@@ -1,0 +1,159 @@
+"""Dataclass configs + argparse front-end.
+
+Replaces the reference's flag system (argparse + module-global ``FLAGS`` +
+``tf.app.run``, SURVEY C18). Flag names and defaults match the reference for
+CLI parity:
+
+* cluster flags — ``demo2/train.py:196-223`` (``--ps_hosts``, ``--worker_hosts``,
+  ``--job_name``, ``--task_index``)
+* retrain flags — ``retrain1/retrain.py:480-632`` and
+  ``retrain2/retrain2.py:512-682`` (``--training_steps`` default differs:
+  10000 single vs 2000 distributed)
+
+Cluster semantics diverge deliberately: there are no parameter servers on TPU.
+``--ps_hosts``/``--job_name=ps`` are accepted for CLI compatibility, but the
+runtime is synchronous SPMD data-parallelism over a device mesh
+(``--worker_hosts`` maps to JAX distributed processes; see
+``parallel/distributed.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def add_dataclass_flags(parser: argparse.ArgumentParser, cls: Type[Any]) -> None:
+    """Auto-register one ``--flag`` per dataclass field (bools as 0/1-style
+    store_true matching the reference's ``action='store_true'`` flags)."""
+    for f in dataclasses.fields(cls):
+        name = "--" + f.name
+        default = f.default if f.default is not dataclasses.MISSING else f.default_factory()  # type: ignore[misc]
+        help_text = f.metadata.get("help", "")
+        if f.type in ("bool", bool):
+            parser.add_argument(name, action="store_true", default=default, help=help_text)
+        else:
+            ftype = {"int": int, "float": float, "str": str}.get(str(f.type), type(default))
+            parser.add_argument(name, type=ftype, default=default, help=help_text)
+
+
+def from_args(cls: Type[T], args: argparse.Namespace) -> T:
+    names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in vars(args).items() if k in names})
+
+
+def parse_flags(*classes: Type[Any], argv=None):
+    """Parse known args into one instance per dataclass (mirrors the
+    reference's ``parser.parse_known_args()`` tolerance of unknown flags,
+    ``demo2/train.py:222``)."""
+    parser = argparse.ArgumentParser()
+    for cls in classes:
+        add_dataclass_flags(parser, cls)
+    ns, _ = parser.parse_known_args(argv)
+    out = tuple(from_args(cls, ns) for cls in classes)
+    return out[0] if len(out) == 1 else out
+
+
+# ---------------------------------------------------------------------------
+# Workload configs.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MnistTrainConfig:
+    """demo1/demo2 MNIST training (defaults from ``demo1/train.py:149-165``:
+    10k steps, batch 100, Adam 1e-4, dropout keep_prob 0.7, eval every 100)."""
+
+    data_dir: str = field(default="MNIST_data", metadata={"help": "idx .gz directory"})
+    log_dir: str = field(default="./logs", metadata={"help": "summaries + autosave ckpts"})
+    model_dir: str = field(default="./model", metadata={"help": "final checkpoint dir"})
+    training_steps: int = 10000
+    batch_size: int = 100
+    learning_rate: float = 1e-4
+    dropout_rate: float = field(
+        default=0.3, metadata={"help": "1 - keep_prob(0.7) from demo1/train.py:155"}
+    )
+    eval_step_interval: int = 100
+    save_model_secs: int = field(
+        default=600, metadata={"help": "Supervisor autosave parity, demo2/train.py:172"}
+    )
+    seed: int = 0
+    synthetic_data: bool = field(
+        default=False, metadata={"help": "generate deterministic synthetic MNIST if idx files absent"}
+    )
+
+
+@dataclass
+class ClusterConfig:
+    """PS/worker cluster flags (``demo2/train.py:196-223``), reinterpreted for
+    SPMD: ``worker_hosts[0]`` is the coordinator, ``task_index`` the process
+    index; ``ps_hosts`` is accepted-and-ignored (no parameter servers on TPU)."""
+
+    ps_hosts: str = field(
+        default="192.168.1.104:2221",
+        metadata={"help": "accepted for CLI parity; unused (no PS on TPU)"},
+    )
+    worker_hosts: str = "192.168.1.105:2222,192.168.1.106:2223"
+    job_name: str = field(default="worker", metadata={"help": "'ps' exits with a notice"})
+    task_index: int = 0
+
+    @property
+    def worker_list(self) -> list[str]:
+        return [h for h in self.worker_hosts.split(",") if h]
+
+    @property
+    def num_processes(self) -> int:
+        return len(self.worker_list)
+
+    @property
+    def coordinator_address(self) -> str:
+        return self.worker_list[0]
+
+    @property
+    def is_chief(self) -> bool:
+        return self.task_index == 0
+
+
+@dataclass
+class RetrainConfig:
+    """Transfer-learning flags, names/defaults from ``retrain1/retrain.py:480-632``."""
+
+    image_dir: str = "./data"
+    output_graph: str = field(
+        default="./retrained_graph.msgpack",
+        metadata={"help": "inference bundle (params); reference wrote a frozen .pb"},
+    )
+    output_labels: str = "./retrained_labels.txt"
+    summaries_dir: str = "./retrain_logs"
+    training_steps: int = 10000
+    learning_rate: float = 0.01
+    testing_percentage: int = 10
+    validation_percentage: int = 10
+    eval_step_interval: int = 10
+    train_batch_size: int = 100
+    test_batch_size: int = -1
+    validation_batch_size: int = 100
+    print_misclassified_test_images: bool = False
+    model_dir: str = field(
+        default="./inception_model",
+        metadata={"help": "Inception-v3 weights dir (npz/msgpack); reference fetched a .pb"},
+    )
+    bottleneck_dir: str = "./bottleneck"
+    final_tensor_name: str = "final_result"
+    flip_left_right: bool = False
+    random_crop: int = 0
+    random_scale: int = 0
+    random_brightness: int = 0
+    seed: int = 0
+
+
+@dataclass
+class DistributedRetrainConfig(RetrainConfig):
+    """retrain2 variant: ``--training_steps`` default 2000
+    (``retrain2/retrain2.py:551``)."""
+
+    training_steps: int = 2000

@@ -1,0 +1,1 @@
+from distributed_tensorflow_tpu.data.mnist import read_data_sets, DataSet, Datasets  # noqa: F401

@@ -1,0 +1,175 @@
+"""MNIST idx loader — in-repo replacement for the TF tutorial ``input_data``
+module the reference imports (``demo1/train.py:6``; ``demo2/train.py:8``).
+
+Parses idx ``.gz`` files directly with numpy (the reference delegated this to
+``tensorflow.examples.tutorials.mnist``). API parity:
+
+    mnist = read_data_sets("MNIST_data", one_hot=True)
+    xs, ys = mnist.train.next_batch(100)        # demo1/train.py:154
+    mnist.test.images, mnist.test.labels        # demo1/train.py:159
+
+``next_batch`` keeps the tutorial semantics: shuffle once per epoch, then
+serve sequential slices. Because this environment has no network egress the
+reference's download-if-absent behavior is replaced by an optional
+deterministic synthetic generator (``synthetic=True``) producing a learnable
+class-structured dataset with identical shapes/dtypes.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+TRAIN_IMAGES = "train-images-idx3-ubyte.gz"
+TRAIN_LABELS = "train-labels-idx1-ubyte.gz"
+TEST_IMAGES = "t10k-images-idx3-ubyte.gz"
+TEST_LABELS = "t10k-labels-idx1-ubyte.gz"
+
+_IDX_IMAGE_MAGIC = 2051
+_IDX_LABEL_MAGIC = 2049
+
+
+def _open_maybe_gz(path: str):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def read_idx_images(path: str) -> np.ndarray:
+    """Parse an idx3-ubyte (optionally gzipped) image file → (N, rows*cols) float32 in [0,1]."""
+    with _open_maybe_gz(path) as fh:
+        magic, n, rows, cols = struct.unpack(">IIII", fh.read(16))
+        if magic != _IDX_IMAGE_MAGIC:
+            raise ValueError(f"{path}: bad idx image magic {magic}")
+        buf = fh.read(n * rows * cols)
+    arr = np.frombuffer(buf, dtype=np.uint8).reshape(n, rows * cols)
+    return arr.astype(np.float32) / 255.0
+
+
+def read_idx_labels(path: str) -> np.ndarray:
+    with _open_maybe_gz(path) as fh:
+        magic, n = struct.unpack(">II", fh.read(8))
+        if magic != _IDX_LABEL_MAGIC:
+            raise ValueError(f"{path}: bad idx label magic {magic}")
+        buf = fh.read(n)
+    return np.frombuffer(buf, dtype=np.uint8).copy()
+
+
+def write_idx_images(path: str, images_u8: np.ndarray) -> None:
+    """Write (N, rows, cols) uint8 images as idx3-ubyte.gz (test fixtures)."""
+    n, rows, cols = images_u8.shape
+    with gzip.open(path, "wb") as fh:
+        fh.write(struct.pack(">IIII", _IDX_IMAGE_MAGIC, n, rows, cols))
+        fh.write(images_u8.astype(np.uint8).tobytes())
+
+
+def write_idx_labels(path: str, labels_u8: np.ndarray) -> None:
+    with gzip.open(path, "wb") as fh:
+        fh.write(struct.pack(">II", _IDX_LABEL_MAGIC, labels_u8.shape[0]))
+        fh.write(labels_u8.astype(np.uint8).tobytes())
+
+
+def one_hot(labels: np.ndarray, num_classes: int = 10) -> np.ndarray:
+    out = np.zeros((labels.shape[0], num_classes), dtype=np.float32)
+    out[np.arange(labels.shape[0]), labels] = 1.0
+    return out
+
+
+_one_hot = one_hot  # module-level alias (read_data_sets has a `one_hot` kwarg)
+
+
+class DataSet:
+    """Epoch-shuffled sequential minibatch iterator (tutorial ``next_batch`` parity)."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray, seed: int = 0):
+        assert images.shape[0] == labels.shape[0]
+        self.images = images
+        self.labels = labels
+        self._num_examples = images.shape[0]
+        self._rng = np.random.default_rng(seed)
+        self._index = 0
+        self._order = self._rng.permutation(self._num_examples)
+
+    @property
+    def num_examples(self) -> int:
+        return self._num_examples
+
+    def next_batch(self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
+        if self._index + batch_size > self._num_examples:
+            self._order = self._rng.permutation(self._num_examples)
+            self._index = 0
+        idx = self._order[self._index : self._index + batch_size]
+        self._index += batch_size
+        return self.images[idx], self.labels[idx]
+
+
+class Datasets:
+    def __init__(self, train: DataSet, test: DataSet, validation: DataSet | None = None):
+        self.train = train
+        self.test = test
+        self.validation = validation
+
+
+def synthetic_mnist(
+    num_train: int = 5000, num_test: int = 1000, seed: int = 0
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Deterministic learnable stand-in for MNIST: each class is a fixed random
+    28×28 blob pattern; samples are the class template blended with noise.
+    Shapes/dtypes identical to the real dataset."""
+    rng = np.random.default_rng(seed)
+    templates = rng.random((10, 784)).astype(np.float32)
+    # Smooth the templates a little so conv features are meaningful.
+    t = templates.reshape(10, 28, 28)
+    t = (t + np.roll(t, 1, 1) + np.roll(t, 1, 2) + np.roll(t, -1, 1) + np.roll(t, -1, 2)) / 5.0
+    templates = t.reshape(10, 784)
+
+    def make(n, rng):
+        labels = rng.integers(0, 10, size=n).astype(np.uint8)
+        noise = rng.random((n, 784)).astype(np.float32)
+        images = np.clip(0.75 * templates[labels] + 0.25 * noise, 0.0, 1.0)
+        return images, labels
+
+    xi, yi = make(num_train, np.random.default_rng(seed + 1))
+    xt, yt = make(num_test, np.random.default_rng(seed + 2))
+    return xi, yi, xt, yt
+
+
+def read_data_sets(
+    data_dir: str,
+    one_hot: bool = True,
+    seed: int = 0,
+    synthetic: bool = False,
+    num_synthetic_train: int = 5000,
+    num_synthetic_test: int = 1000,
+) -> Datasets:
+    """Load MNIST from idx files in ``data_dir``; if files are absent and
+    ``synthetic`` is set, fall back to the deterministic synthetic dataset
+    (this environment has no egress, so the reference's download path —
+    ``input_data.read_data_sets`` auto-fetch — cannot be replicated)."""
+    paths = {k: os.path.join(data_dir, k) for k in (TRAIN_IMAGES, TRAIN_LABELS, TEST_IMAGES, TEST_LABELS)}
+    have_all = all(os.path.exists(p) for p in paths.values())
+    if have_all:
+        train_x = read_idx_images(paths[TRAIN_IMAGES])
+        train_y = read_idx_labels(paths[TRAIN_LABELS])
+        test_x = read_idx_images(paths[TEST_IMAGES])
+        test_y = read_idx_labels(paths[TEST_LABELS])
+    elif synthetic:
+        train_x, train_y, test_x, test_y = synthetic_mnist(
+            num_synthetic_train, num_synthetic_test, seed
+        )
+    else:
+        missing = [k for k, p in paths.items() if not os.path.exists(p)]
+        raise FileNotFoundError(
+            f"MNIST idx files missing in {data_dir}: {missing}. "
+            "No network egress is available; pass synthetic=True (or --synthetic_data) "
+            "for a deterministic stand-in dataset."
+        )
+    if one_hot:
+        train_yy, test_yy = _one_hot(train_y), _one_hot(test_y)
+    else:
+        train_yy, test_yy = train_y, test_y
+    return Datasets(
+        train=DataSet(train_x, train_yy, seed=seed),
+        test=DataSet(test_x, test_yy, seed=seed + 1),
+    )

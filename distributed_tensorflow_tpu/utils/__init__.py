@@ -1,0 +1,3 @@
+from distributed_tensorflow_tpu.utils.summary import SummaryWriter, variable_summaries  # noqa: F401
+from distributed_tensorflow_tpu.utils.timer import StepTimer, WallClock  # noqa: F401
+from distributed_tensorflow_tpu.utils.logging import get_logger  # noqa: F401

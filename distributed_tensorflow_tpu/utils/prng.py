@@ -1,0 +1,33 @@
+"""PRNG key discipline.
+
+The reference relies on TF 1.x implicit graph-level randomness (e.g.
+``tf.truncated_normal`` in ``demo1/train.py:29``, random distortions in
+``retrain1/retrain.py:137-165``). JAX requires explicit keys; these helpers
+keep key handling uniform across the framework.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class KeySeq:
+    """Deterministic stream of PRNG keys: ``ks = KeySeq(0); k = ks.next()``."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(seed)
+
+    def next(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def next_n(self, n: int):
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return subs
+
+
+def fold_in_step(key: jax.Array, step: int) -> jax.Array:
+    """Per-step key derivation — stable under checkpoint/resume (the key for
+    step N is a pure function of (base key, N), so resuming mid-run replays
+    identical dropout/augmentation randomness)."""
+    return jax.random.fold_in(key, step)

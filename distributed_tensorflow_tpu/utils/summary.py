@@ -1,0 +1,275 @@
+"""Self-contained TensorBoard event-file writer (no TF dependency).
+
+TPU-native replacement for the reference's ``tf.summary.*`` + ``FileWriter``
+observability layer (``demo1/train.py:15-24,143-146,151,157``;
+``retrain1/retrain.py:248-258,420-421,440-446``). The reference delegates to
+TF's C++ record writer; here the TFRecord framing (length + masked-CRC32C) and
+the Event/Summary protobuf encoding are implemented directly so event files are
+readable by any stock TensorBoard.
+
+Wire formats implemented:
+  * TFRecord: ``u64le(len) crc32c_masked(len_bytes) data crc32c_masked(data)``
+  * ``Event``  proto: wall_time(1,double) step(2,int64) file_version(3,string)
+    summary(5,message)
+  * ``Summary`` proto: repeated value(1); ``Summary.Value``: tag(1,string)
+    simple_value(2,float) histo(5,message)
+  * ``HistogramProto``: min(1) max(2) num(3) sum(4) sum_squares(5)
+    bucket_limit(6,packed double) bucket(7,packed double)
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli), table-driven, with the TFRecord masking scheme.
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE: list[int] = []
+
+
+def _build_crc_table() -> list[int]:
+    poly = 0x82F63B78  # reflected Castagnoli polynomial
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+def crc32c(data: bytes) -> int:
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        _CRC_TABLE = _build_crc_table()
+    crc = 0xFFFFFFFF
+    table = _CRC_TABLE
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+_MASK_DELTA = 0xA282EAD8
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire-format encoders.
+# ---------------------------------------------------------------------------
+
+
+def _varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return _varint((field << 3) | wire_type)
+
+
+def _f_double(field: int, value: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", value)
+
+
+def _f_float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", value)
+
+
+def _f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def _f_bytes(field: int, value: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(value)) + value
+
+
+def _f_packed_doubles(field: int, values) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in values)
+    return _f_bytes(field, payload)
+
+
+def encode_histogram(values: np.ndarray) -> bytes:
+    """Encode a ``HistogramProto`` over ``values`` with TF-style exponential buckets."""
+    flat = np.asarray(values, dtype=np.float64).ravel()
+    if flat.size == 0:
+        flat = np.zeros((1,), dtype=np.float64)
+    # TF-compatible bucket boundaries: +/- 1e-12 * 1.1^k geometric series.
+    limits = [1e-12]
+    while limits[-1] < 1e20:
+        limits.append(limits[-1] * 1.1)
+    neg = [-x for x in reversed(limits)]
+    bucket_limit = np.array(neg + limits + [np.finfo(np.float64).max])
+    counts, _ = np.histogram(flat, bins=np.concatenate(([-np.inf], bucket_limit)))
+    # Drop empty trailing/leading buckets for compactness (keep at least one).
+    nz = np.nonzero(counts)[0]
+    if nz.size:
+        lo, hi = nz[0], nz[-1] + 1
+        bucket_limit, counts = bucket_limit[lo:hi], counts[lo:hi]
+    else:
+        bucket_limit, counts = bucket_limit[:1], counts[:1]
+    msg = b"".join(
+        [
+            _f_double(1, float(flat.min())),
+            _f_double(2, float(flat.max())),
+            _f_double(3, float(flat.size)),
+            _f_double(4, float(flat.sum())),
+            _f_double(5, float(np.square(flat).sum())),
+            _f_packed_doubles(6, bucket_limit),
+            _f_packed_doubles(7, counts.astype(np.float64)),
+        ]
+    )
+    return msg
+
+
+def encode_scalar_value(tag: str, value: float) -> bytes:
+    return _f_bytes(1, _f_bytes(1, tag.encode()) + _f_float(2, float(value)))
+
+
+def encode_histo_value(tag: str, values: np.ndarray) -> bytes:
+    return _f_bytes(1, _f_bytes(1, tag.encode()) + _f_bytes(5, encode_histogram(values)))
+
+
+def encode_event(
+    wall_time: float,
+    step: int | None = None,
+    summary_values: bytes | None = None,
+    file_version: str | None = None,
+) -> bytes:
+    msg = _f_double(1, wall_time)
+    if step is not None:
+        msg += _f_varint(2, int(step))
+    if file_version is not None:
+        msg += _f_bytes(3, file_version.encode())
+    if summary_values:
+        msg += _f_bytes(5, summary_values)
+    return msg
+
+
+def write_record(fh, data: bytes) -> None:
+    header = struct.pack("<Q", len(data))
+    fh.write(header)
+    fh.write(struct.pack("<I", masked_crc32c(header)))
+    fh.write(data)
+    fh.write(struct.pack("<I", masked_crc32c(data)))
+
+
+def read_records(path: str):
+    """Yield raw record payloads from a TFRecord event file, verifying CRCs."""
+    with open(path, "rb") as fh:
+        while True:
+            header = fh.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", fh.read(4))
+            if masked_crc32c(header) != hcrc:
+                raise IOError(f"corrupt record header in {path}")
+            data = fh.read(length)
+            (dcrc,) = struct.unpack("<I", fh.read(4))
+            if masked_crc32c(data) != dcrc:
+                raise IOError(f"corrupt record payload in {path}")
+            yield data
+
+
+# ---------------------------------------------------------------------------
+# Public writer API.
+# ---------------------------------------------------------------------------
+
+
+class SummaryWriter:
+    """TensorBoard event writer: ``add_scalar`` / ``add_histogram`` / ``flush``.
+
+    Mirrors the role of ``tf.summary.FileWriter(logdir)`` in the reference
+    (``demo1/train.py:151``). Thread-safe; writes are buffered and flushed
+    explicitly or on close.
+    """
+
+    def __init__(self, logdir: str, filename_suffix: str = ""):
+        os.makedirs(logdir, exist_ok=True)
+        self.logdir = logdir
+        fname = "events.out.tfevents.%010d.%s%s" % (
+            int(time.time()),
+            socket.gethostname(),
+            filename_suffix,
+        )
+        self._path = os.path.join(logdir, fname)
+        self._fh = open(self._path, "wb")
+        self._lock = threading.Lock()
+        write_record(self._fh, encode_event(time.time(), file_version="brain.Event:2"))
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        with self._lock:
+            write_record(
+                self._fh, encode_event(time.time(), step, encode_scalar_value(tag, value))
+            )
+
+    def add_scalars(self, scalars: dict, step: int) -> None:
+        values = b"".join(encode_scalar_value(t, v) for t, v in scalars.items())
+        with self._lock:
+            write_record(self._fh, encode_event(time.time(), step, values))
+
+    def add_histogram(self, tag: str, values, step: int) -> None:
+        with self._lock:
+            write_record(
+                self._fh,
+                encode_event(time.time(), step, encode_histo_value(tag, np.asarray(values))),
+            )
+
+    def flush(self) -> None:
+        with self._lock:
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.flush()
+                self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def variable_summaries(writer: SummaryWriter, name: str, values, step: int) -> None:
+    """Parity with the reference's ``variable_summaries`` (``demo1/train.py:15-24``):
+
+    emits mean / stddev / max / min scalars plus a histogram for a tensor.
+    Runs host-side on materialized arrays (summaries are not part of the jitted
+    step — on TPU we keep the hot loop free of host syncs and sample summaries
+    at eval boundaries instead).
+    """
+    arr = np.asarray(values)
+    writer.add_scalars(
+        {
+            f"{name}/mean": float(arr.mean()),
+            f"{name}/stddev": float(arr.std()),
+            f"{name}/max": float(arr.max()),
+            f"{name}/min": float(arr.min()),
+        },
+        step,
+    )
+    writer.add_histogram(f"{name}/histogram", arr, step)

@@ -1,0 +1,53 @@
+"""Wall-clock and step timing.
+
+Parity with the reference's ``time.time()`` deltas printed as
+``Training time:`` / ``Total time:`` (``demo1/train.py:152,164``;
+``retrain1/retrain.py:373,423,468,476``), plus steps/sec tracking for the
+bench harness.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class WallClock:
+    """Elapsed wall-clock timer: ``WallClock()`` starts; ``.elapsed`` reads."""
+
+    def __init__(self):
+        self.start = time.time()
+
+    @property
+    def elapsed(self) -> float:
+        return time.time() - self.start
+
+    def lap(self) -> float:
+        now = time.time()
+        out = now - self.start
+        self.start = now
+        return out
+
+
+class StepTimer:
+    """Tracks steps/sec over a sliding window, excluding warmup/compile steps."""
+
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup_steps = warmup_steps
+        self._count = 0
+        self._timed_steps = 0
+        self._timed_seconds = 0.0
+        self._last = None
+
+    def tick(self) -> None:
+        now = time.time()
+        if self._last is not None and self._count >= self.warmup_steps:
+            self._timed_steps += 1
+            self._timed_seconds += now - self._last
+        self._last = now
+        self._count += 1
+
+    @property
+    def steps_per_sec(self) -> float:
+        if self._timed_seconds <= 0:
+            return 0.0
+        return self._timed_steps / self._timed_seconds

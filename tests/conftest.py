@@ -1,0 +1,14 @@
+"""Test env: force JAX onto 8 virtual CPU devices BEFORE jax import.
+
+This replaces the reference's nonexistent multi-node test story (SURVEY §4):
+sharding/collective code paths are exercised on a single host via
+``--xla_force_host_platform_device_count=8``.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,48 @@
+"""Config/flag-system tests (reference C18 parity)."""
+
+from distributed_tensorflow_tpu.config import (
+    ClusterConfig,
+    DistributedRetrainConfig,
+    MnistTrainConfig,
+    RetrainConfig,
+    parse_flags,
+)
+
+
+def test_defaults_match_reference():
+    m = MnistTrainConfig()
+    assert m.training_steps == 10000 and m.batch_size == 100 and m.learning_rate == 1e-4
+    r = RetrainConfig()
+    assert r.training_steps == 10000 and r.learning_rate == 0.01
+    assert r.testing_percentage == 10 and r.validation_percentage == 10
+    assert r.train_batch_size == 100 and r.test_batch_size == -1
+    assert DistributedRetrainConfig().training_steps == 2000
+    c = ClusterConfig()
+    assert c.job_name == "worker" and c.task_index == 0
+
+
+def test_parse_flags_overrides():
+    cfg = parse_flags(RetrainConfig, argv=["--learning_rate", "0.5", "--image_dir", "/x"])
+    assert cfg.learning_rate == 0.5 and cfg.image_dir == "/x"
+    assert cfg.training_steps == 10000  # untouched default
+
+
+def test_parse_flags_tolerates_unknown():
+    cfg = parse_flags(MnistTrainConfig, argv=["--training_steps", "5", "--bogus", "1"])
+    assert cfg.training_steps == 5
+
+
+def test_cluster_parsing():
+    c = parse_flags(
+        ClusterConfig,
+        argv=["--worker_hosts", "a:1,b:2,c:3", "--task_index", "2", "--job_name", "worker"],
+    )
+    assert c.num_processes == 3
+    assert c.coordinator_address == "a:1"
+    assert not c.is_chief
+
+
+def test_bool_flags():
+    cfg = parse_flags(RetrainConfig, argv=["--flip_left_right"])
+    assert cfg.flip_left_right is True
+    assert parse_flags(RetrainConfig, argv=[]).flip_left_right is False

@@ -1,0 +1,61 @@
+"""Event-writer tests: CRC-verified round trip + stock-TensorBoard readability."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.utils import summary as S
+
+
+def test_crc32c_known_vectors():
+    # Published CRC-32C test vectors (RFC 3720 / kernel crypto tests).
+    assert S.crc32c(b"") == 0x00000000
+    assert S.crc32c(b"a") == 0xC1D04330
+    assert S.crc32c(b"123456789") == 0xE3069283
+    assert S.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_record_roundtrip(tmp_path):
+    w = S.SummaryWriter(str(tmp_path))
+    w.add_scalar("loss", 1.5, step=1)
+    w.add_scalar("accuracy", 0.25, step=1)
+    w.add_histogram("weights", np.linspace(-1, 1, 100), step=2)
+    w.close()
+    records = list(S.read_records(w.path))
+    assert len(records) == 4  # file_version + 2 scalars + 1 histogram
+
+
+def test_corruption_detected(tmp_path):
+    w = S.SummaryWriter(str(tmp_path))
+    w.add_scalar("x", 1.0, step=0)
+    w.close()
+    raw = bytearray(open(w.path, "rb").read())
+    raw[-6] ^= 0xFF  # flip a byte inside the last record's payload
+    open(w.path, "wb").write(bytes(raw))
+    with pytest.raises(IOError):
+        list(S.read_records(w.path))
+
+
+def test_tensorboard_can_parse(tmp_path):
+    tb = pytest.importorskip("tensorboard.backend.event_processing.event_file_loader")
+    w = S.SummaryWriter(str(tmp_path))
+    w.add_scalar("loss", 3.14, step=7)
+    w.add_histogram("h", np.arange(10.0), step=7)
+    w.close()
+    events = list(tb.EventFileLoader(w.path).Load())
+    assert len(events) == 3
+    # The loader migrates legacy simple_value/histo summaries to tensor form —
+    # successful migration proves the wire format is exactly what TB expects.
+    scalar_ev = events[1]
+    assert scalar_ev.step == 7
+    assert scalar_ev.summary.value[0].tag == "loss"
+    assert abs(scalar_ev.summary.value[0].tensor.float_val[0] - 3.14) < 1e-6
+    histo_ev = events[2]
+    hist_tensor = histo_ev.summary.value[0].tensor
+    assert hist_tensor.tensor_shape.dim[1].size == 3  # (left, right, count) triples
+
+
+def test_variable_summaries(tmp_path):
+    w = S.SummaryWriter(str(tmp_path))
+    S.variable_summaries(w, "layer1/weights", np.random.randn(32, 32), step=0)
+    w.close()
+    assert len(list(S.read_records(w.path))) == 3  # version + 4-scalar event + histogram
