@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Hand-drawn digit inference — TPU-native counterpart of the reference's
+``demo1/test.py``: walks ``imgs/``, preprocesses each image with the PIL
+pipeline (grayscale → 20 px aspect resize → sharpen → centered 28×28 white
+canvas → invert-normalize), and prints the predicted digit.
+
+Divergences from the reference (SURVEY §7 "known defects not replicated"):
+the model graph is built and jitted ONCE and reused for all images (the
+reference rebuilt + restored the full graph per image, ``demo1/test.py:9``),
+and there is no init-before-restore. Display via matplotlib is opt-in
+(``--show``) instead of blocking per image."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu.data.digit import classify_digit_images
+from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+from distributed_tensorflow_tpu.train.checkpoint import load_inference_bundle
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="model/train.msgpack")
+    parser.add_argument("--imgs_dir", default="imgs/")
+    parser.add_argument("--show", action="store_true", help="display each image")
+    args, _ = parser.parse_known_args(argv)
+
+    model = MnistCNN()
+    template = model.init(jax.random.PRNGKey(0), np.zeros((1, 784), np.float32))["params"]
+    params, _ = load_inference_bundle(args.model, template=template)
+    predict = jax.jit(lambda p, x: jax.numpy.argmax(model.apply({"params": p}, x), -1))
+    return classify_digit_images(lambda x: predict(params, x)[0], args.imgs_dir, args.show)
+
+
+if __name__ == "__main__":
+    main()

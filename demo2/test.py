@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Digit inference against the distributed-trained model — counterpart of the
+reference's ``demo2/test.py`` (which restored the Supervisor autosave ckpt
+``logs/model.ckpt-3706``). Restores the chief's exported bundle (or the
+latest Orbax autosave in ``--log_dir``) and classifies ``imgs/``."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu.data.digit import classify_digit_images
+from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+from distributed_tensorflow_tpu.train.checkpoint import (
+    CheckpointManager,
+    load_inference_bundle,
+)
+
+
+def load_params(model, log_dir: str, bundle: str | None):
+    template = model.init(jax.random.PRNGKey(0), np.zeros((1, 784), np.float32))["params"]
+    bundle = bundle or os.path.join(log_dir, "model.msgpack")
+    if os.path.exists(bundle):
+        params, _ = load_inference_bundle(bundle, template=template)
+        return params
+    # Fall back to the latest autosaved training checkpoint (Supervisor-ckpt
+    # parity: demo2/test.py:182 restored logs/model.ckpt-<step>). Check the
+    # dir first: constructing a CheckpointManager would mkdir it.
+    if not os.path.isdir(log_dir):
+        raise FileNotFoundError(f"no model bundle or checkpoint dir at {log_dir}")
+    mngr = CheckpointManager(log_dir)
+    restored = mngr.restore_latest_raw()
+    if restored is None:
+        raise FileNotFoundError(f"no model bundle or checkpoint found in {log_dir}")
+    from flax import serialization
+
+    return serialization.from_state_dict(template, restored[1]["params"])
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--log_dir", default="./logs")
+    parser.add_argument("--model", default=None, help="explicit bundle path")
+    parser.add_argument("--imgs_dir", default="imgs/")
+    parser.add_argument("--show", action="store_true")
+    args, _ = parser.parse_known_args(argv)
+
+    model = MnistCNN()
+    params = load_params(model, args.log_dir, args.model)
+    predict = jax.jit(lambda p, x: jax.numpy.argmax(model.apply({"params": p}, x), -1))
+    return classify_digit_images(lambda x: predict(params, x)[0], args.imgs_dir, args.show)
+
+
+if __name__ == "__main__":
+    main()

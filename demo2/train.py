@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Distributed MNIST CNN training — TPU-native counterpart of the reference's
+``demo2/train.py`` (PS/worker asynchronous data parallelism over gRPC).
+
+Architecture divergence (deliberate, SURVEY §2.2): the reference's parameter
+servers and async HogWild updates are replaced by synchronous SPMD data
+parallelism — every device in the ``jax.sharding.Mesh`` holds the parameters
+in HBM and gradients are mean-reduced with one ``lax.psum`` over ICI per step.
+The reference CLI surface is preserved: ``--ps_hosts`` is accepted-and-unused,
+``--job_name=ps`` exits with an explanation, ``--worker_hosts``/
+``--task_index`` define the JAX process group (coordinator = first worker),
+and task 0 is chief (owns checkpoints/summaries), exactly as
+``Supervisor(is_chief=task_index==0)`` did (``demo2/train.py:166-172``).
+
+Single-process invocation uses every local device (e.g. all 8 chips of a
+v5e-8); multi-host invocation runs one process per host."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from distributed_tensorflow_tpu.config import ClusterConfig, MnistTrainConfig, parse_flags
+from distributed_tensorflow_tpu.parallel import consistency, distributed
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_tpu.train.checkpoint import export_inference_bundle
+from distributed_tensorflow_tpu.train.loop import MnistTrainer
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+
+def main(argv=None):
+    log = get_logger("demo2.train")
+    cfg, cluster = parse_flags(MnistTrainConfig, ClusterConfig, argv=argv)
+    if not distributed.initialize_from_cluster(cluster):
+        return None  # ps role: nothing to do on TPU
+    mesh = make_mesh()  # all (global) devices
+    trainer = MnistTrainer(cfg, mesh=mesh, is_chief=distributed.is_chief())
+    log.info("training over %d devices (mesh %s)", mesh.devices.size, dict(mesh.shape))
+    stats = trainer.train()
+    # Sync-SPMD analog of the reference's implicit PS consistency: verify all
+    # processes ended with bitwise-identical parameters.
+    consistency.check_cross_process_consistency(trainer.params)
+    if distributed.is_chief():
+        out = os.path.join(cfg.log_dir, "model.msgpack")
+        export_inference_bundle(out, trainer.params, metadata={"model": "MnistCNN"})
+        log.info("Total time: %.2fs; model exported to %s", stats["seconds"], out)
+    return stats
+
+
+if __name__ == "__main__":
+    main()

@@ -97,7 +97,12 @@ class ClusterConfig:
         default="192.168.1.104:2221",
         metadata={"help": "accepted for CLI parity; unused (no PS on TPU)"},
     )
-    worker_hosts: str = "192.168.1.105:2222,192.168.1.106:2223"
+    # The reference defaulted to the author's two LAN IPs
+    # (demo2/train.py:201,207) — with that default a bare invocation would
+    # block waiting for a second process to join the coordination service.
+    # Default here is single-process (all local devices); pass an explicit
+    # multi-host list to go multi-process.
+    worker_hosts: str = "localhost:12355"
     job_name: str = field(default="worker", metadata={"help": "'ps' exits with a notice"})
     task_index: int = 0
 

@@ -1,0 +1,67 @@
+"""Hand-drawn digit preprocessing for the demo test CLIs.
+
+Behavioral parity with the reference's ``imageprepare`` (``demo1/test.py:12-42``
+== ``demo2/test.py``): grayscale → aspect-preserving resize so the larger
+dimension becomes 20 px → SHARPEN filter → paste centered on a white 28×28
+canvas (4 px margin on the long side) → invert-normalize so 0=white, 1=black
+(matching MNIST's ink-is-high convention).
+
+``Image.ANTIALIAS`` was removed in modern Pillow; ``LANCZOS`` is the same
+resampling filter under its current name.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from PIL import Image, ImageFilter
+
+_RESAMPLE = getattr(Image, "LANCZOS", getattr(Image, "Resampling", Image).LANCZOS)
+
+
+_IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif")
+
+
+def classify_digit_images(predict_fn, imgs_dir: str, show: bool = False) -> dict[str, int]:
+    """Walk ``imgs_dir``, preprocess each image via :func:`imageprepare`, call
+    ``predict_fn((1, 784) array) -> digit``, print and collect results.
+
+    Shared by the demo1/demo2 test CLIs (the reference duplicated this loop
+    byte-identically across ``demo1/test.py`` and ``demo2/test.py``).
+    Non-image files are skipped instead of crashing the walk."""
+    results: dict[str, int] = {}
+    for root, _, files in os.walk(imgs_dir):
+        for fname in sorted(files):
+            if not fname.lower().endswith(_IMAGE_EXTS):
+                continue
+            path = os.path.join(root, fname)
+            digit = int(predict_fn(imageprepare(path)[None, :]))
+            results[path] = digit
+            print(f"{path}: the predicted digit is {digit}")
+            if show:
+                import matplotlib.pyplot as plt
+
+                plt.imshow(Image.open(path))
+                plt.title(f"predicted: {digit}")
+                plt.show()
+    return results
+
+
+def imageprepare(path: str) -> np.ndarray:
+    """Load an image file → flat float32 (784,) in [0,1], MNIST-style."""
+    im = Image.open(path).convert("L")
+    width, height = float(im.size[0]), float(im.size[1])
+    canvas = Image.new("L", (28, 28), 255)
+    if width > height:
+        nheight = max(1, int(round(20.0 / width * height)))
+        img = im.resize((20, nheight), _RESAMPLE).filter(ImageFilter.SHARPEN)
+        wtop = int(round((28 - nheight) / 2))
+        canvas.paste(img, (4, wtop))
+    else:
+        nwidth = max(1, int(round(20.0 / height * width)))
+        img = im.resize((nwidth, 20), _RESAMPLE).filter(ImageFilter.SHARPEN)
+        wleft = int(round((28 - nwidth) / 2))
+        canvas.paste(img, (wleft, 4))
+    arr = np.asarray(canvas, dtype=np.float32).reshape(-1)
+    return (255.0 - arr) / 255.0

@@ -1,0 +1,1 @@
+from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN  # noqa: F401

@@ -1,0 +1,88 @@
+"""MNIST convnet — TPU-native re-design of the reference model (C2).
+
+Architecture parity with ``demo1/train.py:49-123`` (identical copies at
+``demo2/train.py:48-139``, ``demo*/test.py``):
+
+    input 28×28×1
+    → conv 5×5×32 (SAME, stride 1) + ReLU → maxpool 2×2     ("Conv1")
+    → conv 5×5×64 (SAME, stride 1) + ReLU → maxpool 2×2     ("Conv2")
+    → FC 7·7·64→1024 + ReLU + dropout                        ("fc1")
+    → FC 1024→10                                             ("fc2")
+
+Init parity: truncated-normal σ=0.1 weights / constant-0.1 biases
+(``demo1/train.py:28-34``).
+
+TPU-first divergences (deliberate):
+  * The model returns **logits**; softmax happens inside the loss. The
+    reference applies softmax in the graph and then feeds the result to
+    ``softmax_cross_entropy_with_logits`` — a double-softmax defect
+    (``demo1/train.py:123,127``) we do not replicate.
+  * Compute runs in bfloat16 with float32 params/accumulation (MXU-friendly);
+    pass ``compute_dtype=jnp.float32`` for exact-f32 paths (tests).
+  * NHWC layout, batch-leading, static shapes — XLA tiles the convs onto the
+    MXU without layout churn.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def trunc_normal_init(stddev: float = 0.1):
+    return nn.initializers.truncated_normal(stddev=stddev)
+
+
+def const_init(value: float = 0.1):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+
+    return init
+
+
+class MnistCNN(nn.Module):
+    """2-conv + 2-FC MNIST classifier. ``apply`` takes (B, 784) or (B, 28, 28, 1)."""
+
+    num_classes: int = 10
+    dropout_rate: float = 0.3  # 1 - keep_prob(0.7), demo1/train.py:155
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        if x.ndim == 2:
+            x = x.reshape((-1, 28, 28, 1))  # reference reshape, demo1/train.py:54
+        x = x.astype(self.compute_dtype)
+        conv = lambda feat, name: nn.Conv(
+            feat,
+            kernel_size=(5, 5),
+            padding="SAME",
+            kernel_init=trunc_normal_init(),
+            bias_init=const_init(),
+            dtype=self.compute_dtype,
+            param_dtype=jnp.float32,
+            name=name,
+        )
+        x = nn.relu(conv(32, "Conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(conv(64, "Conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))  # (B, 7*7*64)
+        x = nn.Dense(
+            1024,
+            kernel_init=trunc_normal_init(),
+            bias_init=const_init(),
+            dtype=self.compute_dtype,
+            param_dtype=jnp.float32,
+            name="fc1",
+        )(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(
+            self.num_classes,
+            kernel_init=trunc_normal_init(),
+            bias_init=const_init(),
+            dtype=self.compute_dtype,
+            param_dtype=jnp.float32,
+            name="fc2",
+        )(x)
+        return x.astype(jnp.float32)  # logits in f32 for a stable loss

@@ -1,0 +1,4 @@
+from distributed_tensorflow_tpu.ops.losses import (  # noqa: F401
+    accuracy,
+    softmax_cross_entropy,
+)

@@ -1,0 +1,41 @@
+"""Loss + metric ops (reference C3, ``demo1/train.py:126-141``).
+
+The reference computes softmax in the model and then calls
+``softmax_cross_entropy_with_logits`` on the softmaxed output — softmax is
+applied twice (defect noted in SURVEY §2.1 C3). Here the loss takes true
+logits; this is the documented divergence (better-conditioned gradients,
+identical argmax predictions).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, onehot_labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy over the batch, computed in float32."""
+    logits = logits.astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(onehot_labels * log_probs, axis=-1))
+
+
+def per_example_cross_entropy(logits: jnp.ndarray, onehot_labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-example softmax cross-entropy (no reduction), float32."""
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.sum(onehot_labels * log_probs, axis=-1)
+
+
+def correct_mask(logits: jnp.ndarray, onehot_labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-example 1.0/0.0 argmax-correct indicator."""
+    return (jnp.argmax(logits, -1) == jnp.argmax(onehot_labels, -1)).astype(jnp.float32)
+
+
+def accuracy(logits: jnp.ndarray, onehot_labels: jnp.ndarray) -> jnp.ndarray:
+    """Fraction of argmax-correct predictions (``demo1/train.py:135-137``)."""
+    return jnp.mean(correct_mask(logits, onehot_labels))
+
+
+def correct_count(logits: jnp.ndarray, onehot_labels: jnp.ndarray) -> jnp.ndarray:
+    """Number of correct predictions — summable across shards with ``psum``."""
+    return jnp.sum(correct_mask(logits, onehot_labels))

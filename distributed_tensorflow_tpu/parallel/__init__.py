@@ -1,0 +1,7 @@
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh  # noqa: F401
+from distributed_tensorflow_tpu.parallel.data_parallel import (  # noqa: F401
+    build_eval_step,
+    build_train_step,
+    replicate,
+    shard_batch,
+)

@@ -1,0 +1,48 @@
+"""Cross-replica parameter-consistency checking.
+
+The reference's async-PS design *embraces* benign data races on parameters
+(HogWild updates — SURVEY §5.2). Synchronous SPMD has no such races, but
+silent divergence (e.g. non-deterministic host preprocessing leaking into
+params, or a bad collective) is the analogous failure mode; this module is the
+detector for it: a cheap fingerprint of the param pytree compared across
+processes/replicas.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def param_fingerprint(params: Any) -> str:
+    """Deterministic content hash of a pytree (leaf paths + exact bytes)."""
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(params)[0]
+    h = hashlib.sha256()
+    for path, leaf in leaves_with_paths:
+        h.update(jax.tree_util.keystr(path).encode())
+        arr = np.asarray(jax.device_get(leaf))
+        h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def check_cross_process_consistency(params: Any, raise_on_mismatch: bool = True) -> bool:
+    """Verify all processes hold bitwise-identical parameters.
+
+    Uses a numeric digest (first 8 bytes of the sha256) all-gathered across
+    processes. Single-process: trivially consistent."""
+    if jax.process_count() == 1:
+        return True
+    from jax.experimental import multihost_utils
+
+    digest = np.frombuffer(bytes.fromhex(param_fingerprint(params)[:16]), dtype=np.uint32)
+    gathered = multihost_utils.process_allgather(digest)
+    ok = bool(np.all(gathered == gathered[0]))
+    if not ok and raise_on_mismatch:
+        raise RuntimeError(
+            f"parameter divergence across processes: digests {gathered.ravel().tolist()}"
+        )
+    return ok

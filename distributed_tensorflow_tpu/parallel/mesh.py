@@ -1,0 +1,55 @@
+"""Device-mesh construction.
+
+Replaces the reference's ``tf.train.ClusterSpec`` + ``replica_device_setter``
+placement model (``demo2/train.py:18-29``): instead of pinning variables to
+parameter-server processes and ops to worker processes, all devices form a
+``jax.sharding.Mesh``; parameters are replicated (or sharded) across it and
+XLA inserts ICI collectives where shardings demand.
+
+Axis conventions (room for every strategy even though the reference only
+exercises DP — SURVEY §2.3):
+  * ``data``  — batch (data-parallel) axis
+  * ``model`` — tensor-parallel axis (optional second mesh dim)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    num_devices: int | None = None,
+    model_parallel: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ('data', 'model') mesh over local (or given) devices.
+
+    ``model_parallel=1`` (the default, and all the reference needs) yields a
+    pure data-parallel mesh."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices but only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    n = len(devices)
+    if n % model_parallel:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    arr = np.array(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, axis_names=("data", "model"))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-leading sharding: dim 0 split over 'data', rest replicated."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_data_size(mesh: Mesh) -> int:
+    return mesh.shape["data"]

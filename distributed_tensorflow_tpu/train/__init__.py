@@ -1,0 +1,6 @@
+from distributed_tensorflow_tpu.train.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    export_inference_bundle,
+    load_inference_bundle,
+)
+from distributed_tensorflow_tpu.train.loop import MnistTrainer  # noqa: F401

@@ -1,0 +1,187 @@
+"""MNIST trainer — the demo1/demo2 training loop, TPU-native.
+
+One loop serves both the single-device (``demo1/train.py:149-165``) and
+distributed (``demo2/train.py:176-193``) workloads: the only difference is the
+mesh it runs over. Structure parity with the reference:
+
+  * ``training_steps`` steps of batch-``batch_size`` Adam updates
+  * full test-set + train-set accuracy eval every ``eval_step_interval``
+    (reference evals *inside* the hot loop at ``demo1/train.py:158-163`` with
+    full-dataset feed_dict runs — here eval is a separate jitted sharded
+    program and the hot loop stays free of host transfers)
+  * scalar/histogram summaries per eval (not per step: a per-step host sync
+    would stall the TPU pipeline; divergence documented)
+  * timed checkpoint autosave + restore-on-start (Supervisor parity)
+  * wall-clock ``Training time`` print (``demo1/train.py:164``)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_tensorflow_tpu.config import MnistTrainConfig
+from distributed_tensorflow_tpu.data.mnist import DataSet, read_data_sets
+from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+from distributed_tensorflow_tpu.parallel import data_parallel as dp
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_tpu.train.checkpoint import CheckpointManager
+from distributed_tensorflow_tpu.utils.logging import get_logger
+from distributed_tensorflow_tpu.utils.prng import fold_in_step
+from distributed_tensorflow_tpu.utils.summary import SummaryWriter, variable_summaries
+from distributed_tensorflow_tpu.utils.timer import StepTimer, WallClock
+
+log = get_logger(__name__)
+
+
+class MnistTrainer:
+    def __init__(
+        self,
+        cfg: MnistTrainConfig,
+        mesh=None,
+        datasets=None,
+        model: MnistCNN | None = None,
+        is_chief: bool = True,
+        eval_chunk: int = 2000,
+        scale_batch_by_mesh: bool = True,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(num_devices=1)
+        self.model = model or MnistCNN(dropout_rate=cfg.dropout_rate)
+        self.datasets = datasets or read_data_sets(
+            cfg.data_dir, one_hot=True, seed=cfg.seed, synthetic=cfg.synthetic_data
+        )
+        self.is_chief = is_chief
+        self.eval_chunk = eval_chunk
+        self.mesh_size = self.mesh.devices.size
+        # Reference demo2 semantics: each of n async workers consumed
+        # batch_size examples per step; the sync-SPMD equivalent is a global
+        # batch of batch_size × mesh_size (each device computes one
+        # batch_size shard). With a 1-device mesh this is exactly demo1.
+        if scale_batch_by_mesh:
+            self.global_batch = cfg.batch_size * self.mesh_size
+        else:
+            if cfg.batch_size % self.mesh_size:
+                raise ValueError(
+                    f"batch_size {cfg.batch_size} not divisible by mesh size {self.mesh_size}"
+                )
+            self.global_batch = cfg.batch_size
+
+        self.tx = optax.adam(cfg.learning_rate)  # demo1/train.py:132
+        self.rng = jax.random.PRNGKey(cfg.seed)
+
+        params = self.model.init(
+            jax.random.PRNGKey(cfg.seed), jnp.zeros((1, 784), jnp.float32), train=False
+        )["params"]
+        opt_state = self.tx.init(params)
+        self.params = dp.replicate(params, self.mesh)
+        self.opt_state = dp.replicate(opt_state, self.mesh)
+        self.global_step = dp.replicate(jnp.zeros((), jnp.int32), self.mesh)
+
+        self.train_step = dp.build_train_step(self.model.apply, self.tx, self.mesh)
+        self.eval_step = dp.build_eval_step(self.model.apply, self.mesh)
+
+        self.ckpt = CheckpointManager(cfg.log_dir, save_interval_secs=cfg.save_model_secs)
+        self.writer = SummaryWriter(cfg.log_dir) if is_chief else None
+
+        # Supervisor parity: init-or-restore from logdir (demo2/train.py:166-176).
+        restored = self.ckpt.restore_latest(self._state_dict())
+        if restored is not None:
+            step, state = restored
+            self._load_state_dict(state)
+            log.info("restored checkpoint at step %d from %s", step, cfg.log_dir)
+
+    # -- state (de)serialization ------------------------------------------------
+
+    def _state_dict(self):
+        return {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "global_step": self.global_step,
+        }
+
+    def _load_state_dict(self, state):
+        self.params = dp.replicate(state["params"], self.mesh)
+        self.opt_state = jax.tree_util.tree_map(
+            lambda a, b: dp.replicate(jnp.asarray(b, a.dtype), self.mesh)
+            if hasattr(a, "dtype")
+            else b,
+            self.opt_state,
+            state["opt_state"],
+        )
+        self.global_step = dp.replicate(jnp.asarray(state["global_step"], jnp.int32), self.mesh)
+
+    # -- eval ------------------------------------------------------------------
+
+    def evaluate(self, dataset: DataSet, max_examples: int | None = None):
+        """Exact full-dataset accuracy/loss via chunked sharded eval."""
+        images, labels = dataset.images, dataset.labels
+        if max_examples is not None:
+            images, labels = images[:max_examples], labels[:max_examples]
+        total_correct = total_loss = 0.0
+        n = images.shape[0]
+        for lo in range(0, n, self.eval_chunk):
+            chunk = {"image": images[lo : lo + self.eval_chunk], "label": labels[lo : lo + self.eval_chunk]}
+            padded, real = dp.pad_to_multiple(chunk, self.mesh_size)
+            batch = dp.shard_batch(padded, self.mesh)
+            correct, loss_sum = self.eval_step(self.params, batch)
+            total_correct += float(correct)
+            total_loss += float(loss_sum)
+        return total_correct / n, total_loss / n
+
+    # -- train -----------------------------------------------------------------
+
+    def train(self, num_steps: int | None = None):
+        cfg = self.cfg
+        num_steps = num_steps if num_steps is not None else cfg.training_steps
+        clock = WallClock()
+        timer = StepTimer()
+        step = int(jax.device_get(self.global_step))
+        while step < num_steps:
+            xs, ys = self.datasets.train.next_batch(self.global_batch)
+            batch = dp.shard_batch({"image": xs, "label": ys}, self.mesh)
+            rng = fold_in_step(self.rng, step)
+            self.params, self.opt_state, self.global_step, metrics = self.train_step(
+                self.params, self.opt_state, self.global_step, batch, rng
+            )
+            timer.tick()
+            step += 1
+            if step % cfg.eval_step_interval == 0 or step == num_steps:
+                test_acc, test_loss = self.evaluate(self.datasets.test)
+                train_acc, _ = self.evaluate(self.datasets.train, max_examples=10000)
+                m = jax.device_get(metrics)
+                log.info(
+                    "step %d: batch loss %.4f, test acc %.4f, train acc %.4f (%.1f steps/s)",
+                    step, float(m["loss"]), test_acc, train_acc, timer.steps_per_sec,
+                )
+                if self.writer:
+                    self.writer.add_scalars(
+                        {
+                            "cross_entropy": float(m["loss"]),
+                            "batch_accuracy": float(m["accuracy"]),
+                            "test_accuracy": test_acc,
+                            "test_loss": test_loss,
+                            "train_accuracy": train_acc,
+                            "steps_per_sec": timer.steps_per_sec,
+                        },
+                        step,
+                    )
+                    # variable_summaries parity (demo1/train.py:15-24) at eval
+                    # cadence, for the fc2 layer weights.
+                    p = jax.device_get(self.params)
+                    variable_summaries(self.writer, "fc2/weights", p["fc2"]["kernel"], step)
+            if self.is_chief:
+                self.ckpt.maybe_save(step, self._state_dict())
+        if self.is_chief:
+            self.ckpt.maybe_save(step, self._state_dict(), force=True)
+            if self.writer:
+                self.writer.flush()
+        train_time = clock.elapsed
+        log.info("Training time: %.2fs (%.1f steps/s)", train_time, timer.steps_per_sec)
+        return {
+            "steps": step,
+            "seconds": train_time,
+            "steps_per_sec": timer.steps_per_sec,
+        }

@@ -1,14 +1,21 @@
-"""Test env: force JAX onto 8 virtual CPU devices BEFORE jax import.
+"""Test env: force JAX onto 8 virtual CPU devices BEFORE any backend init.
 
 This replaces the reference's nonexistent multi-node test story (SURVEY §4):
 sharding/collective code paths are exercised on a single host via
 ``--xla_force_host_platform_device_count=8``.
+
+Note: this environment's sitecustomize force-registers the axon TPU platform
+and overrides ``JAX_PLATFORMS`` from the env, so the override must go through
+``jax.config.update`` (which wins at backend-selection time). XLA_FLAGS is
+read lazily at CPU-client creation, so setting it here is early enough.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,81 @@
+"""Checkpoint/export tests (reference C14 parity: Saver ckpts, Supervisor
+timed autosave + restore, frozen export → inference bundle)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+from distributed_tensorflow_tpu.train import checkpoint as ckpt
+
+
+@pytest.fixture
+def params():
+    model = MnistCNN(compute_dtype=jnp.float32)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+
+
+def _state(params):
+    tx = optax.adam(1e-4)
+    return {
+        "params": params,
+        "opt_state": tx.init(params),
+        "global_step": jnp.asarray(17, jnp.int32),
+    }
+
+
+def test_save_restore_roundtrip(tmp_path, params):
+    mngr = ckpt.CheckpointManager(str(tmp_path / "ck"), save_interval_secs=0)
+    state = _state(params)
+    mngr.save(17, state)
+    assert mngr.latest_step() == 17
+    step, restored = mngr.restore_latest(state)
+    assert step == 17
+    assert int(restored["global_step"]) == 17
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(state["params"]),
+        restored["params"],
+    )
+    mngr.close()
+
+
+def test_timed_autosave_gate(tmp_path, params):
+    mngr = ckpt.CheckpointManager(str(tmp_path / "ck"), save_interval_secs=3600)
+    state = _state(params)
+    assert not mngr.maybe_save(1, state)  # interval not yet elapsed
+    assert mngr.maybe_save(2, state, force=True)
+    mngr._last_save = time.time() - 7200
+    assert mngr.maybe_save(3, state)  # interval elapsed
+    assert mngr.latest_step() == 3
+    mngr.close()
+
+
+def test_keep_n(tmp_path, params):
+    mngr = ckpt.CheckpointManager(str(tmp_path / "ck"), save_interval_secs=0, max_to_keep=2)
+    state = _state(params)
+    for s in (1, 2, 3, 4):
+        mngr.save(s, state)
+    assert mngr.latest_step() == 4
+    assert len(mngr._mngr.all_steps()) <= 2
+    mngr.close()
+
+
+def test_inference_bundle_roundtrip(tmp_path, params):
+    path = str(tmp_path / "model.msgpack")
+    labels_path = str(tmp_path / "labels.txt")
+    ckpt.export_inference_bundle(
+        path, params, labels=["cat", "dog"], labels_path=labels_path, metadata={"model": "M"}
+    )
+    restored, meta = ckpt.load_inference_bundle(path, template=params)
+    assert meta["model"] == "M"
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(params),
+        restored,
+    )
+    assert ckpt.load_labels(labels_path) == ["cat", "dog"]

@@ -1,0 +1,114 @@
+"""SPMD data-parallel tests on the 8-device virtual CPU mesh (reference C6
+parity: this is the multi-worker training story, minus parameter servers)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+from distributed_tensorflow_tpu.parallel import data_parallel as dp
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = MnistCNN(compute_dtype=jnp.float32, dropout_rate=0.0)
+    tx = optax.adam(1e-3)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+    return model, tx, params
+
+
+def _fake_batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.random((n, 784)).astype(np.float32)
+    labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+    return {"image": images, "label": labels}
+
+
+def test_mesh_shapes():
+    assert jax.device_count() == 8
+    mesh = make_mesh()
+    assert dict(mesh.shape) == {"data": 8, "model": 1}
+    mesh2 = make_mesh(model_parallel=2)
+    assert dict(mesh2.shape) == {"data": 4, "model": 2}
+    mesh1 = make_mesh(num_devices=1)
+    assert mesh1.devices.size == 1
+
+
+def test_train_step_runs_and_counts(setup):
+    model, tx, params = setup
+    mesh = make_mesh()
+    step_fn = dp.build_train_step(model.apply, tx, mesh, donate=False)
+    p = dp.replicate(params, mesh)
+    o = dp.replicate(tx.init(params), mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    batch = dp.shard_batch(_fake_batch(64), mesh)
+    p, o, g, metrics = step_fn(p, o, g, batch, jax.random.PRNGKey(0))
+    assert int(jax.device_get(g)) == 1
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_dp_equals_single_device(setup):
+    """8-way sharded gradient step == single-device step on the same global
+    batch: the psum-mean must be exactly a big-batch gradient. Uses SGD so the
+    update is linear in the gradient (an Adam step would amplify float noise
+    through g/(|g|+eps))."""
+    model, _, params = setup
+    tx = optax.sgd(0.1)
+    batch = _fake_batch(64)
+
+    results = {}
+    for ndev in (1, 8):
+        mesh = make_mesh(num_devices=ndev)
+        step_fn = dp.build_train_step(model.apply, tx, mesh, donate=False)
+        p = dp.replicate(params, mesh)
+        o = dp.replicate(tx.init(params), mesh)
+        g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+        sharded = dp.shard_batch(batch, mesh)
+        p, o, g, m = step_fn(p, o, g, sharded, jax.random.PRNGKey(7))
+        results[ndev] = (jax.device_get(p), float(m["loss"]))
+
+    np.testing.assert_allclose(results[1][1], results[8][1], rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+        results[1][0],
+        results[8][0],
+    )
+
+
+def test_eval_step_exact_counts(setup):
+    model, tx, params = setup
+    mesh = make_mesh()
+    eval_fn = dp.build_eval_step(model.apply, mesh)
+    batch = _fake_batch(40)  # not divisible by 8 -> exercises padding/mask
+    padded, n = dp.pad_to_multiple(batch, 8)
+    assert padded["image"].shape[0] == 40  # 40 % 8 == 0 already
+    batch27 = _fake_batch(27)
+    padded27, n27 = dp.pad_to_multiple(batch27, 8)
+    assert padded27["image"].shape[0] == 32 and n27 == 27
+    p = dp.replicate(params, mesh)
+    correct, loss_sum = eval_fn(p, dp.shard_batch(padded27, mesh))
+    # Reference computation on host:
+    logits = model.apply({"params": params}, jnp.asarray(batch27["image"]))
+    host_correct = float(
+        np.sum(np.argmax(np.asarray(logits), -1) == np.argmax(batch27["label"], -1))
+    )
+    np.testing.assert_allclose(float(correct), host_correct)
+    assert 0 <= float(correct) <= 27
+
+
+def test_model_parallel_mesh_train_step(setup):
+    """The ('data','model') 2-D mesh path compiles and matches 1-device
+    results (model axis currently replicates compute; reserved for TP)."""
+    model, tx, params = setup
+    batch = _fake_batch(32)
+    mesh = make_mesh(model_parallel=2)  # 4x2
+    step_fn = dp.build_train_step(model.apply, tx, mesh, donate=False)
+    p = dp.replicate(params, mesh)
+    o = dp.replicate(tx.init(params), mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    p, o, g, m = step_fn(p, o, g, dp.shard_batch(batch, mesh), jax.random.PRNGKey(3))
+    assert np.isfinite(float(m["loss"]))
+    assert int(jax.device_get(g)) == 1

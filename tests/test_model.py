@@ -1,0 +1,61 @@
+"""MNIST convnet tests (reference C2/C3/C4 parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+from distributed_tensorflow_tpu.ops.losses import accuracy, softmax_cross_entropy
+
+
+def test_shapes_and_param_structure():
+    model = MnistCNN(compute_dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 784)))["params"]
+    # Architecture parity: conv 5x5x32, conv 5x5x64, fc 3136->1024, fc 1024->10.
+    assert params["Conv1"]["kernel"].shape == (5, 5, 1, 32)
+    assert params["Conv2"]["kernel"].shape == (5, 5, 32, 64)
+    assert params["fc1"]["kernel"].shape == (7 * 7 * 64, 1024)
+    assert params["fc2"]["kernel"].shape == (1024, 10)
+    logits = model.apply({"params": params}, jnp.zeros((3, 784)))
+    assert logits.shape == (3, 10)
+    assert logits.dtype == jnp.float32
+    # Accepts NHWC input too.
+    logits2 = model.apply({"params": params}, jnp.zeros((3, 28, 28, 1)))
+    np.testing.assert_allclose(logits, logits2, rtol=1e-5)
+
+
+def test_init_statistics_match_reference():
+    # truncated normal sigma=0.1 weights, const 0.1 biases (demo1/train.py:28-34)
+    model = MnistCNN(compute_dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))["params"]
+    w = np.asarray(params["fc1"]["kernel"])
+    assert abs(w.std() - 0.1) < 0.02
+    assert np.abs(w).max() <= 0.2 + 1e-6  # truncated at 2 sigma
+    np.testing.assert_allclose(params["Conv1"]["bias"], 0.1)
+
+
+def test_dropout_active_only_in_train_mode():
+    model = MnistCNN(compute_dtype=jnp.float32, dropout_rate=0.5)
+    x = jnp.ones((4, 784))
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    eval1 = model.apply({"params": params}, x, train=False)
+    eval2 = model.apply({"params": params}, x, train=False)
+    np.testing.assert_array_equal(eval1, eval2)
+    tr1 = model.apply({"params": params}, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)})
+    tr2 = model.apply({"params": params}, x, train=True, rngs={"dropout": jax.random.PRNGKey(2)})
+    assert not np.allclose(tr1, tr2)
+
+
+def test_loss_is_single_softmax():
+    # The reference double-softmaxes (demo1/train.py:123,127); ours must match
+    # the analytic single-softmax CE.
+    logits = jnp.array([[2.0, 0.0, -1.0]])
+    labels = jnp.array([[1.0, 0.0, 0.0]])
+    expected = -np.log(np.exp(2.0) / (np.exp(2.0) + 1.0 + np.exp(-1.0)))
+    np.testing.assert_allclose(softmax_cross_entropy(logits, labels), expected, rtol=1e-6)
+
+
+def test_accuracy():
+    logits = jnp.array([[1.0, 0.0], [0.0, 1.0], [1.0, 0.0]])
+    labels = jnp.array([[1.0, 0.0], [1.0, 0.0], [1.0, 0.0]])
+    np.testing.assert_allclose(accuracy(logits, labels), 2.0 / 3.0)
