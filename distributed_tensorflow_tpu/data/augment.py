@@ -1,0 +1,101 @@
+"""Input distortion pipeline (reference C11, ``retrain1/retrain.py:132-165``).
+
+Reference semantics: JPEG decode → random scale (margin = 1 + crop%, times a
+uniform resize factor up to 1 + scale%) → bilinear resize → random crop to
+299×299 → optional left/right flip → brightness multiply by
+uniform(1−b%, 1+b%).
+
+TPU-first redesign: the reference's dynamic-size resize-then-crop cannot be
+jitted (XLA needs static shapes). The same transform — scale by ``s`` then
+crop a 299² window at a random offset — is expressed as ONE
+``jax.image.scale_and_translate`` with static output shape, jitted and
+vmapped over the batch with explicit per-example PRNG keys (the reference
+relied on TF graph-level randomness). Decode stays on the host (PIL), exactly
+as the reference's distorted path feeds decoded tensors
+(``retrain1/retrain.py:313-314``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from PIL import Image
+
+
+def should_distort_images(
+    flip_left_right: bool, random_crop: int, random_scale: int, random_brightness: int
+) -> bool:
+    """Parity with ``retrain1/retrain.py:132-134``: distortions are enabled
+    iff any distortion flag is nonzero."""
+    return flip_left_right or (random_crop != 0) or (random_scale != 0) or (
+        random_brightness != 0
+    )
+
+
+def load_image(path: str, size: int) -> np.ndarray:
+    """Host-side decode: RGB uint8 resized to (size, size, 3)."""
+    with Image.open(path) as im:
+        im = im.convert("RGB").resize((size, size), Image.BILINEAR)
+        return np.asarray(im, dtype=np.uint8)
+
+
+def _distort_one(
+    key: jax.Array,
+    image: jnp.ndarray,  # (H, W, 3) float32 in [0, 255]
+    flip_left_right: bool,
+    random_crop: int,
+    random_scale: int,
+    random_brightness: int,
+) -> jnp.ndarray:
+    h, w = image.shape[0], image.shape[1]
+    k_scale, k_x, k_y, k_flip, k_bright = jax.random.split(key, 5)
+
+    margin_scale = 1.0 + random_crop / 100.0
+    resize_scale = 1.0 + jax.random.uniform(k_scale) * (random_scale / 100.0)
+    s = margin_scale * resize_scale  # total upscale factor ≥ 1
+
+    # Virtual: resize to (s·h, s·w) then crop (h, w) at uniform offset.
+    # Actual: one bilinear resample with static output shape.
+    max_off_y = (s - 1.0) * h
+    max_off_x = (s - 1.0) * w
+    off_y = jax.random.uniform(k_y) * max_off_y
+    off_x = jax.random.uniform(k_x) * max_off_x
+    out = jax.image.scale_and_translate(
+        image,
+        shape=(h, w, 3),
+        spatial_dims=(0, 1),
+        scale=jnp.array([s, s], jnp.float32),
+        translation=jnp.array([-off_y, -off_x], jnp.float32),
+        method="bilinear",
+    )
+
+    if flip_left_right:
+        out = jnp.where(jax.random.bernoulli(k_flip), out[:, ::-1, :], out)
+
+    if random_brightness != 0:
+        delta = random_brightness / 100.0
+        factor = jax.random.uniform(k_bright, minval=1.0 - delta, maxval=1.0 + delta)
+        out = out * factor
+
+    return jnp.clip(out, 0.0, 255.0)
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def distort_batch(
+    key: jax.Array,
+    images: jnp.ndarray,  # (B, H, W, 3) uint8/float
+    flip_left_right: bool = False,
+    random_crop: int = 0,
+    random_scale: int = 0,
+    random_brightness: int = 0,
+) -> jnp.ndarray:
+    """Vmapped jitted distortion over a batch; returns float32 in [0, 255]."""
+    images = jnp.asarray(images, jnp.float32)
+    keys = jax.random.split(key, images.shape[0])
+    fn = lambda k, im: _distort_one(
+        k, im, flip_left_right, random_crop, random_scale, random_brightness
+    )
+    return jax.vmap(fn)(keys, images)

@@ -1,0 +1,243 @@
+"""Bottleneck feature cache (reference C12, ``retrain1/retrain.py:168-245,
+300-369``).
+
+Each image is pushed through Inception-v3 to its 2048-d penultimate
+("bottleneck") vector and cached on disk as comma-separated floats at
+``bottleneck_dir/<label>/<image>.txt`` — same path scheme and text codec as
+the reference, including corruption recovery (a cache file that fails to
+parse is regenerated, ``retrain1/retrain.py:212-224``).
+
+TPU-first divergence: the reference ran one ``sess.run`` per image
+(``retrain1/retrain.py:229``); here featurization is **batched** through one
+jitted apply — images are decoded host-side, stacked, and pushed through the
+MXU hundreds at a time.
+
+Batch samplers (``retrain1/retrain.py:300-354``):
+  * ``how_many >= 0`` → sample with replacement (uniform label, uniform index)
+  * ``how_many == -1`` → deterministic full sweep of a category
+  * distorted variant bypasses the cache and re-featurizes every time
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.data import images as I
+from distributed_tensorflow_tpu.data.augment import distort_batch, load_image
+from distributed_tensorflow_tpu.models import inception_v3 as iv3
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class FeatureExtractor:
+    """Jitted batched Inception-v3 bottleneck runner."""
+
+    def __init__(self, model: iv3.InceptionV3, variables, image_size: int = iv3.INPUT_SIZE):
+        self.model = model
+        self.variables = variables
+        self.image_size = image_size
+        self._apply = jax.jit(
+            lambda v, x: model.apply(v, iv3.preprocess(x), return_bottleneck=True)
+        )
+
+    def bottlenecks(self, images_u8: np.ndarray) -> np.ndarray:
+        """(B, H, W, 3) uint8/float [0,255] → (B, 2048) float32."""
+        return np.asarray(self._apply(self.variables, jnp.asarray(images_u8)))
+
+    def bottleneck_for_path(self, path: str) -> np.ndarray:
+        return self.bottlenecks(load_image(path, self.image_size)[None])[0]
+
+
+# ---------------------------------------------------------------------------
+# Cache codec (text, comma-separated — reference parity).
+# ---------------------------------------------------------------------------
+
+
+def get_bottleneck_path(
+    image_lists: dict, label_name: str, index: int, bottleneck_dir: str, category: str
+) -> str:
+    """``retrain1/retrain.py:202-204``: image path under bottleneck_dir + '.txt'."""
+    return I.get_image_path(image_lists, label_name, index, bottleneck_dir, category) + ".txt"
+
+
+def write_bottleneck_file(path: str, values: np.ndarray) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write(",".join(str(float(x)) for x in values))
+
+
+def read_bottleneck_file(path: str) -> np.ndarray:
+    """Raises ValueError on corruption (caller regenerates)."""
+    with open(path) as fh:
+        return np.array([float(x) for x in fh.read().split(",")], dtype=np.float32)
+
+
+def get_or_create_bottleneck(
+    extractor: FeatureExtractor,
+    image_lists: dict,
+    label_name: str,
+    index: int,
+    image_dir: str,
+    category: str,
+    bottleneck_dir: str,
+) -> np.ndarray:
+    """Cache-hit read with regenerate-on-corruption (``retrain1/retrain.py:206-232``)."""
+    bpath = get_bottleneck_path(image_lists, label_name, index, bottleneck_dir, category)
+    if not os.path.exists(bpath):
+        ipath = I.get_image_path(image_lists, label_name, index, image_dir, category)
+        write_bottleneck_file(bpath, extractor.bottleneck_for_path(ipath))
+    try:
+        return read_bottleneck_file(bpath)
+    except ValueError:
+        log.warning("invalid bottleneck file %s — regenerating", bpath)
+        ipath = I.get_image_path(image_lists, label_name, index, image_dir, category)
+        values = extractor.bottleneck_for_path(ipath)
+        write_bottleneck_file(bpath, values)
+        return values
+
+
+def cache_bottlenecks(
+    extractor: FeatureExtractor,
+    image_lists: dict,
+    image_dir: str,
+    bottleneck_dir: str,
+    batch_size: int = 64,
+) -> int:
+    """Precompute every missing bottleneck, batched through the TPU (the
+    reference looped one sess.run per image, ``retrain1/retrain.py:168-180``).
+    Returns the number of bottlenecks newly created."""
+    os.makedirs(bottleneck_dir, exist_ok=True)
+    todo: list[tuple[str, str]] = []  # (image path, bottleneck path)
+    for label_name, label_lists in image_lists.items():
+        for category in I.CATEGORIES:
+            for index in range(len(label_lists[category])):
+                bpath = get_bottleneck_path(
+                    image_lists, label_name, index, bottleneck_dir, category
+                )
+                if os.path.exists(bpath):
+                    try:
+                        read_bottleneck_file(bpath)
+                        continue
+                    except ValueError:
+                        log.warning("invalid bottleneck file %s — regenerating", bpath)
+                todo.append(
+                    (I.get_image_path(image_lists, label_name, index, image_dir, category), bpath)
+                )
+    created = 0
+    for lo in range(0, len(todo), batch_size):
+        chunk = todo[lo : lo + batch_size]
+        imgs = np.stack([load_image(p, extractor.image_size) for p, _ in chunk])
+        vecs = extractor.bottlenecks(imgs)
+        for (_, bpath), vec in zip(chunk, vecs):
+            write_bottleneck_file(bpath, vec)
+        created += len(chunk)
+        if created and created % 100 < batch_size:
+            log.info("%d bottleneck files created.", created)
+    return created
+
+
+# ---------------------------------------------------------------------------
+# Batch samplers.
+# ---------------------------------------------------------------------------
+
+
+def get_random_cached_bottlenecks(
+    extractor: FeatureExtractor,
+    image_lists: dict,
+    how_many: int,
+    category: str,
+    bottleneck_dir: str,
+    image_dir: str,
+    rng: np.random.Generator,
+):
+    """→ (bottlenecks (N,2048), one-hot truths (N,K), filenames). Sampling
+    parity with ``retrain1/retrain.py:318-341``: uniform over labels, uniform
+    index with replacement; ``how_many == -1`` sweeps every image."""
+    class_count = len(image_lists)
+    label_names = list(image_lists.keys())
+    bottlenecks, truths, filenames = [], [], []
+    if how_many >= 0:
+        # Robustness divergence: the reference fataled when the sampled label
+        # had no images in the category (retrain1/retrain.py:192) — possible
+        # for small classes since the SHA-1 split gives no per-class
+        # guarantees. Sample only from labels that have images there.
+        eligible = [i for i, n in enumerate(label_names) if image_lists[n][category]]
+        if not eligible:
+            raise ValueError(f"no label has any images in category {category}")
+        for _ in range(how_many):
+            label_index = eligible[int(rng.integers(len(eligible)))]
+            label_name = label_names[label_index]
+            image_index = int(rng.integers(I.MAX_NUM_IMAGES_PER_CLASS + 1))
+            bottlenecks.append(
+                get_or_create_bottleneck(
+                    extractor, image_lists, label_name, image_index, image_dir, category, bottleneck_dir
+                )
+            )
+            truth = np.zeros(class_count, np.float32)
+            truth[label_index] = 1.0
+            truths.append(truth)
+            filenames.append(
+                I.get_image_path(image_lists, label_name, image_index, image_dir, category)
+            )
+    else:
+        for label_index, label_name in enumerate(label_names):
+            for image_index in range(len(image_lists[label_name][category])):
+                bottlenecks.append(
+                    get_or_create_bottleneck(
+                        extractor, image_lists, label_name, image_index, image_dir, category, bottleneck_dir
+                    )
+                )
+                truth = np.zeros(class_count, np.float32)
+                truth[label_index] = 1.0
+                truths.append(truth)
+                filenames.append(
+                    I.get_image_path(image_lists, label_name, image_index, image_dir, category)
+                )
+    return np.stack(bottlenecks), np.stack(truths), filenames
+
+
+def get_random_distorted_bottlenecks(
+    extractor: FeatureExtractor,
+    image_lists: dict,
+    how_many: int,
+    category: str,
+    image_dir: str,
+    rng: np.random.Generator,
+    distort_key: jax.Array,
+    flip_left_right: bool = False,
+    random_crop: int = 0,
+    random_scale: int = 0,
+    random_brightness: int = 0,
+):
+    """Distorted sampler (``retrain1/retrain.py:344-354``): bypasses the
+    cache — images are re-decoded, jit-distorted, and re-featurized each call,
+    batched (the reference ran two sess.runs per image)."""
+    label_names = list(image_lists.keys())
+    class_count = len(label_names)
+    eligible = [i for i, n in enumerate(label_names) if image_lists[n][category]]
+    if not eligible:
+        raise ValueError(f"no label has any images in category {category}")
+    imgs, truths = [], []
+    for _ in range(how_many):
+        label_index = eligible[int(rng.integers(len(eligible)))]
+        label_name = label_names[label_index]
+        image_index = int(rng.integers(I.MAX_NUM_IMAGES_PER_CLASS + 1))
+        path = I.get_image_path(image_lists, label_name, image_index, image_dir, category)
+        imgs.append(load_image(path, extractor.image_size))
+        truth = np.zeros(class_count, np.float32)
+        truth[label_index] = 1.0
+        truths.append(truth)
+    batch = distort_batch(
+        distort_key,
+        np.stack(imgs),
+        flip_left_right,
+        random_crop,
+        random_scale,
+        random_brightness,
+    )
+    return extractor.bottlenecks(np.asarray(batch)), np.stack(truths)

@@ -23,6 +23,23 @@ _RESAMPLE = getattr(Image, "LANCZOS", getattr(Image, "Resampling", Image).LANCZO
 _IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif")
 
 
+def iter_image_files(imgs_dir: str):
+    """Yield image-file paths under ``imgs_dir`` (sorted walk, non-image files
+    skipped). Shared by every classifier CLI."""
+    for root, _, files in os.walk(imgs_dir):
+        for fname in sorted(files):
+            if fname.lower().endswith(_IMAGE_EXTS):
+                yield os.path.join(root, fname)
+
+
+def show_image(path: str, title: str) -> None:
+    import matplotlib.pyplot as plt
+
+    plt.imshow(Image.open(path))
+    plt.title(title)
+    plt.show()
+
+
 def classify_digit_images(predict_fn, imgs_dir: str, show: bool = False) -> dict[str, int]:
     """Walk ``imgs_dir``, preprocess each image via :func:`imageprepare`, call
     ``predict_fn((1, 784) array) -> digit``, print and collect results.
@@ -31,20 +48,12 @@ def classify_digit_images(predict_fn, imgs_dir: str, show: bool = False) -> dict
     byte-identically across ``demo1/test.py`` and ``demo2/test.py``).
     Non-image files are skipped instead of crashing the walk."""
     results: dict[str, int] = {}
-    for root, _, files in os.walk(imgs_dir):
-        for fname in sorted(files):
-            if not fname.lower().endswith(_IMAGE_EXTS):
-                continue
-            path = os.path.join(root, fname)
-            digit = int(predict_fn(imageprepare(path)[None, :]))
-            results[path] = digit
-            print(f"{path}: the predicted digit is {digit}")
-            if show:
-                import matplotlib.pyplot as plt
-
-                plt.imshow(Image.open(path))
-                plt.title(f"predicted: {digit}")
-                plt.show()
+    for path in iter_image_files(imgs_dir):
+        digit = int(predict_fn(imageprepare(path)[None, :]))
+        results[path] = digit
+        print(f"{path}: the predicted digit is {digit}")
+        if show:
+            show_image(path, f"predicted: {digit}")
     return results
 
 

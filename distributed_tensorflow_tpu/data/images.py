@@ -1,0 +1,103 @@
+"""Image-folder dataset: deterministic train/test/validation split.
+
+Parity with the reference's ``create_image_lists`` / ``get_image_path``
+(``retrain1/retrain.py:78-128,184-199``): one subfolder per class (jpg/jpeg),
+label = folder name lowercased with non-alphanumerics collapsed to spaces,
+and a **stable per-file split** decided by SHA-1 of the file's path (with any
+``_nohash_`` suffix stripped) mod 2²⁷-1 scaled to a percentage — so a given
+image always lands in the same split as the dataset grows.
+
+Faithful quirk kept: the hash covers the full joined path exactly as the
+reference computes it (``hash_name = re.sub(r'_nohash_.*$', '', file_name)``
+on the glob result, retrain1/retrain.py:111), not just the basename — byte-
+for-byte split parity with reference runs on the same ``--image_dir`` string.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import re
+
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+MAX_NUM_IMAGES_PER_CLASS = 2**27 - 1  # retrain1/retrain.py:36
+CATEGORIES = ("training", "testing", "validation")
+_EXTENSIONS = ("jpg", "jpeg", "JPG", "JPEG")
+
+
+def split_percentage_hash(file_path: str) -> float:
+    """The reference's deterministic split statistic for one file path."""
+    hash_name = re.sub(r"_nohash_.*$", "", file_path)
+    hashed = hashlib.sha1(hash_name.encode("utf-8")).hexdigest()
+    return (int(hashed, 16) % (MAX_NUM_IMAGES_PER_CLASS + 1)) * (
+        100.0 / MAX_NUM_IMAGES_PER_CLASS
+    )
+
+
+def create_image_lists(
+    image_dir: str, testing_percentage: float, validation_percentage: float
+) -> dict | None:
+    """→ ``{label: {dir, training: [...], testing: [...], validation: [...]}}``."""
+    if not os.path.isdir(image_dir):
+        log.error("Image directory '%s' not found.", image_dir)
+        return None
+    result = {}
+    sub_dirs = sorted(
+        d for d in os.listdir(image_dir) if os.path.isdir(os.path.join(image_dir, d))
+    )
+    for dir_name in sub_dirs:
+        file_list: list[str] = []
+        for extension in _EXTENSIONS:
+            file_list.extend(
+                glob.glob(os.path.join(image_dir, dir_name, "*." + extension))
+            )
+        if not file_list:
+            log.warning("No files found in '%s'", dir_name)
+            continue
+        if len(file_list) < 20:
+            log.warning(
+                "Folder '%s' has less than 20 images, which may cause issues.", dir_name
+            )
+        elif len(file_list) > MAX_NUM_IMAGES_PER_CLASS:
+            log.warning(
+                "Folder '%s' has more than %d images; some will never be selected.",
+                dir_name,
+                MAX_NUM_IMAGES_PER_CLASS,
+            )
+        label_name = re.sub(r"[^a-z0-9]+", " ", dir_name.lower())
+        buckets: dict[str, list[str]] = {c: [] for c in CATEGORIES}
+        for file_name in file_list:
+            p = split_percentage_hash(file_name)
+            if p < validation_percentage:
+                buckets["validation"].append(os.path.basename(file_name))
+            elif p < testing_percentage + validation_percentage:
+                buckets["testing"].append(os.path.basename(file_name))
+            else:
+                buckets["training"].append(os.path.basename(file_name))
+        result[label_name] = {"dir": dir_name, **buckets}
+    return result
+
+
+def get_image_path(
+    image_lists: dict, label_name: str, index: int, image_dir: str, category: str
+) -> str:
+    """Path of the ``index``-th (mod list length) image of a label/category
+    (``retrain1/retrain.py:184-199``)."""
+    if label_name not in image_lists:
+        raise KeyError(f"Label does not exist: {label_name}")
+    label_lists = image_lists[label_name]
+    if category not in label_lists:
+        raise KeyError(f"Category does not exist: {category}")
+    category_list = label_lists[category]
+    if not category_list:
+        raise ValueError(f"Label {label_name} has no images in category {category}")
+    base_name = category_list[index % len(category_list)]
+    return os.path.join(image_dir, label_lists["dir"], base_name)
+
+
+def count_images(image_lists: dict, category: str) -> int:
+    return sum(len(v[category]) for v in image_lists.values())

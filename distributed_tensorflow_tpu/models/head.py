@@ -1,0 +1,36 @@
+"""Final training ops — the retrain head (reference
+``add_final_training_ops``, ``retrain1/retrain.py:262-297``): a single new
+trainable layer 2048 → K with truncated-normal σ=0.001 weights and zero
+biases, trained with plain gradient descent while the Inception trunk stays
+frozen.
+
+The reference's ``placeholder_with_default`` trick (``:264-266`` — cached
+bottlenecks can be fed *or* live Inception output flows in) needs no analog:
+the head is a pure function of bottleneck vectors wherever they come from.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckHead(nn.Module):
+    """Linear softmax classifier over 2048-d bottlenecks. Returns logits."""
+
+    num_classes: int
+    compute_dtype: jnp.dtype = jnp.float32  # K×2048 matmul — f32 is free here
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        del train  # no dropout in the reference head
+        x = jnp.asarray(x, self.compute_dtype)
+        logits = nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.truncated_normal(stddev=0.001),
+            bias_init=nn.initializers.zeros,
+            dtype=self.compute_dtype,
+            param_dtype=jnp.float32,
+            name="final",
+        )(x)
+        return logits.astype(jnp.float32)

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 
 def make_mesh(
@@ -42,14 +42,3 @@ def make_mesh(
     return Mesh(arr, axis_names=("data", "model"))
 
 
-def data_sharding(mesh: Mesh) -> NamedSharding:
-    """Batch-leading sharding: dim 0 split over 'data', rest replicated."""
-    return NamedSharding(mesh, P("data"))
-
-
-def replicated_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
-
-
-def local_data_size(mesh: Mesh) -> int:
-    return mesh.shape["data"]

@@ -1,0 +1,287 @@
+"""Transfer-learning trainer — the retrain1/retrain2 workload, TPU-native
+(reference C15/C16: ``retrain1/retrain.py:372-476``,
+``retrain2/retrain2.py:366-508``).
+
+Pipeline parity:
+  1. wipe + recreate the summaries dir (``:374-376``)
+  2. build the Inception-v3 feature extractor (frozen trunk; the reference
+     downloaded+imported a frozen GraphDef — here weights load from
+     ``--model_dir`` if a converted bundle exists, else random init)
+  3. ``create_image_lists`` deterministic split; abort on <2 classes
+     (``:388-394``)
+  4. cache all bottlenecks up front on the non-distorted path (``:417-418``),
+     batched through the TPU
+  5. per step: sample a train batch (cached or freshly-distorted), one
+     gradient-descent step on the head; every ``eval_step_interval`` evaluate
+     a validation batch (``:424-457``)
+  6. final full test-set eval, optional misclassified-image listing (the
+     reference parsed ``--print_misclassified_test_images`` but never used
+     it — implemented here), export params bundle + labels file (``:459-475``)
+
+Distributed (retrain2) divergences, both documented improvements: head
+training is synchronous SPMD over the mesh instead of async PS; bottleneck
+caching is **sharded across processes** by index stride instead of every
+worker duplicating the full cache pass (``retrain2/retrain2.py:437-438``).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_tensorflow_tpu.config import RetrainConfig
+from distributed_tensorflow_tpu.data import bottleneck as B
+from distributed_tensorflow_tpu.data import images as I
+from distributed_tensorflow_tpu.data.augment import should_distort_images
+from distributed_tensorflow_tpu.models import inception_v3 as iv3
+from distributed_tensorflow_tpu.models.head import BottleneckHead
+from distributed_tensorflow_tpu.parallel import data_parallel as dp
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_tpu.train.checkpoint import export_inference_bundle
+from distributed_tensorflow_tpu.utils.logging import get_logger
+from distributed_tensorflow_tpu.utils.prng import fold_in_step
+from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+from distributed_tensorflow_tpu.utils.timer import WallClock
+
+log = get_logger(__name__)
+
+
+def build_extractor(cfg: RetrainConfig, image_size: int = iv3.INPUT_SIZE):
+    """Feature extractor with weights from ``--model_dir`` when a converted
+    bundle is present (``inception_v3.msgpack`` / ``.npz``), else random init
+    (this environment cannot download the 2015 .pb — no egress)."""
+    model = iv3.create_model()
+    for name in ("inception_v3.msgpack", "inception_v3.npz"):
+        path = os.path.join(cfg.model_dir, name)
+        if os.path.exists(path):
+            log.info("loading Inception-v3 weights from %s", path)
+            variables = iv3.load_pretrained(path, model, image_size=image_size)
+            return B.FeatureExtractor(model, variables, image_size)
+    log.warning(
+        "no Inception-v3 weights found under %s — using random init "
+        "(features are untrained but the full pipeline is exercised)",
+        cfg.model_dir,
+    )
+    variables = iv3.init_params(model, seed=0, image_size=image_size)
+    return B.FeatureExtractor(model, variables, image_size)
+
+
+class RetrainTrainer:
+    def __init__(
+        self,
+        cfg: RetrainConfig,
+        mesh=None,
+        extractor: B.FeatureExtractor | None = None,
+        is_chief: bool = True,
+        process_index: int = 0,
+        process_count: int = 1,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(num_devices=1)
+        self.mesh_size = self.mesh.devices.size
+        self.is_chief = is_chief
+        self.process_index = process_index
+        self.process_count = process_count
+
+        # 1. summaries dir wipe (chief only — the reference's per-worker wipe
+        # raced, retrain2/retrain2.py:368-372).
+        if is_chief and os.path.isdir(cfg.summaries_dir):
+            shutil.rmtree(cfg.summaries_dir)
+        os.makedirs(cfg.summaries_dir, exist_ok=True)
+
+        # 2. feature extractor.
+        self.extractor = extractor or build_extractor(cfg)
+
+        # 3. dataset split.
+        self.image_lists = I.create_image_lists(
+            cfg.image_dir, cfg.testing_percentage, cfg.validation_percentage
+        )
+        class_count = len(self.image_lists) if self.image_lists else 0
+        if class_count == 0:
+            raise ValueError(f"No valid folders of images found at {cfg.image_dir}")
+        if class_count == 1:
+            raise ValueError(
+                f"Only one valid folder of images found at {cfg.image_dir} — "
+                "multiple classes are needed for classification."
+            )
+        self.class_count = class_count
+        self.do_distort = should_distort_images(
+            cfg.flip_left_right, cfg.random_crop, cfg.random_scale, cfg.random_brightness
+        )
+
+        # Head model + optimizer (GD at cfg.learning_rate, retrain parity).
+        self.head = BottleneckHead(num_classes=class_count)
+        self.tx = optax.sgd(cfg.learning_rate)
+        params = self.head.init(
+            jax.random.PRNGKey(cfg.seed), jnp.zeros((1, iv3.BOTTLENECK_SIZE), jnp.float32)
+        )["params"]
+        self.params = dp.replicate(params, self.mesh)
+        self.opt_state = dp.replicate(self.tx.init(params), self.mesh)
+        self.global_step = dp.replicate(jnp.zeros((), jnp.int32), self.mesh)
+        self.train_step = dp.build_train_step(self._head_apply, self.tx, self.mesh)
+        self.eval_step = dp.build_eval_step(self._head_apply, self.mesh)
+
+        self.rng = np.random.default_rng(cfg.seed)
+        self.distort_key = jax.random.PRNGKey(cfg.seed + 1)
+        self.step_rng = jax.random.PRNGKey(cfg.seed + 2)
+
+        self.train_writer = SummaryWriter(os.path.join(cfg.summaries_dir, "train")) if is_chief else None
+        self.val_writer = SummaryWriter(os.path.join(cfg.summaries_dir, "validation")) if is_chief else None
+
+    def _head_apply(self, variables, x, train=False, rngs=None):
+        del rngs
+        return self.head.apply(variables, x, train=train)
+
+    # ------------------------------------------------------------------
+
+    def cache_all_bottlenecks(self) -> int:
+        """Step 4 — skipped when distorting (cache is bypassed then, parity
+        with ``retrain1/retrain.py:414-418``). Multi-process: each process
+        caches a stride-slice of the work (divergence from the reference's
+        per-worker full duplication)."""
+        if self.do_distort:
+            return 0
+        if self.process_count == 1:
+            return B.cache_bottlenecks(
+                self.extractor, self.image_lists, self.cfg.image_dir, self.cfg.bottleneck_dir
+            )
+        # Stride-sharded caching: process p takes labels p, p+P, p+2P, ...
+        labels = sorted(self.image_lists.keys())
+        mine = {k: self.image_lists[k] for k in labels[self.process_index :: self.process_count]}
+        created = B.cache_bottlenecks(
+            self.extractor, mine, self.cfg.image_dir, self.cfg.bottleneck_dir
+        )
+        from distributed_tensorflow_tpu.parallel.distributed import barrier
+
+        barrier("bottleneck_cache")
+        return created
+
+    def _sample(self, how_many: int, category: str):
+        cfg = self.cfg
+        if self.do_distort and category == "training":
+            b, t = B.get_random_distorted_bottlenecks(
+                self.extractor,
+                self.image_lists,
+                how_many,
+                category,
+                cfg.image_dir,
+                self.rng,
+                self._next_distort_key(),
+                cfg.flip_left_right,
+                cfg.random_crop,
+                cfg.random_scale,
+                cfg.random_brightness,
+            )
+            return b, t, []
+        return B.get_random_cached_bottlenecks(
+            self.extractor, self.image_lists, how_many, category,
+            cfg.bottleneck_dir, cfg.image_dir, self.rng,
+        )
+
+    def _next_distort_key(self):
+        self.distort_key, sub = jax.random.split(self.distort_key)
+        return sub
+
+    def _eval_batch(self, bottlenecks, truths):
+        padded, n = dp.pad_to_multiple(
+            {"image": bottlenecks, "label": truths}, self.mesh_size
+        )
+        correct, loss_sum = self.eval_step(self.params, dp.shard_batch(padded, self.mesh))
+        return float(correct) / n, float(loss_sum) / n
+
+    # ------------------------------------------------------------------
+
+    def train(self):
+        cfg = self.cfg
+        clock = WallClock()
+        created = self.cache_all_bottlenecks()
+        if created:
+            log.info("cached %d bottlenecks in %.1fs", created, clock.elapsed)
+
+        # Round the train batch up to a mesh multiple (sampling is
+        # with-replacement, so a slightly larger batch is semantically clean;
+        # padding with zero-label rows would instead skew the loss mean).
+        train_bs = -(-cfg.train_batch_size // self.mesh_size) * self.mesh_size
+
+        step = int(jax.device_get(self.global_step))
+        while step < cfg.training_steps:
+            bottlenecks, truths, _ = self._sample(train_bs, "training")
+            batch = dp.shard_batch({"image": bottlenecks, "label": truths}, self.mesh)
+            rng = fold_in_step(self.step_rng, step)
+            self.params, self.opt_state, self.global_step, metrics = self.train_step(
+                self.params, self.opt_state, self.global_step, batch, rng
+            )
+            step += 1
+            is_last = step == cfg.training_steps
+            if step % cfg.eval_step_interval == 0 or is_last:
+                m = jax.device_get(metrics)
+                train_acc, train_ce = float(m["accuracy"]), float(m["loss"])
+                vb, vt, _ = self._sample(cfg.validation_batch_size, "validation")
+                val_acc, val_ce = self._eval_batch(vb, vt)
+                log.info(
+                    "%s: Step %d: Train accuracy = %.1f%%  Cross entropy = %f  "
+                    "Validation accuracy = %.1f%%",
+                    time.strftime("%Y-%m-%d %H:%M:%S"), step,
+                    train_acc * 100, train_ce, val_acc * 100,
+                )
+                if self.train_writer:
+                    self.train_writer.add_scalars(
+                        {"accuracy": train_acc, "cross_entropy": train_ce}, step
+                    )
+                    self.val_writer.add_scalars(
+                        {"accuracy": val_acc, "cross_entropy": val_ce}, step
+                    )
+        train_time = clock.elapsed
+        log.info("Training time: %.2fs", train_time)
+
+        # Final full test eval (test_batch_size default -1 = whole set).
+        tb, tt, tfiles = self._sample(cfg.test_batch_size, "testing")
+        test_acc, _ = self._eval_batch(tb, tt)
+        log.info("Final test accuracy = %.1f%% (N=%d)", test_acc * 100, len(tb))
+        if cfg.print_misclassified_test_images:
+            self._print_misclassified(tb, tt, tfiles)
+
+        if self.is_chief:
+            self.export()
+        if self.train_writer:
+            self.train_writer.close()
+            self.val_writer.close()
+        return {"test_accuracy": test_acc, "seconds": train_time, "steps": step}
+
+    def _print_misclassified(self, bottlenecks, truths, filenames):
+        """``--print_misclassified_test_images`` — parsed-but-dead in the
+        reference (SURVEY §7 defect list); functional here."""
+        logits = np.asarray(
+            self.head.apply({"params": jax.device_get(self.params)}, jnp.asarray(bottlenecks))
+        )
+        preds = logits.argmax(-1)
+        labels = np.asarray(truths).argmax(-1)
+        label_names = list(self.image_lists.keys())
+        log.info("=== MISCLASSIFIED TEST IMAGES ===")
+        for fname, p, t in zip(filenames, preds, labels):
+            if p != t:
+                log.info("%s: predicted %s, true %s", fname, label_names[p], label_names[t])
+
+    def export(self):
+        """Params bundle + labels txt (frozen-graph export parity,
+        ``retrain1/retrain.py:470-475``)."""
+        cfg = self.cfg
+        export_inference_bundle(
+            cfg.output_graph,
+            jax.device_get(self.params),
+            labels=list(self.image_lists.keys()),
+            labels_path=cfg.output_labels,
+            metadata={
+                "model": "BottleneckHead",
+                "num_classes": self.class_count,
+                "final_tensor_name": cfg.final_tensor_name,
+                "bottleneck_size": iv3.BOTTLENECK_SIZE,
+            },
+        )
+        log.info("exported %s and %s", cfg.output_graph, cfg.output_labels)

@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Inception-v3 transfer learning — TPU-native counterpart of the reference's
+``retrain1/retrain.py``: train a new softmax head on 2048-d bottleneck
+features over a directory-of-folders image dataset, with deterministic
+SHA-1 splits, disk bottleneck caching, optional input distortions, periodic
+validation, final test eval, and params+labels export.
+
+Flag names/defaults match the reference (``retrain1/retrain.py:480-632``).
+Divergence: ``--model_dir`` holds converted Inception weights
+(``inception_v3.msgpack``/``.npz``) instead of the downloaded 2015 ``.pb`` —
+this environment has no network egress (the reference's
+``maybe_download_and_extract`` cannot run); random-init features are used
+when no weights are present."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from distributed_tensorflow_tpu.config import RetrainConfig, parse_flags
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_tpu.train.retrain_loop import RetrainTrainer
+from distributed_tensorflow_tpu.utils.logging import get_logger
+from distributed_tensorflow_tpu.utils.timer import WallClock
+
+
+def main(argv=None):
+    log = get_logger("retrain1")
+    clock = WallClock()
+    cfg = parse_flags(RetrainConfig, argv=argv)
+    trainer = RetrainTrainer(cfg, mesh=make_mesh(num_devices=1))
+    stats = trainer.train()
+    log.info("Total time: %.2fs", clock.elapsed)
+    return stats
+
+
+if __name__ == "__main__":
+    main()

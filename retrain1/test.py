@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Retrained-model classifier — TPU-native counterpart of the reference's
+``retrain1/test.py``: load the exported labels + head bundle, run every image
+in ``imgs/`` through Inception-v3 → head, and print ALL class scores sorted
+descending plus a final verdict per image (``retrain1/test.py:44-58``).
+
+One jitted pipeline serves all images (the reference kept one Session but
+fed images one at a time)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.data.augment import load_image
+from distributed_tensorflow_tpu.data.digit import iter_image_files, show_image
+from distributed_tensorflow_tpu.models import inception_v3 as iv3
+from distributed_tensorflow_tpu.models.head import BottleneckHead
+from distributed_tensorflow_tpu.train import retrain_loop
+from distributed_tensorflow_tpu.train.checkpoint import load_inference_bundle, load_labels
+from distributed_tensorflow_tpu.config import RetrainConfig
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--graph", default="retrained_graph.msgpack", help="head bundle")
+    parser.add_argument("--labels", default="retrained_labels.txt")
+    parser.add_argument("--imgs_dir", default="imgs/")
+    parser.add_argument("--model_dir", default="./inception_model")
+    parser.add_argument("--show", action="store_true")
+    args, _ = parser.parse_known_args(argv)
+
+    labels = load_labels(args.labels)  # id → name map, retrain1/test.py:10-16
+    head = BottleneckHead(num_classes=len(labels))
+    template = head.init(jax.random.PRNGKey(0), jnp.zeros((1, iv3.BOTTLENECK_SIZE)))["params"]
+    head_params, _ = load_inference_bundle(args.graph, template=template)
+
+    extractor = retrain_loop.build_extractor(RetrainConfig(model_dir=args.model_dir))
+
+    @jax.jit
+    def scores_fn(hp, bottlenecks):
+        return jax.nn.softmax(head.apply({"params": hp}, bottlenecks), -1)
+
+    # Featurize every image in ONE batched Inception pass (the reference fed
+    # images one sess.run at a time, retrain1/test.py:38-39).
+    paths = list(iter_image_files(args.imgs_dir))
+    if not paths:
+        print(f"no images found under {args.imgs_dir}")
+        return {}
+    imgs = np.stack([load_image(p, extractor.image_size) for p in paths])
+    all_scores = np.asarray(scores_fn(head_params, extractor.bottlenecks(imgs)))
+
+    results = {}
+    for path, scores in zip(paths, all_scores):
+        order = scores.argsort()[::-1]
+        print(path)
+        for idx in order:
+            print(f"  {labels[idx]} (score = {scores[idx]:.5f})")
+        verdict = labels[order[0]]
+        print(f"  => {verdict}")
+        results[path] = verdict
+        if args.show:
+            show_image(path, verdict)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Distributed Inception-v3 transfer learning — TPU-native counterpart of the
+reference's ``retrain2/retrain2.py`` (PS/worker head training over gRPC,
+``retrain2/retrain2.py:366-508``).
+
+Divergences (deliberate, documented in SURVEY §2.2 / train/retrain_loop.py):
+  * synchronous SPMD head training over the device mesh instead of async
+    parameter-server updates (``--training_steps`` default 2000, parity);
+  * bottleneck caching is stride-sharded across processes with a barrier,
+    instead of every worker duplicating the entire cache pass
+    (``retrain2/retrain2.py:437-438``);
+  * chief (task 0) owns the summaries wipe and the final export, as
+    ``Supervisor(is_chief=...)`` did (``:423-429,501-507``)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+
+from distributed_tensorflow_tpu.config import ClusterConfig, DistributedRetrainConfig, parse_flags
+from distributed_tensorflow_tpu.parallel import distributed
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_tpu.train.retrain_loop import RetrainTrainer
+from distributed_tensorflow_tpu.utils.logging import get_logger
+from distributed_tensorflow_tpu.utils.timer import WallClock
+
+
+def main(argv=None):
+    log = get_logger("retrain2")
+    clock = WallClock()
+    cfg, cluster = parse_flags(DistributedRetrainConfig, ClusterConfig, argv=argv)
+    if not distributed.initialize_from_cluster(cluster):
+        return None  # ps role: nothing to do on TPU
+    mesh = make_mesh()
+    trainer = RetrainTrainer(
+        cfg,
+        mesh=mesh,
+        is_chief=distributed.is_chief(),
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+    log.info("retraining over %d devices (mesh %s)", mesh.devices.size, dict(mesh.shape))
+    stats = trainer.train()
+    log.info("Total time: %.2fs", clock.elapsed)
+    return stats
+
+
+if __name__ == "__main__":
+    main()

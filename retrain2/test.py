@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Retrained-model classifier (distributed variant) — counterpart of the
+reference's ``retrain2/test.py``, which is byte-identical to
+``retrain1/test.py``; this wrapper reuses that CLI instead of duplicating it."""
+
+import importlib.util
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_here, ".."))
+
+_spec = importlib.util.spec_from_file_location(
+    "retrain1_test", os.path.join(_here, "..", "retrain1", "test.py")
+)
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+main = _mod.main
+
+if __name__ == "__main__":
+    main()

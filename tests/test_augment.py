@@ -1,0 +1,48 @@
+"""Distortion-pipeline tests (reference C11 parity, explicit-PRNG JAX version)."""
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu.data import augment as A
+
+
+def test_should_distort_flags():
+    # retrain1/retrain.py:132-134 semantics
+    assert not A.should_distort_images(False, 0, 0, 0)
+    assert A.should_distort_images(True, 0, 0, 0)
+    assert A.should_distort_images(False, 10, 0, 0)
+    assert A.should_distort_images(False, 0, 5, 0)
+    assert A.should_distort_images(False, 0, 0, 5)
+
+
+def test_distort_shapes_and_range():
+    imgs = np.random.default_rng(0).integers(0, 255, (4, 64, 64, 3)).astype(np.uint8)
+    out = A.distort_batch(jax.random.PRNGKey(0), imgs, True, 10, 10, 10)
+    assert out.shape == (4, 64, 64, 3)
+    o = np.asarray(out)
+    assert o.min() >= 0.0 and o.max() <= 255.0
+
+
+def test_distort_deterministic_under_key():
+    imgs = np.random.default_rng(0).integers(0, 255, (2, 32, 32, 3)).astype(np.uint8)
+    a = np.asarray(A.distort_batch(jax.random.PRNGKey(5), imgs, True, 20, 20, 20))
+    b = np.asarray(A.distort_batch(jax.random.PRNGKey(5), imgs, True, 20, 20, 20))
+    c = np.asarray(A.distort_batch(jax.random.PRNGKey(6), imgs, True, 20, 20, 20))
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_no_distortion_flags_is_near_identity():
+    imgs = np.random.default_rng(0).integers(0, 255, (2, 32, 32, 3)).astype(np.uint8)
+    out = np.asarray(A.distort_batch(jax.random.PRNGKey(0), imgs, False, 0, 0, 0))
+    # scale==1, offset==0, no flip, no brightness -> exact passthrough
+    np.testing.assert_allclose(out, imgs.astype(np.float32), atol=1e-3)
+
+
+def test_per_example_randomness_differs():
+    img = np.full((1, 32, 32, 3), 128, np.uint8)
+    batch = np.repeat(img, 4, axis=0)
+    out = np.asarray(A.distort_batch(jax.random.PRNGKey(0), batch, False, 0, 0, 50))
+    # Same input image, different per-example brightness factors.
+    means = out.reshape(4, -1).mean(1)
+    assert len(np.unique(np.round(means, 3))) > 1

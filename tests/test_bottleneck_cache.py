@@ -1,0 +1,115 @@
+"""Bottleneck-cache tests (reference C12 parity: text codec, cache hits,
+corruption recovery, samplers). Uses a tiny stand-in extractor so tests stay
+fast — the cache layer only sees the (B,H,W,3)->(B,2048) contract."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distributed_tensorflow_tpu.data import bottleneck as B
+from distributed_tensorflow_tpu.data import images as I
+
+
+class FakeExtractor:
+    """Deterministic stand-in: bottleneck = per-image mean stats projected to
+    2048 dims. Counts calls so cache hits are observable."""
+
+    image_size = 16
+
+    def __init__(self):
+        self.calls = 0
+
+    def bottlenecks(self, imgs):
+        self.calls += 1
+        imgs = np.asarray(imgs, np.float32)
+        base = imgs.reshape(imgs.shape[0], -1).mean(1, keepdims=True)
+        return np.tile(base, (1, 2048)).astype(np.float32)
+
+    def bottleneck_for_path(self, path):
+        from distributed_tensorflow_tpu.data.augment import load_image
+
+        return self.bottlenecks(load_image(path, self.image_size)[None])[0]
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    rng = np.random.default_rng(0)
+    for cls in ("apple", "banana"):
+        d = tmp_path / "data" / cls
+        d.mkdir(parents=True)
+        for i in range(24):
+            arr = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(str(d / f"{cls}{i}.jpg"))
+    lists = I.create_image_lists(str(tmp_path / "data"), 10, 10)
+    return str(tmp_path / "data"), str(tmp_path / "bn"), lists
+
+
+def test_codec_roundtrip(tmp_path):
+    vec = np.random.default_rng(0).random(2048).astype(np.float32)
+    path = str(tmp_path / "a" / "b.txt")
+    B.write_bottleneck_file(path, vec)
+    np.testing.assert_allclose(B.read_bottleneck_file(path), vec, rtol=1e-6)
+
+
+def test_cache_all_and_hit(dataset):
+    image_dir, bn_dir, lists = dataset
+    ex = FakeExtractor()
+    created = B.cache_bottlenecks(ex, lists, image_dir, bn_dir)
+    assert created == 48
+    # Second pass: everything cached, no extractor calls.
+    calls_before = ex.calls
+    created2 = B.cache_bottlenecks(ex, lists, image_dir, bn_dir)
+    assert created2 == 0
+    assert ex.calls == calls_before
+
+
+def test_corruption_recovery(dataset):
+    image_dir, bn_dir, lists = dataset
+    ex = FakeExtractor()
+    B.cache_bottlenecks(ex, lists, image_dir, bn_dir)
+    label = next(iter(lists))
+    bpath = B.get_bottleneck_path(lists, label, 0, bn_dir, "training")
+    good = B.read_bottleneck_file(bpath)
+    with open(bpath, "w") as fh:
+        fh.write("garbage,not,floats")
+    recovered = B.get_or_create_bottleneck(
+        ex, lists, label, 0, image_dir, "training", bn_dir
+    )
+    np.testing.assert_allclose(recovered, good, rtol=1e-5)
+    # File was rewritten valid.
+    np.testing.assert_allclose(B.read_bottleneck_file(bpath), good, rtol=1e-5)
+
+
+def test_random_sampler(dataset):
+    image_dir, bn_dir, lists = dataset
+    ex = FakeExtractor()
+    rng = np.random.default_rng(42)
+    b, t, f = B.get_random_cached_bottlenecks(ex, lists, 10, "training", bn_dir, image_dir, rng)
+    assert b.shape == (10, 2048) and t.shape == (10, 2)
+    assert len(f) == 10
+    np.testing.assert_allclose(t.sum(1), 1.0)
+
+
+def test_full_sweep_sampler(dataset):
+    image_dir, bn_dir, lists = dataset
+    ex = FakeExtractor()
+    rng = np.random.default_rng(0)
+    b, t, f = B.get_random_cached_bottlenecks(ex, lists, -1, "testing", bn_dir, image_dir, rng)
+    expected = I.count_images(lists, "testing")
+    assert b.shape == (expected, 2048)
+    assert len(set(f)) == expected  # sweep covers each file exactly once
+
+
+def test_distorted_sampler_bypasses_cache(dataset):
+    import jax
+
+    image_dir, bn_dir, lists = dataset
+    ex = FakeExtractor()
+    b, t = B.get_random_distorted_bottlenecks(
+        ex, lists, 6, "training", image_dir, np.random.default_rng(0),
+        jax.random.PRNGKey(0), True, 10, 10, 10,
+    )
+    assert b.shape == (6, 2048) and t.shape == (6, 2)
+    assert not os.path.exists(bn_dir)  # nothing cached

@@ -1,0 +1,95 @@
+"""Dataset-splitter tests (reference C10 parity: SHA-1 deterministic split)."""
+
+import hashlib
+import os
+import re
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distributed_tensorflow_tpu.data import images as I
+
+
+def _make_dataset(root, classes=("roses", "tulips"), n=30, size=32):
+    rng = np.random.default_rng(0)
+    for cls in classes:
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(n):
+            arr = rng.integers(0, 255, (size, size, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(str(d / f"{cls}_{i}.jpg"))
+    return str(root)
+
+
+def test_split_structure_and_determinism(tmp_path):
+    d = _make_dataset(tmp_path / "data")
+    lists1 = I.create_image_lists(d, 10, 10)
+    lists2 = I.create_image_lists(d, 10, 10)
+    assert set(lists1.keys()) == {"roses", "tulips"}
+    for label in lists1:
+        info = lists1[label]
+        total = len(info["training"]) + len(info["testing"]) + len(info["validation"])
+        assert total == 30
+        assert info["dir"] in ("roses", "tulips")
+        # Deterministic across calls.
+        for cat in I.CATEGORIES:
+            assert sorted(lists1[label][cat]) == sorted(lists2[label][cat])
+    # No file in two categories.
+    for label in lists1:
+        cats = [set(lists1[label][c]) for c in I.CATEGORIES]
+        assert not (cats[0] & cats[1]) and not (cats[0] & cats[2]) and not (cats[1] & cats[2])
+
+
+def test_hash_semantics_match_reference_formula(tmp_path):
+    """Independently recompute the reference's split statistic
+    (retrain1/retrain.py:109-121) for each file and check bucket placement."""
+    d = _make_dataset(tmp_path / "data", classes=("a",), n=40)
+    lists = I.create_image_lists(d, 15, 15)
+    info = lists["a"]
+    for cat, lo, hi in (("validation", 0, 15), ("testing", 15, 30), ("training", 30, 101)):
+        for base in info[cat]:
+            full_path = os.path.join(d, "a", base)
+            hash_name = re.sub(r"_nohash_.*$", "", full_path)
+            h = hashlib.sha1(hash_name.encode()).hexdigest()
+            p = (int(h, 16) % (I.MAX_NUM_IMAGES_PER_CLASS + 1)) * (
+                100.0 / I.MAX_NUM_IMAGES_PER_CLASS
+            )
+            assert lo <= p < hi, f"{base} in {cat} but p={p}"
+
+
+def test_nohash_suffix_groups_together(tmp_path):
+    d = tmp_path / "data" / "x"
+    d.mkdir(parents=True)
+    arr = np.zeros((8, 8, 3), np.uint8)
+    # Files differing only after _nohash_ must land in the same split.
+    for suffix in ("_nohash_0", "_nohash_1", "_nohash_zzz"):
+        Image.fromarray(arr).save(str(d / f"img{suffix}.jpg"))
+    lists = I.create_image_lists(str(tmp_path / "data"), 30, 30)
+    cats_used = [c for c in I.CATEGORIES if lists["x"][c]]
+    assert len(cats_used) == 1
+    assert len(lists["x"][cats_used[0]]) == 3
+
+
+def test_label_normalization(tmp_path):
+    d = tmp_path / "data" / "Fancy-Class_99!"
+    d.mkdir(parents=True)
+    for i in range(3):
+        Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(str(d / f"f{i}.jpg"))
+    lists = I.create_image_lists(str(tmp_path / "data"), 10, 10)
+    assert list(lists.keys()) == ["fancy class 99 "]
+
+
+def test_get_image_path_mod_index(tmp_path):
+    d = _make_dataset(tmp_path / "data", classes=("a",), n=25)
+    lists = I.create_image_lists(d, 10, 10)
+    n_train = len(lists["a"]["training"])
+    p0 = I.get_image_path(lists, "a", 0, d, "training")
+    p_wrap = I.get_image_path(lists, "a", n_train, d, "training")
+    assert p0 == p_wrap  # index wraps mod list length (retrain1/retrain.py:194)
+    with pytest.raises(KeyError):
+        I.get_image_path(lists, "nope", 0, d, "training")
+
+
+def test_missing_dir_returns_none(tmp_path):
+    assert I.create_image_lists(str(tmp_path / "nope"), 10, 10) is None
