@@ -1,0 +1,91 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Metric: MNIST convnet training steps/sec/chip at the reference workload shape
+(batch 100 per chip, the demo1/demo2 hot loop: demo1/train.py:153-163). The
+timed region includes the host input pipeline (next_batch + device_put), i.e.
+it measures the framework end to end, not just the XLA program.
+
+The reference publishes no numbers (BASELINE.md; BASELINE.json "published" is
+empty). ``vs_baseline`` is therefore computed against a documented estimate of
+the reference's own throughput on its 2016-era CPU deployment: TF 1.x, this
+convnet, batch 100, LAN parameter-server — ~20 steps/s is a generous estimate
+(the per-step fwd+bwd is ~330 MFLOP; 2016 desktop CPUs sustained TF1 convnets
+at O(10) steps/s, before gRPC variable round-trips).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import os
+
+REFERENCE_STEPS_PER_SEC_ESTIMATE = 20.0
+BATCH_PER_CHIP = 100
+WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP_STEPS", 10))
+TIMED_STEPS = int(os.environ.get("BENCH_TIMED_STEPS", 300))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.data.mnist import read_data_sets
+    from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+    from distributed_tensorflow_tpu.parallel import data_parallel as dp
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+    from distributed_tensorflow_tpu.utils.prng import fold_in_step
+
+    n_chips = len(jax.devices())
+    mesh = make_mesh()  # all local devices, pure data-parallel
+    datasets = read_data_sets("MNIST_data", one_hot=True, seed=0, synthetic=True)
+
+    model = MnistCNN()  # bf16 compute, f32 params — the TPU path
+    tx = optax.adam(1e-4)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784), jnp.float32))["params"]
+    opt_state = tx.init(params)
+    params = dp.replicate(params, mesh)
+    opt_state = dp.replicate(opt_state, mesh)
+    global_step = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    train_step = dp.build_train_step(model.apply, tx, mesh)
+
+    rng = jax.random.PRNGKey(0)
+    global_batch = BATCH_PER_CHIP * n_chips
+
+    def run_step(step):
+        nonlocal params, opt_state, global_step
+        xs, ys = datasets.train.next_batch(global_batch)
+        batch = dp.shard_batch({"image": xs, "label": ys}, mesh)
+        params, opt_state, global_step, metrics = train_step(
+            params, opt_state, global_step, batch, fold_in_step(rng, step)
+        )
+        return metrics
+
+    for s in range(WARMUP_STEPS):
+        metrics = run_step(s)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for s in range(WARMUP_STEPS, WARMUP_STEPS + TIMED_STEPS):
+        metrics = run_step(s)
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - t0
+
+    steps_per_sec_per_chip = TIMED_STEPS / elapsed  # global batch scales with chips
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_train_steps_per_sec_per_chip_batch100",
+                "value": round(steps_per_sec_per_chip, 2),
+                "unit": "steps/s/chip",
+                "vs_baseline": round(
+                    steps_per_sec_per_chip / REFERENCE_STEPS_PER_SEC_ESTIMATE, 2
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

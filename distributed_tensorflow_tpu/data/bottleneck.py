@@ -66,15 +66,23 @@ def get_bottleneck_path(
 
 
 def write_bottleneck_file(path: str, values: np.ndarray) -> None:
+    """Atomic write (tmp + os.replace): concurrent workers in a shared
+    bottleneck_dir (retrain2) must never expose a torn file to a reader."""
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as fh:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
         fh.write(",".join(str(float(x)) for x in values))
+    os.replace(tmp, path)
 
 
-def read_bottleneck_file(path: str) -> np.ndarray:
-    """Raises ValueError on corruption (caller regenerates)."""
+def read_bottleneck_file(path: str, expected_size: int = iv3.BOTTLENECK_SIZE) -> np.ndarray:
+    """Raises ValueError on corruption (caller regenerates) — including a
+    cleanly-truncated file whose floats all parse but whose length is wrong."""
     with open(path) as fh:
-        return np.array([float(x) for x in fh.read().split(",")], dtype=np.float32)
+        values = np.array([float(x) for x in fh.read().split(",")], dtype=np.float32)
+    if expected_size and values.shape != (expected_size,):
+        raise ValueError(f"{path}: expected {expected_size} floats, got {values.shape}")
+    return values
 
 
 def get_or_create_bottleneck(
@@ -88,17 +96,15 @@ def get_or_create_bottleneck(
 ) -> np.ndarray:
     """Cache-hit read with regenerate-on-corruption (``retrain1/retrain.py:206-232``)."""
     bpath = get_bottleneck_path(image_lists, label_name, index, bottleneck_dir, category)
-    if not os.path.exists(bpath):
-        ipath = I.get_image_path(image_lists, label_name, index, image_dir, category)
-        write_bottleneck_file(bpath, extractor.bottleneck_for_path(ipath))
-    try:
-        return read_bottleneck_file(bpath)
-    except ValueError:
-        log.warning("invalid bottleneck file %s — regenerating", bpath)
-        ipath = I.get_image_path(image_lists, label_name, index, image_dir, category)
-        values = extractor.bottleneck_for_path(ipath)
-        write_bottleneck_file(bpath, values)
-        return values
+    if os.path.exists(bpath):
+        try:
+            return read_bottleneck_file(bpath)
+        except ValueError:
+            log.warning("invalid bottleneck file %s — regenerating", bpath)
+    ipath = I.get_image_path(image_lists, label_name, index, image_dir, category)
+    values = extractor.bottleneck_for_path(ipath)
+    write_bottleneck_file(bpath, values)
+    return values
 
 
 def cache_bottlenecks(
@@ -158,47 +164,58 @@ def get_random_cached_bottlenecks(
     """→ (bottlenecks (N,2048), one-hot truths (N,K), filenames). Sampling
     parity with ``retrain1/retrain.py:318-341``: uniform over labels, uniform
     index with replacement; ``how_many == -1`` sweeps every image."""
-    class_count = len(image_lists)
     label_names = list(image_lists.keys())
+    pairs = _sample_index_pairs(image_lists, how_many, category, rng)
     bottlenecks, truths, filenames = [], [], []
+    for label_index, image_index in pairs:
+        label_name = label_names[label_index]
+        bottlenecks.append(
+            get_or_create_bottleneck(
+                extractor, image_lists, label_name, image_index, image_dir, category, bottleneck_dir
+            )
+        )
+        truths.append(_one_hot(len(label_names), label_index))
+        filenames.append(
+            I.get_image_path(image_lists, label_name, image_index, image_dir, category)
+        )
+    return np.stack(bottlenecks), np.stack(truths), filenames
+
+
+def _one_hot(class_count: int, label_index: int) -> np.ndarray:
+    truth = np.zeros(class_count, np.float32)
+    truth[label_index] = 1.0
+    return truth
+
+
+def _sample_index_pairs(
+    image_lists: dict, how_many: int, category: str, rng: np.random.Generator
+) -> list[tuple[int, int]]:
+    """Shared sampling policy → list of (label_index, image_index).
+
+    ``how_many >= 0``: with replacement, uniform label then uniform index mod
+    list length (``retrain1/retrain.py:322-326``). Robustness divergence: the
+    reference fataled when the sampled label had no images in the category
+    (retrain1/retrain.py:192) — possible for small classes since the SHA-1
+    split gives no per-class guarantees; sample only from labels that do.
+    ``how_many == -1``: deterministic full sweep (``retrain1/retrain.py:333-341``).
+    """
+    label_names = list(image_lists.keys())
     if how_many >= 0:
-        # Robustness divergence: the reference fataled when the sampled label
-        # had no images in the category (retrain1/retrain.py:192) — possible
-        # for small classes since the SHA-1 split gives no per-class
-        # guarantees. Sample only from labels that have images there.
         eligible = [i for i, n in enumerate(label_names) if image_lists[n][category]]
         if not eligible:
             raise ValueError(f"no label has any images in category {category}")
-        for _ in range(how_many):
-            label_index = eligible[int(rng.integers(len(eligible)))]
-            label_name = label_names[label_index]
-            image_index = int(rng.integers(I.MAX_NUM_IMAGES_PER_CLASS + 1))
-            bottlenecks.append(
-                get_or_create_bottleneck(
-                    extractor, image_lists, label_name, image_index, image_dir, category, bottleneck_dir
-                )
+        return [
+            (
+                eligible[int(rng.integers(len(eligible)))],
+                int(rng.integers(I.MAX_NUM_IMAGES_PER_CLASS + 1)),
             )
-            truth = np.zeros(class_count, np.float32)
-            truth[label_index] = 1.0
-            truths.append(truth)
-            filenames.append(
-                I.get_image_path(image_lists, label_name, image_index, image_dir, category)
-            )
-    else:
-        for label_index, label_name in enumerate(label_names):
-            for image_index in range(len(image_lists[label_name][category])):
-                bottlenecks.append(
-                    get_or_create_bottleneck(
-                        extractor, image_lists, label_name, image_index, image_dir, category, bottleneck_dir
-                    )
-                )
-                truth = np.zeros(class_count, np.float32)
-                truth[label_index] = 1.0
-                truths.append(truth)
-                filenames.append(
-                    I.get_image_path(image_lists, label_name, image_index, image_dir, category)
-                )
-    return np.stack(bottlenecks), np.stack(truths), filenames
+            for _ in range(how_many)
+        ]
+    return [
+        (label_index, image_index)
+        for label_index, label_name in enumerate(label_names)
+        for image_index in range(len(image_lists[label_name][category]))
+    ]
 
 
 def get_random_distorted_bottlenecks(
@@ -218,20 +235,13 @@ def get_random_distorted_bottlenecks(
     cache — images are re-decoded, jit-distorted, and re-featurized each call,
     batched (the reference ran two sess.runs per image)."""
     label_names = list(image_lists.keys())
-    class_count = len(label_names)
-    eligible = [i for i, n in enumerate(label_names) if image_lists[n][category]]
-    if not eligible:
-        raise ValueError(f"no label has any images in category {category}")
     imgs, truths = [], []
-    for _ in range(how_many):
-        label_index = eligible[int(rng.integers(len(eligible)))]
-        label_name = label_names[label_index]
-        image_index = int(rng.integers(I.MAX_NUM_IMAGES_PER_CLASS + 1))
-        path = I.get_image_path(image_lists, label_name, image_index, image_dir, category)
+    for label_index, image_index in _sample_index_pairs(image_lists, how_many, category, rng):
+        path = I.get_image_path(
+            image_lists, label_names[label_index], image_index, image_dir, category
+        )
         imgs.append(load_image(path, extractor.image_size))
-        truth = np.zeros(class_count, np.float32)
-        truth[label_index] = 1.0
-        truths.append(truth)
+        truths.append(_one_hot(len(label_names), label_index))
     batch = distort_batch(
         distort_key,
         np.stack(imgs),

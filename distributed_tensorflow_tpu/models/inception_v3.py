@@ -46,7 +46,7 @@ def preprocess(images_u8: jnp.ndarray) -> jnp.ndarray:
 
     Parity with the pb's ``Sub(128) → Mul(2/255)`` input nodes."""
     x = jnp.asarray(images_u8, jnp.float32)
-    return (x - 128.0) / 128.0
+    return (x - 128.0) * (2.0 / 255.0)
 
 
 class ConvBN(nn.Module):

@@ -113,3 +113,29 @@ def test_distorted_sampler_bypasses_cache(dataset):
     )
     assert b.shape == (6, 2048) and t.shape == (6, 2)
     assert not os.path.exists(bn_dir)  # nothing cached
+
+
+def test_truncation_recovery(dataset):
+    """A cleanly-truncated file (all floats parse, wrong length) must be
+    detected by the length check and regenerated, not returned as valid."""
+    image_dir, bn_dir, lists = dataset
+    ex = FakeExtractor()
+    B.cache_bottlenecks(ex, lists, image_dir, bn_dir)
+    label = next(iter(lists))
+    bpath = B.get_bottleneck_path(lists, label, 0, bn_dir, "training")
+    good = B.read_bottleneck_file(bpath)
+    with open(bpath, "w") as fh:
+        fh.write(",".join(str(float(x)) for x in good[:1000]))  # parseable but short
+    recovered = B.get_or_create_bottleneck(
+        ex, lists, label, 0, image_dir, "training", bn_dir
+    )
+    assert recovered.shape == (2048,)
+    np.testing.assert_allclose(recovered, good, rtol=1e-5)
+    np.testing.assert_allclose(B.read_bottleneck_file(bpath), good, rtol=1e-5)
+
+
+def test_atomic_write_no_tmp_residue(tmp_path):
+    vec = np.random.default_rng(1).random(2048).astype(np.float32)
+    path = str(tmp_path / "sub" / "x.txt")
+    B.write_bottleneck_file(path, vec)
+    assert [p.name for p in (tmp_path / "sub").iterdir()] == ["x.txt"]

@@ -37,8 +37,11 @@ def test_logits_head(model_and_vars):
 
 
 def test_preprocess_range():
+    # Parity with the pb's Sub(128) -> Mul(2/255) input nodes.
     x = iv3.preprocess(np.array([[0.0, 128.0, 255.0]]))
-    np.testing.assert_allclose(np.asarray(x), [[-1.0, 0.0, 0.9921875]])
+    np.testing.assert_allclose(
+        np.asarray(x), [[-256.0 / 255.0, 0.0, 254.0 / 255.0]], rtol=1e-6
+    )
 
 
 def test_param_count_is_inception_scale(model_and_vars):
