@@ -65,14 +65,29 @@ def get_bottleneck_path(
     return I.get_image_path(image_lists, label_name, index, bottleneck_dir, category) + ".txt"
 
 
-def write_bottleneck_file(path: str, values: np.ndarray) -> None:
+def write_bottleneck_file(
+    path: str, values: np.ndarray, expected_size: int = iv3.BOTTLENECK_SIZE
+) -> np.ndarray:
     """Atomic write (tmp + os.replace): concurrent workers in a shared
-    bottleneck_dir (retrain2) must never expose a torn file to a reader."""
+    bottleneck_dir (retrain2) must never expose a torn file to a reader.
+
+    Validates the vector length up front (a wrong-size write would otherwise
+    poison the cache: every later read warns and regenerates forever) and
+    returns the **text-codec roundtrip** of ``values`` so a cache-miss caller
+    can return exactly what every cache-hit read will return — cold- and
+    warm-cache runs then consume bit-identical training inputs."""
+    values = np.asarray(values, dtype=np.float32).reshape(-1)
+    if expected_size and values.shape != (expected_size,):
+        raise ValueError(
+            f"refusing to write {path}: expected {expected_size} floats, got {values.shape}"
+        )
     os.makedirs(os.path.dirname(path), exist_ok=True)
+    text = ",".join(str(float(x)) for x in values)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
-        fh.write(",".join(str(float(x)) for x in values))
+        fh.write(text)
     os.replace(tmp, path)
+    return np.array([float(x) for x in text.split(",")], dtype=np.float32)
 
 
 def read_bottleneck_file(path: str, expected_size: int = iv3.BOTTLENECK_SIZE) -> np.ndarray:
@@ -103,8 +118,7 @@ def get_or_create_bottleneck(
             log.warning("invalid bottleneck file %s — regenerating", bpath)
     ipath = I.get_image_path(image_lists, label_name, index, image_dir, category)
     values = extractor.bottleneck_for_path(ipath)
-    write_bottleneck_file(bpath, values)
-    return values
+    return write_bottleneck_file(bpath, values)
 
 
 def cache_bottlenecks(

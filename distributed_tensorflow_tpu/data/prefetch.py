@@ -1,0 +1,140 @@
+"""Asynchronous host→device input prefetching.
+
+The reference's hot loop pays a host round-trip every step: ``feed_dict``
+re-uploads the batch inside ``sess.run`` (``demo1/train.py:153-155``), and in
+the distributed case the worker additionally pulls variables from the ps over
+gRPC (``demo2/train.py:183``). On TPU the equivalent stall is the host-side
+``next_batch`` + ``device_put`` sitting serially in front of each dispatched
+step, leaving the chip idle while Python slices numpy arrays.
+
+:class:`Prefetcher` moves that host work onto a background thread with a small
+bounded queue: batch assembly and the HBM transfer for step *k+depth* overlap
+the device computation of step *k*. Because JAX dispatch is already
+asynchronous, a queue depth of 2 is enough to keep the TPU busy; deeper queues
+only add HBM pressure (each queued batch is resident on device).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+__all__ = ["Prefetcher", "batches_forever", "bounded_device_batches"]
+
+_SENTINEL = object()
+
+
+class Prefetcher:
+    """Iterate ``place_fn(item)`` for items of ``source``, computed ``depth``
+    batches ahead on a daemon thread.
+
+    ``source``    — iterable yielding host-side batches (may be infinite).
+    ``place_fn``  — host→device placement, e.g. ``lambda b: shard_batch(b, mesh)``;
+                    runs on the worker thread so the transfer overlaps compute.
+    ``depth``     — max device-resident batches queued ahead (≥1).
+
+    Exceptions raised by ``source``/``place_fn`` propagate to the consumer at
+    the next ``__next__``. Use as a context manager (or call :meth:`close`) to
+    stop the worker before the source is exhausted.
+    """
+
+    def __init__(
+        self,
+        source: Iterable[Any],
+        place_fn: Callable[[Any], Any] | None = None,
+        depth: int = 2,
+    ):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._place = place_fn if place_fn is not None else (lambda x: x)
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._worker, args=(iter(source),), daemon=True, name="input-prefetch"
+        )
+        self._thread.start()
+
+    def _worker(self, it: Iterator[Any]) -> None:
+        try:
+            for item in it:
+                placed = self._place(item)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(placed, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — propagated to consumer
+            self._error = e
+        # Exhausted (or errored): wake the consumer.
+        while not self._stop.is_set():
+            try:
+                self._q.put(_SENTINEL, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self) -> "Prefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        if self._done:  # sentinel is enqueued once; don't block on a drained queue
+            raise StopIteration
+        item = self._q.get()
+        if item is _SENTINEL:
+            self._done = True
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Stop the worker thread and drop queued batches."""
+        self._done = True  # later __next__ raises StopIteration, never blocks
+        self._stop.set()
+        # Drain so a blocked put() notices the stop flag quickly.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def batches_forever(dataset, batch_size: int) -> Iterator[dict]:
+    """Infinite ``{'image', 'label'}`` batch generator over a
+    :class:`~distributed_tensorflow_tpu.data.mnist.DataSet` (epoch-shuffled
+    ``next_batch`` semantics, ``demo1/train.py:154``)."""
+    while True:
+        xs, ys = dataset.next_batch(batch_size)
+        yield {"image": xs, "label": ys}
+
+
+def bounded_device_batches(dataset, batch_size: int, mesh, num_batches: int, depth: int = 2) -> Prefetcher:
+    """The standard training input pipeline: exactly ``num_batches`` batches
+    from ``dataset``, sharded onto ``mesh`` on a background thread. Bounding
+    the source (rather than closing an infinite one) guarantees the lookahead
+    never pulls batches that get discarded, so a segmented run — train(100)
+    then train(200) after restore — consumes the identical example stream as
+    one uninterrupted run."""
+    import itertools
+
+    from distributed_tensorflow_tpu.parallel import data_parallel as dp
+
+    return Prefetcher(
+        itertools.islice(batches_forever(dataset, batch_size), num_batches),
+        place_fn=lambda b: dp.shard_batch(b, mesh),
+        depth=depth,
+    )

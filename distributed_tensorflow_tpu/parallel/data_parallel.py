@@ -68,11 +68,12 @@ def build_train_step(
     data_axes = ("data", "model")  # batch sharded over both axes when model dim >1
 
     def _shard_step(params, opt_state, global_step, batch, rng):
-        # Distinct dropout noise per shard, same base key per step.
+        # Distinct dropout noise per step (fold in the on-device global step —
+        # no per-step host-side key derivation/dispatch) and per shard.
         shard_id = lax.axis_index(data_axes[0]) * lax.axis_size(data_axes[1]) + lax.axis_index(
             data_axes[1]
         )
-        rng = jax.random.fold_in(rng, shard_id)
+        rng = jax.random.fold_in(jax.random.fold_in(rng, global_step), shard_id)
 
         def compute_loss(p):
             logits = apply_fn(

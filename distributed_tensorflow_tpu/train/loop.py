@@ -24,12 +24,12 @@ import optax
 
 from distributed_tensorflow_tpu.config import MnistTrainConfig
 from distributed_tensorflow_tpu.data.mnist import DataSet, read_data_sets
+from distributed_tensorflow_tpu.data.prefetch import bounded_device_batches
 from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
 from distributed_tensorflow_tpu.parallel import data_parallel as dp
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh
 from distributed_tensorflow_tpu.train.checkpoint import CheckpointManager
 from distributed_tensorflow_tpu.utils.logging import get_logger
-from distributed_tensorflow_tpu.utils.prng import fold_in_step
 from distributed_tensorflow_tpu.utils.summary import SummaryWriter, variable_summaries
 from distributed_tensorflow_tpu.utils.timer import StepTimer, WallClock
 
@@ -139,12 +139,39 @@ class MnistTrainer:
         clock = WallClock()
         timer = StepTimer()
         step = int(jax.device_get(self.global_step))
+        if step < num_steps:
+            # Background input pipeline: batch assembly + HBM transfer overlap
+            # the device step (replaces the reference's serial feed_dict
+            # upload, demo1/train.py:153-155).
+            prefetch = bounded_device_batches(
+                self.datasets.train, self.global_batch, self.mesh, num_steps - step
+            )
+            try:
+                self._train_loop(prefetch, num_steps, step, timer)
+            finally:
+                prefetch.close()
+        step = int(jax.device_get(self.global_step))
+        if self.is_chief:
+            self.ckpt.maybe_save(step, self._state_dict(), force=True)
+            if self.writer:
+                self.writer.flush()
+        train_time = clock.elapsed
+        log.info("Training time: %.2fs (%.1f steps/s)", train_time, timer.steps_per_sec)
+        return {
+            "steps": step,
+            "seconds": train_time,
+            "steps_per_sec": timer.steps_per_sec,
+        }
+
+    def _train_loop(self, prefetch, num_steps: int, step: int, timer: StepTimer) -> None:
+        cfg = self.cfg
         while step < num_steps:
-            xs, ys = self.datasets.train.next_batch(self.global_batch)
-            batch = dp.shard_batch({"image": xs, "label": ys}, self.mesh)
-            rng = fold_in_step(self.rng, step)
+            batch = next(prefetch)
+            # Base key only: the step fold happens on-device inside the jitted
+            # program (keyed on global_step), so the hot loop does zero
+            # per-step host dispatches besides the train step itself.
             self.params, self.opt_state, self.global_step, metrics = self.train_step(
-                self.params, self.opt_state, self.global_step, batch, rng
+                self.params, self.opt_state, self.global_step, batch, self.rng
             )
             timer.tick()
             step += 1
@@ -174,14 +201,3 @@ class MnistTrainer:
                     variable_summaries(self.writer, "fc2/weights", p["fc2"]["kernel"], step)
             if self.is_chief:
                 self.ckpt.maybe_save(step, self._state_dict())
-        if self.is_chief:
-            self.ckpt.maybe_save(step, self._state_dict(), force=True)
-            if self.writer:
-                self.writer.flush()
-        train_time = clock.elapsed
-        log.info("Training time: %.2fs (%.1f steps/s)", train_time, timer.steps_per_sec)
-        return {
-            "steps": step,
-            "seconds": train_time,
-            "steps_per_sec": timer.steps_per_sec,
-        }

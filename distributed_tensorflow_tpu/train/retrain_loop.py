@@ -45,7 +45,6 @@ from distributed_tensorflow_tpu.parallel import data_parallel as dp
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh
 from distributed_tensorflow_tpu.train.checkpoint import export_inference_bundle
 from distributed_tensorflow_tpu.utils.logging import get_logger
-from distributed_tensorflow_tpu.utils.prng import fold_in_step
 from distributed_tensorflow_tpu.utils.summary import SummaryWriter
 from distributed_tensorflow_tpu.utils.timer import WallClock
 
@@ -213,9 +212,10 @@ class RetrainTrainer:
         while step < cfg.training_steps:
             bottlenecks, truths, _ = self._sample(train_bs, "training")
             batch = dp.shard_batch({"image": bottlenecks, "label": truths}, self.mesh)
-            rng = fold_in_step(self.step_rng, step)
+            # Base key only — the per-step fold happens on-device in the jitted
+            # step, keyed on global_step.
             self.params, self.opt_state, self.global_step, metrics = self.train_step(
-                self.params, self.opt_state, self.global_step, batch, rng
+                self.params, self.opt_state, self.global_step, batch, self.step_rng
             )
             step += 1
             is_last = step == cfg.training_steps

@@ -26,8 +26,7 @@ class KeySeq:
         return subs
 
 
-def fold_in_step(key: jax.Array, step: int) -> jax.Array:
-    """Per-step key derivation — stable under checkpoint/resume (the key for
-    step N is a pure function of (base key, N), so resuming mid-run replays
-    identical dropout/augmentation randomness)."""
-    return jax.random.fold_in(key, step)
+# Per-step key derivation happens ON-DEVICE inside the jitted train step
+# (``data_parallel.build_train_step`` folds the replicated global_step into the
+# base key), so keys stay a pure function of (base key, step) — stable under
+# checkpoint/resume — without a host-side dispatch per step.

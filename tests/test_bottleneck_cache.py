@@ -53,6 +53,23 @@ def test_codec_roundtrip(tmp_path):
     np.testing.assert_allclose(B.read_bottleneck_file(path), vec, rtol=1e-6)
 
 
+def test_write_returns_exact_read_value(tmp_path):
+    # Cold-cache (miss) and warm-cache (hit) paths must return bit-identical
+    # vectors, so the write returns the text-codec roundtrip.
+    vec = np.random.default_rng(3).random(2048).astype(np.float32) * 1e-3
+    path = str(tmp_path / "c.txt")
+    returned = B.write_bottleneck_file(path, vec)
+    np.testing.assert_array_equal(returned, B.read_bottleneck_file(path))
+
+
+def test_write_refuses_wrong_size(tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError, match="refusing to write"):
+        B.write_bottleneck_file(str(tmp_path / "d.txt"), np.zeros(7, np.float32))
+    assert not (tmp_path / "d.txt").exists()
+
+
 def test_cache_all_and_hit(dataset):
     image_dir, bn_dir, lists = dataset
     ex = FakeExtractor()
