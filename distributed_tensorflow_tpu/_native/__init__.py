@@ -1,0 +1,149 @@
+"""ctypes loader for the native runtime library (``native.cc``).
+
+Build-on-first-import with an atomic rename (safe under concurrent pytest
+workers / multi-process training); every entry point has a pure-Python
+fallback, so the framework degrades gracefully when no C++ toolchain is
+available (``lib() is None`` then).
+
+Set ``DTF_NATIVE=0`` to force the Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "native.cc")
+_SO = os.path.join(os.path.dirname(__file__), "libdtfnative.so")
+
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_SO))
+        os.close(fd)
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _SO)  # atomic: concurrent builders race benignly
+        return True
+    except (OSError, subprocess.SubprocessError):
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return False
+
+
+def lib() -> ctypes.CDLL | None:
+    """The loaded native library, or None when unavailable/disabled."""
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("DTF_NATIVE", "1") == "0":
+        return None
+    try:
+        stale = not os.path.exists(_SO) or (
+            os.path.exists(_SRC) and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        )
+    except OSError:
+        stale = False  # can't stat — use whatever .so exists
+    if stale and not _build():
+        return None
+    if not os.path.exists(_SO):
+        return None
+    try:
+        cdll = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    cdll.dtf_crc32c.restype = ctypes.c_uint32
+    cdll.dtf_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    cdll.dtf_masked_crc32c.restype = ctypes.c_uint32
+    cdll.dtf_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    cdll.dtf_frame_record.restype = ctypes.c_size_t
+    cdll.dtf_frame_record.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.c_void_p,
+    ]
+    cdll.dtf_parse_csv_floats.restype = ctypes.c_int64
+    cdll.dtf_parse_csv_floats.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    cdll.dtf_format_csv_floats.restype = ctypes.c_int64
+    cdll.dtf_format_csv_floats.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    _lib = cdll
+    return _lib
+
+
+# ---------------------------------------------------------------------------
+# Typed wrappers (native when available, else None — callers keep their
+# pure-Python implementations as the fallback branch).
+# ---------------------------------------------------------------------------
+
+
+def masked_crc32c(data: bytes) -> int | None:
+    l = lib()
+    if l is None:
+        return None
+    return l.dtf_masked_crc32c(data, len(data))
+
+
+def frame_record(data: bytes) -> bytes | None:
+    """One TFRecord frame: u64le(len) crc data crc."""
+    l = lib()
+    if l is None:
+        return None
+    out = ctypes.create_string_buffer(len(data) + 16)
+    n = l.dtf_frame_record(data, len(data), out)
+    return out.raw[:n]
+
+
+def parse_csv_floats(text: bytes, expected_size: int | None = None) -> np.ndarray | None:
+    """Parse comma-separated floats. Returns None if the native lib is
+    unavailable. Raises ValueError on malformed input (parity with the Python
+    codec's corruption signal)."""
+    l = lib()
+    if l is None:
+        return None
+    cap = expected_size if expected_size else max(1, (len(text) + 1) // 2)
+    out = np.empty(cap, dtype=np.float32)
+    n = l.dtf_parse_csv_floats(text, len(text), out.ctypes.data_as(ctypes.c_void_p), cap)
+    if n < 0:
+        raise ValueError("malformed csv float data")
+    return out[:n].copy() if n != cap else out
+
+
+def format_csv_floats(values: np.ndarray) -> bytes | None:
+    l = lib()
+    if l is None:
+        return None
+    arr = np.ascontiguousarray(values, dtype=np.float32).reshape(-1)
+    cap = 24 * max(1, arr.size)
+    out = ctypes.create_string_buffer(cap)
+    n = l.dtf_format_csv_floats(
+        arr.ctypes.data_as(ctypes.c_void_p), arr.size, out, cap
+    )
+    if n < 0:
+        raise RuntimeError("csv format buffer too small")  # cap=24/float can't happen
+    return out.raw[:n]

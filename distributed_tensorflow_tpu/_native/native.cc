@@ -1,0 +1,153 @@
+// Native runtime kernels for distributed_tensorflow_tpu.
+//
+// The reference delegates its host-side runtime to the TensorFlow 1.x C++
+// core: the TFRecord/CRC32C event record writer behind tf.summary.FileWriter
+// (demo1/train.py:151) and the per-step bottleneck cache-file text codec that
+// dominates the retrain hot loop (retrain1/retrain.py:430-438 reads + parses
+// comma-separated float files every training step). This library is the
+// TPU-build's native equivalent of those subsystems, exposed over a plain C
+// ABI and loaded from Python via ctypes (no pybind11 in this environment).
+//
+// Pure-Python fallbacks exist for every entry point; byte-format differences
+// between the two CSV writers are allowed, but parsed float32 values are
+// guaranteed identical (both emit shortest-round-trip decimals).
+
+#include <charconv>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli). Slice-by-8 table software path + SSE4.2 hardware path,
+// selected once at runtime.
+// ---------------------------------------------------------------------------
+
+uint32_t g_table[8][256];
+bool g_tables_ready = false;
+
+void build_tables() {
+  const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = i;
+    for (int k = 0; k < 8; ++k) crc = (crc & 1) ? (crc >> 1) ^ poly : crc >> 1;
+    g_table[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; ++i)
+    for (int s = 1; s < 8; ++s)
+      g_table[s][i] = (g_table[s - 1][i] >> 8) ^ g_table[0][g_table[s - 1][i] & 0xFF];
+  g_tables_ready = true;
+}
+
+uint32_t crc32c_sw(uint32_t crc, const uint8_t* p, size_t n) {
+  if (!g_tables_ready) build_tables();
+  while (n >= 8) {
+    uint64_t word;
+    std::memcpy(&word, p, 8);
+    word ^= crc;  // little-endian: low 4 bytes absorb the running crc
+    crc = g_table[7][word & 0xFF] ^ g_table[6][(word >> 8) & 0xFF] ^
+          g_table[5][(word >> 16) & 0xFF] ^ g_table[4][(word >> 24) & 0xFF] ^
+          g_table[3][(word >> 32) & 0xFF] ^ g_table[2][(word >> 40) & 0xFF] ^
+          g_table[1][(word >> 48) & 0xFF] ^ g_table[0][(word >> 56) & 0xFF];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = g_table[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return crc;
+}
+
+__attribute__((target("sse4.2")))
+uint32_t crc32c_hw(uint32_t crc, const uint8_t* p, size_t n) {
+  uint64_t c = crc;
+  while (n >= 8) {
+    uint64_t word;
+    std::memcpy(&word, p, 8);
+    c = __builtin_ia32_crc32di(c, word);
+    p += 8;
+    n -= 8;
+  }
+  while (n--) c = __builtin_ia32_crc32qi(static_cast<uint32_t>(c), *p++);
+  return static_cast<uint32_t>(c);
+}
+
+uint32_t (*g_crc_impl)(uint32_t, const uint8_t*, size_t) = nullptr;
+
+uint32_t crc32c_dispatch(uint32_t crc, const uint8_t* p, size_t n) {
+  if (!g_crc_impl)
+    g_crc_impl = __builtin_cpu_supports("sse4.2") ? crc32c_hw : crc32c_sw;
+  return g_crc_impl(crc, p, n);
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t dtf_crc32c(const uint8_t* data, size_t len) {
+  return crc32c_dispatch(0xFFFFFFFFu, data, len) ^ 0xFFFFFFFFu;
+}
+
+// TFRecord masking (same scheme as TF's record writer).
+uint32_t dtf_masked_crc32c(const uint8_t* data, size_t len) {
+  uint32_t crc = dtf_crc32c(data, len);
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+// Frame one TFRecord into `out` (caller provides len+16 bytes):
+//   u64le(len) u32le(maskcrc(header)) data u32le(maskcrc(data))
+// Returns bytes written (len + 16).
+size_t dtf_frame_record(const uint8_t* data, size_t len, uint8_t* out) {
+  uint64_t n = len;
+  std::memcpy(out, &n, 8);
+  uint32_t hcrc = dtf_masked_crc32c(out, 8);
+  std::memcpy(out + 8, &hcrc, 4);
+  std::memcpy(out + 12, data, len);
+  uint32_t dcrc = dtf_masked_crc32c(data, len);
+  std::memcpy(out + 12 + len, &dcrc, 4);
+  return len + 16;
+}
+
+// Parse comma-separated floats from buf[0:len] into out (capacity cap).
+// Returns the count parsed, or -1 on malformed input (bad char, empty field,
+// trailing separator) — the Python caller maps -1 to the cache-corruption
+// recovery path. Leading/trailing ASCII whitespace around fields is accepted.
+int64_t dtf_parse_csv_floats(const char* buf, size_t len, float* out, size_t cap) {
+  const char* p = buf;
+  const char* end = buf + len;
+  size_t count = 0;
+  if (p == end) return 0;
+  for (;;) {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+    if (p == end) return -1;  // empty field
+    float value;
+    auto res = std::from_chars(p, end, value);
+    if (res.ec != std::errc()) return -1;
+    p = res.ptr;
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+    if (count >= cap) return -1;
+    out[count++] = value;
+    if (p == end) return static_cast<int64_t>(count);
+    if (*p != ',') return -1;
+    ++p;
+  }
+}
+
+// Format floats as comma-separated shortest-round-trip decimals into out.
+// Returns bytes written, or -1 if cap is too small (caller should size
+// cap >= 16*n). No trailing NUL.
+int64_t dtf_format_csv_floats(const float* vals, size_t n, char* out, size_t cap) {
+  char* p = out;
+  char* end = out + cap;
+  for (size_t i = 0; i < n; ++i) {
+    if (i) {
+      if (p == end) return -1;
+      *p++ = ',';
+    }
+    auto res = std::to_chars(p, end, vals[i]);
+    if (res.ec != std::errc()) return -1;
+    p = res.ptr;
+  }
+  return p - out;
+}
+
+}  // extern "C"

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_tensorflow_tpu import _native
 from distributed_tensorflow_tpu.data import images as I
 from distributed_tensorflow_tpu.data.augment import distort_batch, load_image
 from distributed_tensorflow_tpu.models import inception_v3 as iv3
@@ -82,19 +83,32 @@ def write_bottleneck_file(
             f"refusing to write {path}: expected {expected_size} floats, got {values.shape}"
         )
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    text = ",".join(str(float(x)) for x in values)
+    # Native codec (shortest-round-trip float32 decimals, C++ to_chars) when
+    # available; Python repr fallback. Both parse back to identical float32s
+    # from either reader, so mixed native/fallback processes share a cache.
+    data = _native.format_csv_floats(values)
+    if data is None:
+        data = ",".join(str(float(x)) for x in values).encode()
     tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as fh:
-        fh.write(text)
+    with open(tmp, "wb") as fh:
+        fh.write(data)
     os.replace(tmp, path)
-    return np.array([float(x) for x in text.split(",")], dtype=np.float32)
+    return _parse_csv(data)
+
+
+def _parse_csv(data: bytes, expected_size: int | None = None) -> np.ndarray:
+    """Parse the cache text format; raises ValueError on corruption."""
+    parsed = _native.parse_csv_floats(data, expected_size)
+    if parsed is not None:
+        return parsed
+    return np.array([float(x) for x in data.split(b",")], dtype=np.float32)
 
 
 def read_bottleneck_file(path: str, expected_size: int = iv3.BOTTLENECK_SIZE) -> np.ndarray:
     """Raises ValueError on corruption (caller regenerates) — including a
     cleanly-truncated file whose floats all parse but whose length is wrong."""
-    with open(path) as fh:
-        values = np.array([float(x) for x in fh.read().split(",")], dtype=np.float32)
+    with open(path, "rb") as fh:
+        values = _parse_csv(fh.read(), expected_size or None)
     if expected_size and values.shape != (expected_size,):
         raise ValueError(f"{path}: expected {expected_size} floats, got {values.shape}")
     return values

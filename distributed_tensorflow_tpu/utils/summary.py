@@ -27,6 +27,8 @@ import time
 
 import numpy as np
 
+from distributed_tensorflow_tpu import _native
+
 # ---------------------------------------------------------------------------
 # CRC32C (Castagnoli), table-driven, with the TFRecord masking scheme.
 # ---------------------------------------------------------------------------
@@ -164,6 +166,10 @@ def encode_event(
 
 
 def write_record(fh, data: bytes) -> None:
+    framed = _native.frame_record(data)  # C++ CRC32C path (TF's record writer
+    if framed is not None:               # is native too); None → no toolchain
+        fh.write(framed)
+        return
     header = struct.pack("<Q", len(data))
     fh.write(header)
     fh.write(struct.pack("<I", masked_crc32c(header)))

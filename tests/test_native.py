@@ -1,0 +1,147 @@
+"""Native C++ runtime library: parity with the pure-Python fallbacks.
+
+Every assertion here runs against both implementations — the native library
+must be byte/value-compatible so mixed native/fallback processes can share
+event files and bottleneck caches.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu import _native as N
+from distributed_tensorflow_tpu.utils import summary as S
+
+pytestmark = pytest.mark.skipif(
+    N.lib() is None, reason="native library unavailable (no C++ toolchain)"
+)
+
+
+@pytest.mark.parametrize(
+    "data",
+    [b"", b"x", b"hello world" * 100, bytes(range(256)) * 33, np.random.default_rng(0).bytes(4097)],
+)
+def test_masked_crc32c_matches_python(data):
+    assert N.masked_crc32c(data) == S.masked_crc32c(data)
+
+
+def test_frame_record_matches_python_framing(tmp_path):
+    import io
+
+    payload = b"some event payload" * 7
+    framed = N.frame_record(payload)
+    buf = io.BytesIO()
+    # Force the Python path by writing manually.
+    import struct
+
+    header = struct.pack("<Q", len(payload))
+    buf.write(header)
+    buf.write(struct.pack("<I", S.masked_crc32c(header)))
+    buf.write(payload)
+    buf.write(struct.pack("<I", S.masked_crc32c(payload)))
+    assert framed == buf.getvalue()
+
+
+def test_event_file_native_write_python_read(tmp_path):
+    w = S.SummaryWriter(str(tmp_path))
+    w.add_scalars({"loss": 1.5, "acc": 0.5}, step=3)
+    w.add_histogram("h", np.arange(100.0), step=3)
+    w.close()
+    records = list(S.read_records(w.path))  # read side verifies both CRCs
+    assert len(records) == 3  # file_version + scalars + histogram
+
+
+def test_csv_roundtrip_values_exact():
+    v = (np.random.default_rng(1).random(4096).astype(np.float32) - 0.5) * 1e6
+    txt = N.format_csv_floats(v)
+    assert np.array_equal(N.parse_csv_floats(txt, 4096), v)
+    # Python reader of native text → identical float32s.
+    py = np.array([float(x) for x in txt.decode().split(",")], dtype=np.float32)
+    assert np.array_equal(py, v)
+
+
+def test_csv_parse_python_written_text():
+    v = np.random.default_rng(2).random(512).astype(np.float32)
+    pytxt = ",".join(str(float(x)) for x in v).encode()
+    assert np.array_equal(N.parse_csv_floats(pytxt, 512), v)
+
+
+@pytest.mark.parametrize("special", [np.inf, -np.inf, np.nan, 0.0, -0.0, 1e-38, 3.4e38])
+def test_csv_specials(special):
+    v = np.array([special], dtype=np.float32)
+    txt = N.format_csv_floats(v)
+    out = N.parse_csv_floats(txt, 1)
+    if np.isnan(special):
+        assert np.isnan(out[0])
+    else:
+        assert out[0] == v[0]
+
+
+@pytest.mark.parametrize("bad", [b",", b"1,,2", b"1,2,", b"abc", b"1;2", b"1,2x,3"])
+def test_csv_malformed_raises(bad):
+    with pytest.raises(ValueError):
+        N.parse_csv_floats(bad, 16)
+
+
+def test_csv_empty_is_empty():
+    assert N.parse_csv_floats(b"", 4).shape == (0,)
+
+
+def test_csv_too_many_floats_for_cap_raises():
+    with pytest.raises(ValueError):
+        N.parse_csv_floats(b"1,2,3,4,5", 3)
+
+
+def test_loader_degrades_when_build_impossible(monkeypatch, tmp_path):
+    """A failed mkstemp (read-only package dir) must yield lib() is None, not
+    an exception through the fallback contract."""
+    import importlib
+    import tempfile as _tempfile
+
+    import distributed_tensorflow_tpu._native as mod
+
+    fresh = importlib.reload(mod)
+    try:
+        monkeypatch.setattr(
+            _tempfile, "mkstemp", lambda *a, **k: (_ for _ in ()).throw(PermissionError())
+        )
+        monkeypatch.setattr(fresh, "_SO", str(tmp_path / "nonexistent.so"))
+        assert fresh.lib() is None
+        assert fresh.masked_crc32c(b"abc") is None
+    finally:
+        importlib.reload(mod)  # restore the real singleton for later tests
+
+
+def test_loader_uses_prebuilt_so_without_source(monkeypatch, tmp_path):
+    import importlib
+    import shutil
+
+    import distributed_tensorflow_tpu._native as mod
+
+    assert mod.lib() is not None  # ensure the .so exists to copy
+    so = str(tmp_path / "libdtfnative.so")
+    shutil.copy(mod._SO, so)
+    fresh = importlib.reload(mod)
+    try:
+        monkeypatch.setattr(fresh, "_SO", so)
+        monkeypatch.setattr(fresh, "_SRC", str(tmp_path / "missing.cc"))
+        assert fresh.lib() is not None
+        assert fresh.masked_crc32c(b"abc") is not None
+    finally:
+        importlib.reload(mod)
+
+
+def test_bottleneck_cache_native_python_interop(tmp_path, monkeypatch):
+    from distributed_tensorflow_tpu.data import bottleneck as B
+
+    v = np.random.default_rng(3).random(2048).astype(np.float32)
+    # Write with native codec, read with forced-Python codec and vice versa.
+    p1 = str(tmp_path / "n.txt")
+    ret1 = B.write_bottleneck_file(p1, v)
+    monkeypatch.setattr(N, "parse_csv_floats", lambda *a, **k: None)
+    monkeypatch.setattr(N, "format_csv_floats", lambda *a, **k: None)
+    assert np.array_equal(B.read_bottleneck_file(p1), v)
+    p2 = str(tmp_path / "p.txt")
+    ret2 = B.write_bottleneck_file(p2, v)
+    assert np.array_equal(ret1, ret2)
+    monkeypatch.undo()
+    assert np.array_equal(B.read_bottleneck_file(p2), v)
