@@ -70,6 +70,8 @@ def lib() -> ctypes.CDLL | None:
         return None
     cdll.dtf_crc32c.restype = ctypes.c_uint32
     cdll.dtf_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    cdll.dtf_crc32c_sw.restype = ctypes.c_uint32
+    cdll.dtf_crc32c_sw.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     cdll.dtf_masked_crc32c.restype = ctypes.c_uint32
     cdll.dtf_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     cdll.dtf_frame_record.restype = ctypes.c_size_t

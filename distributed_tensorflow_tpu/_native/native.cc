@@ -87,6 +87,12 @@ uint32_t dtf_crc32c(const uint8_t* data, size_t len) {
   return crc32c_dispatch(0xFFFFFFFFu, data, len) ^ 0xFFFFFFFFu;
 }
 
+// Software path, exported so parity tests can exercise it even on hosts whose
+// dispatch always picks the SSE4.2 path.
+uint32_t dtf_crc32c_sw(const uint8_t* data, size_t len) {
+  return crc32c_sw(0xFFFFFFFFu, data, len) ^ 0xFFFFFFFFu;
+}
+
 // TFRecord masking (same scheme as TF's record writer).
 uint32_t dtf_masked_crc32c(const uint8_t* data, size_t len) {
   uint32_t crc = dtf_crc32c(data, len);

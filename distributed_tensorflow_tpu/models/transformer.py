@@ -1,0 +1,126 @@
+"""Decoder-only transformer LM — the framework's long-context model family.
+
+The reference has no sequence models (SURVEY §5.7); this model exists so the
+framework's first-class long-context machinery (``ops.attention`` blockwise/
+flash kernels, ``parallel.ring_attention`` sequence parallelism) has a
+production consumer, the same way ``MnistCNN`` consumes the data-parallel
+stack.
+
+TPU-first choices:
+  * bf16 compute / f32 params (MXU-native), static shapes throughout
+  * pre-norm blocks, GELU MLP, learned positional embeddings taken by
+    **global** position so a sequence shard on device i embeds positions
+    [i·S_loc, (i+1)·S_loc) — the hook sequence parallelism needs
+  * attention implementation is injectable: 'dense' (short seq), 'blockwise'
+    (long seq, differentiable scan), 'flash' (Pallas kernel), or a callable
+    (ring attention closure from the parallel layer)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.ops import attention as A
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    d_ff: int = 512
+    max_seq_len: int = 2048
+    dropout_rate: float = 0.0
+    attention: str | Callable = "dense"  # 'dense' | 'blockwise' | 'flash' | callable
+    compute_dtype: Any = jnp.bfloat16
+
+
+def _attention_fn(cfg: TransformerConfig) -> Callable:
+    if callable(cfg.attention):
+        return cfg.attention
+    if cfg.attention == "dense":
+        return lambda q, k, v: A.dense_attention(q, k, v, causal=True)
+    if cfg.attention == "blockwise":
+        return lambda q, k, v: A.blockwise_attention(q, k, v, causal=True)
+    if cfg.attention == "flash":
+        return lambda q, k, v: A.flash_attention(q, k, v, causal=True)
+    raise ValueError(f"unknown attention implementation: {cfg.attention!r}")
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, attend, train: bool = False):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x)
+        b, s, _ = h.shape
+        dh = cfg.d_model // cfg.num_heads
+        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.compute_dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # (B, S, D) -> (B, H, S, dh)
+        to_heads = lambda t: t.reshape(b, s, cfg.num_heads, dh).transpose(0, 2, 1, 3)
+        attn = attend(to_heads(q), to_heads(k), to_heads(v))
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        attn = nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, name="proj")(attn)
+        if cfg.dropout_rate:
+            attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
+        x = x + attn
+
+        h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.compute_dtype, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, name="mlp_out")(h)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """``apply(variables, tokens, positions=None) -> logits`` (f32).
+
+    ``tokens``: (B, S) int32. ``positions``: (B, S) global positions — pass
+    them when S is a sequence shard (ring attention); defaults to arange(S).
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, train: bool = False):
+        cfg = self.cfg
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="tok_embed")(
+            tokens
+        )
+        x = x + nn.Embed(
+            cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
+        )(positions)
+        attend = _attention_fn(cfg)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block_{i}")(x, attend, train=train)
+        x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def next_token_loss(logits, tokens, weight=None):
+    """Mean cross-entropy of logits[:, :-1] predicting tokens[:, 1:].
+
+    ``weight`` (B, S) optionally masks positions (e.g. sequence-shard padding).
+    """
+    import jax
+
+    targets = tokens[:, 1:]
+    lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+    if weight is not None:
+        w = weight[:, 1:]
+        return (nll * w).sum() / jnp.maximum(w.sum(), 1.0)
+    return nll.mean()

@@ -1,0 +1,274 @@
+"""Attention ops: dense reference, blockwise (memory-efficient, differentiable),
+and a Pallas TPU flash-attention forward kernel.
+
+The reference has no sequence models (SURVEY §5.7: its largest "sequence" is a
+784-pixel flattened image), but long-context support is first-class in this
+framework: these ops are the single-device building blocks under
+``parallel.ring_attention`` (sequence parallelism over a mesh axis) and
+``models.transformer``.
+
+Three tiers, one semantics (causal or full softmax attention over
+``(batch, heads, seq, head_dim)``):
+
+  * :func:`dense_attention` — O(S²) memory jnp reference; ground truth in
+    tests, fine for short sequences.
+  * :func:`blockwise_attention` — online-softmax ``lax.scan`` over key/value
+    blocks; O(S·block) memory, differentiable through the scan (the training
+    path for long sequences). Same algorithm as flash attention, expressed at
+    the XLA level so autodiff derives the backward pass.
+  * :func:`flash_attention` — Pallas kernel (grid over (batch·heads,
+    q-blocks); fori_loop over kv-blocks with running max/denominator carried
+    in registers, f32 accumulation, MXU dots). Forward-only kernel; its
+    ``custom_vjp`` backward recomputes gradients through
+    :func:`blockwise_attention` (O(S·block) memory in the backward too).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
+
+
+def _scale(q, scale):
+    return (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+
+
+def dense_attention(q, k, v, causal: bool = False, scale: float | None = None):
+    """O(S²)-memory reference: softmax(q·kᵀ/√d [+ causal mask]) · v.
+
+    q: (B, H, Sq, D); k, v: (B, H, Skv, D). Returns (B, H, Sq, D) in q's dtype.
+    """
+    s = _scale(q, scale)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * s
+    if causal:
+        sq, skv = q.shape[2], k.shape[2]
+        # Align the ends: query i attends to keys ≤ i + (skv - sq).
+        mask = jnp.tril(jnp.ones((sq, skv), jnp.bool_), k=skv - sq)
+        logits = jnp.where(mask, logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", weights.astype(q.dtype), v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+def _online_block_update(carry, q, k_blk, v_blk, mask, s):
+    """One online-softmax accumulation step shared by blockwise/ring attention.
+
+    carry = (acc (..., q, d) f32, m (..., q) f32 running max,
+             l (..., q) f32 running denominator); mask True = attend.
+    """
+    acc, m, l = carry
+    logits = (
+        jnp.einsum("...qd,...kd->...qk", q, k_blk, preferred_element_type=jnp.float32) * s
+    )
+    logits = jnp.where(mask, logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    # Guard fully-masked rows: keep m finite so exp() stays 0, not NaN.
+    m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    correction = jnp.exp(m - m_safe)
+    p = jnp.exp(logits - m_safe[..., None])
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = acc * correction[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
+    )
+    return acc_new, m_safe + jnp.where(m_new <= NEG_INF / 2, NEG_INF, 0.0), l_new
+
+
+def _finalize(acc, l, dtype):
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(dtype)
+
+
+def blockwise_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_kv: int = 512,
+    scale: float | None = None,
+    q_offset: int | jax.Array = 0,
+    kv_offset: int | jax.Array = 0,
+):
+    """Memory-efficient attention: ``lax.scan`` over kv blocks with the online
+    softmax; never materializes (Sq, Skv). Differentiable (autodiff through
+    the scan rematerializes per-block logits — O(S·block) backward memory).
+
+    ``q_offset``/``kv_offset`` are the global positions of q[..., 0, :] and
+    k[..., 0, :] — used by ring attention where each device holds a sequence
+    shard (may be traced values).
+    """
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    s = _scale(q, scale)
+    block_kv = min(block_kv, skv)
+    num_blocks = -(-skv // block_kv)
+    pad = num_blocks * block_kv - skv
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(b, h, num_blocks, block_kv, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, num_blocks, block_kv, d).transpose(2, 0, 1, 3, 4)
+
+    q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (sq, 1), 0)  # (sq, 1)
+
+    def step(carry, xs):
+        blk_idx, k_blk, v_blk = xs
+        k_pos = kv_offset + blk_idx * block_kv + lax.broadcasted_iota(
+            jnp.int32, (1, block_kv), 1
+        )
+        valid = (k_pos - kv_offset) < skv  # padding mask
+        mask = valid if not causal else (k_pos <= q_pos) & valid
+        carry = _online_block_update(carry, q, k_blk, v_blk, mask, s)
+        return carry, None
+
+    init = (
+        jnp.zeros((b, h, sq, d), jnp.float32),
+        jnp.full((b, h, sq), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, sq), jnp.float32),
+    )
+    (acc, _, l), _ = lax.scan(step, init, (jnp.arange(num_blocks), kb, vb))
+    return _finalize(acc, l, q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention forward kernel.
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_kv: int, causal: bool, s: float):
+    # q_ref: (1, bq, D); k_ref/v_ref: (1, S, D); o_ref: (1, bq, D).
+    bq = q_ref.shape[1]
+    skv = k_ref.shape[1]
+    d = q_ref.shape[2]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (bq, D)
+    q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    num_kv = skv // block_kv
+    if causal:
+        # Only kv blocks whose start position can be <= the last q position.
+        upper = lax.div((qi + 1) * bq + block_kv - 1, block_kv)
+        upper = jnp.minimum(upper, num_kv)
+    else:
+        upper = num_kv
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_kv, block_kv), :]
+        logits = jax.lax.dot_general(
+            q,
+            k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * s  # (bq, bkv)
+        if causal:
+            k_pos = j * block_kv + lax.broadcasted_iota(jnp.int32, (1, block_kv), 1)
+            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        correction = jnp.exp(m - m_safe)
+        p = jnp.exp(logits - m_safe)
+        l_new = l * correction + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * correction + jax.lax.dot_general(
+            p.astype(v_blk.dtype),
+            v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_out = m_safe + jnp.where(m_new <= NEG_INF / 2, NEG_INF, 0.0)
+        return acc_new, m_out, l_new
+
+    init = (
+        jnp.zeros((bq, d), jnp.float32),
+        jnp.full((bq, 1), NEG_INF, jnp.float32),
+        jnp.zeros((bq, 1), jnp.float32),
+    )
+    acc, _, l = lax.fori_loop(0, upper, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+try:  # Pallas import is deferred-tolerant: CPU-only installs may lack it.
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret):
+    if not HAVE_PALLAS:
+        raise RuntimeError(
+            "jax.experimental.pallas unavailable — use blockwise_attention instead"
+        )
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    s = _scale(q, scale)
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, skv)
+    if sq % block_q or skv % block_kv:
+        raise ValueError(
+            f"flash_attention needs seq divisible by blocks: sq={sq}%{block_q}, "
+            f"skv={skv}%{block_kv}"
+        )
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, skv, d)
+    vf = v.reshape(b * h, skv, d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    kernel = functools.partial(_flash_kernel, block_kv=block_kv, causal=causal, s=s)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, skv, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, skv, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: int = 128,
+    block_kv: int = 128,
+    scale: float | None = None,
+    interpret: bool | None = None,
+):
+    """Pallas flash-attention forward (TPU; interpret-mode elsewhere), with a
+    recompute-based backward through :func:`blockwise_attention` (same online
+    softmax, so forward/backward numerics agree to f32 tolerance)."""
+    return _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_kv, scale, interpret):
+    out = _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_kv, scale, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, causal=causal, block_kv=block_kv, scale=scale),
+        q,
+        k,
+        v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

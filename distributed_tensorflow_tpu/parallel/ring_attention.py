@@ -1,0 +1,84 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Long-context training shards the **sequence** dimension across devices: each
+device holds a contiguous Sq/P slice of q/k/v. Exact attention then needs
+every (q-shard, kv-shard) pair; ring attention streams the kv shards around
+the mesh axis with ``lax.ppermute`` (P-1 hops over ICI) while each device
+folds the visiting block into its local online-softmax state — communication
+overlaps compute, memory stays O(S/P · block), and the result is bit-for-bit
+the same softmax as dense attention over the full sequence.
+
+This is the TPU-native shape of the technique (Liu et al., "Ring Attention
+with Blockwise Transformers", 2023): collectives over the mesh axis instead
+of point-to-point NCCL sends. The reference has no sequence models at all
+(SURVEY §5.7) — this subsystem is framework-first-class rather than parity.
+
+Use inside ``shard_map`` with the sequence axis sharded over ``axis_name``:
+
+    mesh = make_mesh(...)   # e.g. axes ('data', 'model'); seq rides 'model'
+    f = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name='model', causal=True),
+        mesh=mesh,
+        in_specs=P(None, None, 'model', None),   # (B, H, S, D) sharded on S
+        out_specs=P(None, None, 'model', None),
+    )
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_tensorflow_tpu.ops.attention import (
+    NEG_INF,
+    _finalize,
+    _online_block_update,
+    _scale,
+)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    causal: bool = False,
+    scale: float | None = None,
+):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Must run inside ``shard_map``/``pmap``. ``q``/``k``/``v`` are the local
+    shards, shape (B, H, S_local, D); shard i holds global positions
+    [i·S_local, (i+1)·S_local). Returns the local (B, H, S_local, D) output.
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    s = _scale(q, scale)
+    q_pos = my_idx * s_local + lax.broadcasted_iota(jnp.int32, (s_local, 1), 0)
+    # Shift kv one hop "left" each step: after t hops we hold the shard that
+    # originated on device (my_idx + t) mod P.
+    perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, t):
+        acc, m, l, k_blk, v_blk = carry
+        src = lax.rem(my_idx + t, axis_size)
+        k_pos = src * s_local + lax.broadcasted_iota(jnp.int32, (1, s_local), 1)
+        mask = jnp.ones((s_local, s_local), jnp.bool_) if not causal else (k_pos <= q_pos)
+        acc, m, l = _online_block_update((acc, m, l), q, k_blk, v_blk, mask, s)
+        # Unconditional permute (the last hop returns shards home): collectives
+        # under lax.cond don't lower cleanly in SPMD, and one extra hop is
+        # cheaper than a branch.
+        k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm)
+        return (acc, m, l, k_blk, v_blk), None
+
+    init = (
+        jnp.zeros((b, h, s_local, d), jnp.float32),
+        jnp.full((b, h, s_local), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, s_local), jnp.float32),
+        k,
+        v,
+    )
+    (acc, _, l, _, _), _ = lax.scan(step, init, jnp.arange(axis_size))
+    return _finalize(acc, l, q.dtype)
