@@ -85,6 +85,14 @@ class MnistTrainConfig:
     synthetic_data: bool = field(
         default=False, metadata={"help": "generate deterministic synthetic MNIST if idx files absent"}
     )
+    profile_dir: str = field(
+        default="",
+        metadata={"help": "if set, write a jax.profiler (TensorBoard XPlane) trace here"},
+    )
+    profile_start_step: int = field(
+        default=10, metadata={"help": "first traced step (after compile warmup)"}
+    )
+    profile_num_steps: int = field(default=5, metadata={"help": "traced step count"})
 
 
 @dataclass

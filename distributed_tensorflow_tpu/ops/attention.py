@@ -17,10 +17,16 @@ Three tiers, one semantics (causal or full softmax attention over
     path for long sequences). Same algorithm as flash attention, expressed at
     the XLA level so autodiff derives the backward pass.
   * :func:`flash_attention` — Pallas kernel (grid over (batch·heads,
-    q-blocks); fori_loop over kv-blocks with running max/denominator carried
-    in registers, f32 accumulation, MXU dots). Forward-only kernel; its
-    ``custom_vjp`` backward recomputes gradients through
-    :func:`blockwise_attention` (O(S·block) memory in the backward too).
+    q-blocks, kv-blocks) with the kv axis innermost — sequential on TPU — and
+    the running max/denominator/accumulator carried in VMEM scratch, so VMEM
+    holds only (block_q + 2·block_kv)·D rows, never the full sequence; f32
+    accumulation, MXU dots). Forward-only kernel; its ``custom_vjp`` backward
+    recomputes gradients through :func:`blockwise_attention` (O(S·block)
+    memory in the backward too).
+
+Causal masking is **end-aligned** in all three tiers: query ``i`` attends to
+keys ``<= i + (Skv - Sq)``, so with cached keys (Sq < Skv, decode) the last
+query sees the full prefix — matching :func:`dense_attention`'s ground truth.
 """
 
 from __future__ import annotations
@@ -52,6 +58,10 @@ def dense_attention(q, k, v, causal: bool = False, scale: float | None = None):
         mask = jnp.tril(jnp.ones((sq, skv), jnp.bool_), k=skv - sq)
         logits = jnp.where(mask, logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
+    if causal:
+        # Fully-masked rows (possible when sq > skv) output 0, not the uniform
+        # mean softmax degrades to — same semantics as blockwise/flash.
+        weights = jnp.where(mask.any(axis=-1)[:, None], weights, 0.0)
     return jnp.einsum(
         "bhqk,bhkd->bhqd", weights.astype(q.dtype), v, preferred_element_type=jnp.float32
     ).astype(q.dtype)
@@ -91,7 +101,7 @@ def blockwise_attention(
     causal: bool = False,
     block_kv: int = 512,
     scale: float | None = None,
-    q_offset: int | jax.Array = 0,
+    q_offset: int | jax.Array | None = None,
     kv_offset: int | jax.Array = 0,
 ):
     """Memory-efficient attention: ``lax.scan`` over kv blocks with the online
@@ -100,10 +110,14 @@ def blockwise_attention(
 
     ``q_offset``/``kv_offset`` are the global positions of q[..., 0, :] and
     k[..., 0, :] — used by ring attention where each device holds a sequence
-    shard (may be traced values).
+    shard (may be traced values). ``q_offset=None`` (default) end-aligns the
+    sequences (``q_offset = Skv - Sq``), matching :func:`dense_attention`'s
+    causal semantics when Sq != Skv.
     """
     b, h, sq, d = q.shape
     skv = k.shape[2]
+    if q_offset is None:
+        q_offset = skv - sq
     s = _scale(q, scale)
     block_kv = min(block_kv, skv)
     num_blocks = -(-skv // block_kv)
@@ -140,27 +154,48 @@ def blockwise_attention(
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_kv: int, causal: bool, s: float):
-    # q_ref: (1, bq, D); k_ref/v_ref: (1, S, D); o_ref: (1, bq, D).
-    bq = q_ref.shape[1]
-    skv = k_ref.shape[1]
-    d = q_ref.shape[2]
+# Lane width of the m/l scratch buffers: TPU VMEM tiles are (8, 128); a
+# 128-wide broadcast column keeps Mosaic on the fast layout path.
+_STAT_LANES = 128
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    block_kv: int,
+    num_kv: int,
+    causal: bool,
+    s: float,
+    q_pos_offset: int,
+):
+    """One (batch·head, q-block, kv-block) grid cell.
+
+    The kv axis is the innermost grid dimension — executed sequentially on
+    TPU — so the online-softmax state (acc/m/l) lives in VMEM scratch and is
+    carried across kv iterations; only one (block_q, D) q tile and one
+    (block_kv, D) k/v tile are resident per cell. q_pos_offset end-aligns
+    causal masking when Sq != Skv.
+    """
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (bq, D)
-    q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    j = pl.program_id(2)
+    bq = q_ref.shape[1]
 
-    num_kv = skv // block_kv
-    if causal:
-        # Only kv blocks whose start position can be <= the last q position.
-        upper = lax.div((qi + 1) * bq + block_kv - 1, block_kv)
-        upper = jnp.minimum(upper, num_kv)
-    else:
-        upper = num_kv
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_kv, block_kv), :]
+    def compute():
+        q = q_ref[0].astype(jnp.float32)  # (bq, D)
+        k_blk = k_ref[0].astype(jnp.float32)  # (bkv, D)
+        v_blk = v_ref[0]
         logits = jax.lax.dot_general(
             q,
             k_blk,
@@ -168,29 +203,43 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_kv: int, causal: bool, s:
             preferred_element_type=jnp.float32,
         ) * s  # (bq, bkv)
         if causal:
+            q_pos = (
+                q_pos_offset
+                + qi * bq
+                + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+            )
             k_pos = j * block_kv + lax.broadcasted_iota(jnp.int32, (1, block_kv), 1)
             logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+        m = m_ref[:, :1]
+        l = l_ref[:, :1]
         m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
         m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
         correction = jnp.exp(m - m_safe)
         p = jnp.exp(logits - m_safe)
         l_new = l * correction + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * correction + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
             p.astype(v_blk.dtype),
             v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_out = m_safe + jnp.where(m_new <= NEG_INF / 2, NEG_INF, 0.0)
-        return acc_new, m_out, l_new
+        m_ref[...] = jnp.broadcast_to(m_out, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    init = (
-        jnp.zeros((bq, d), jnp.float32),
-        jnp.full((bq, 1), NEG_INF, jnp.float32),
-        jnp.zeros((bq, 1), jnp.float32),
-    )
-    acc, _, l = lax.fori_loop(0, upper, body, init)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if causal:
+        # Skip kv blocks entirely beyond the last query position of this tile.
+        last_q = q_pos_offset + (qi + 1) * bq - 1
+
+        @pl.when(j * block_kv <= last_q)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
 
 
 try:  # Pallas import is deferred-tolerant: CPU-only installs may lack it.
@@ -222,17 +271,46 @@ def _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret):
     vf = v.reshape(b * h, skv, d)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    kernel = functools.partial(_flash_kernel, block_kv=block_kv, causal=causal, s=s)
+    num_kv = skv // block_kv
+    kernel = functools.partial(
+        _flash_kernel,
+        block_kv=block_kv,
+        num_kv=num_kv,
+        causal=causal,
+        s=s,
+        q_pos_offset=skv - sq,  # end-aligned causal, matching dense_attention
+    )
+    if causal:
+        # Block-sparse kv fetch: cells beyond this q-tile's last needed kv
+        # block are compute-skipped in the kernel; mapping their index to that
+        # last block keeps the block index constant across the skipped tail of
+        # the kv axis, so Pallas elides the HBM→VMEM DMA (it only re-fetches
+        # when the mapped index changes between consecutive grid steps).
+        q_pos_offset = skv - sq
+
+        def kv_index(bh, i, j):
+            last_block = jnp.clip(
+                (q_pos_offset + (i + 1) * block_q - 1) // block_kv, 0, num_kv - 1
+            )
+            return (bh, jnp.minimum(j, last_block), 0)
+
+    else:
+        kv_index = lambda bh, i, j: (bh, j, 0)
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q),
+        grid=(b * h, sq // block_q, num_kv),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, skv, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, skv, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+            pl.BlockSpec((1, block_kv, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d)

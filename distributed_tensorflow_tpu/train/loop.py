@@ -30,6 +30,7 @@ from distributed_tensorflow_tpu.parallel import data_parallel as dp
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh
 from distributed_tensorflow_tpu.train.checkpoint import CheckpointManager
 from distributed_tensorflow_tpu.utils.logging import get_logger
+from distributed_tensorflow_tpu.utils.profiler import Profiler
 from distributed_tensorflow_tpu.utils.summary import SummaryWriter, variable_summaries
 from distributed_tensorflow_tpu.utils.timer import StepTimer, WallClock
 
@@ -165,14 +166,33 @@ class MnistTrainer:
 
     def _train_loop(self, prefetch, num_steps: int, step: int, timer: StepTimer) -> None:
         cfg = self.cfg
+        # Chief-only trace (SURVEY §5.1): replaces the reference's wall-clock
+        # prints with a real per-op device timeline when --profile_dir is set.
+        # The window is relative to THIS run's first step (``step`` may be a
+        # checkpoint-resumed global step); the sync callback flushes the
+        # async-dispatched device queue so the XPlane isn't truncated.
+        prof = Profiler(
+            cfg.profile_dir if self.is_chief else None,
+            start_step=step + cfg.profile_start_step,
+            num_steps=cfg.profile_num_steps,
+            sync=lambda: jax.block_until_ready(self.global_step),
+        )
+        try:
+            self._train_steps(prefetch, num_steps, step, timer, prof)
+        finally:
+            prof.close()
+
+    def _train_steps(self, prefetch, num_steps: int, step: int, timer: StepTimer, prof) -> None:
+        cfg = self.cfg
         while step < num_steps:
             batch = next(prefetch)
             # Base key only: the step fold happens on-device inside the jitted
             # program (keyed on global_step), so the hot loop does zero
             # per-step host dispatches besides the train step itself.
-            self.params, self.opt_state, self.global_step, metrics = self.train_step(
-                self.params, self.opt_state, self.global_step, batch, self.rng
-            )
+            with prof.step(step):
+                self.params, self.opt_state, self.global_step, metrics = self.train_step(
+                    self.params, self.opt_state, self.global_step, batch, self.rng
+                )
             timer.tick()
             step += 1
             if step % cfg.eval_step_interval == 0 or step == num_steps:

@@ -1,0 +1,153 @@
+"""Profiling / tracing subsystem.
+
+The reference's only "profiling" is wall-clock ``time.time()`` deltas printed
+to stdout (``demo1/train.py:152,164``) plus graph visualisation via
+``FileWriter(..., sess.graph)`` (``demo1/train.py:151``) — SURVEY §5.1. The
+TPU-native upgrade is a real XLA trace: ``jax.profiler`` writes a
+TensorBoard-loadable profile (XPlane) with per-op device timelines, HLO, and
+memory-allocation views.
+
+Three entry points:
+
+* :class:`Profiler` — step-windowed tracing for training loops: arm it with a
+  ``[start_step, start_step + num_steps)`` window and call ``.step(i)`` once
+  per loop iteration; the trace starts/stops itself and each step inside the
+  window is annotated with ``StepTraceAnnotation`` so TensorBoard groups
+  device ops by step.
+* :func:`trace` — context manager for ad-hoc tracing of any region.
+* :func:`annotate` — named ``TraceAnnotation`` for host-side regions so they
+  show up on the trace timeline.
+
+All are no-ops when given an empty/None log dir, so call sites need no
+conditionals.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class Profiler:
+    """Step-windowed ``jax.profiler`` trace for a training loop.
+
+    Usage::
+
+        prof = Profiler(log_dir, start_step=10, num_steps=5)
+        for step in range(n):
+            with prof.step(step):
+                run_one_step()
+        prof.close()  # safety net if the loop exits inside the window
+
+    ``start_step`` defaults past the compile steps so the trace captures
+    steady-state device time, not XLA compilation.
+
+    ``sync`` (if given) is called right before the trace is stopped. Training
+    loops dispatch steps asynchronously, so without a device sync the host
+    reaches the end of the window while the device is still executing traced
+    steps and the XPlane is truncated; pass e.g.
+    ``lambda: jax.block_until_ready(self.global_step)`` — device execution is
+    in-order, so blocking on the window's last output flushes all of it.
+    """
+
+    def __init__(
+        self,
+        log_dir: str | None,
+        start_step: int = 10,
+        num_steps: int = 5,
+        sync=None,
+    ):
+        self.log_dir = log_dir or None
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self.sync = sync
+        self._active = False
+        self._done = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.log_dir is not None
+
+    def step(self, step: int):
+        """Context manager wrapping one training step; manages the trace window."""
+        if not self.enabled or self._done:
+            return contextlib.nullcontext()
+        if not self._active and self.start_step <= step < self.start_step + self.num_steps:
+            self._start()
+        if self._active and step >= self.start_step + self.num_steps:
+            self._stop()
+            return contextlib.nullcontext()
+        if self._active:
+            import jax
+
+            return jax.profiler.StepTraceAnnotation("train", step_num=step)
+        return contextlib.nullcontext()
+
+    def _start(self) -> None:
+        import jax
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        jax.profiler.start_trace(self.log_dir)
+        self._active = True
+        log.info("profiler: trace started -> %s", self.log_dir)
+
+    def _stop(self) -> None:
+        import jax
+
+        if self.sync is not None:
+            self.sync()
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        log.info("profiler: trace written to %s", self.log_dir)
+
+    def close(self) -> None:
+        """Stop the trace if the loop ended while it was still active; warn if
+        the run finished before the window ever opened (else an empty profile
+        dir would be the only clue)."""
+        if self._active:
+            self._stop()
+        elif self.enabled and not self._done:
+            log.warning(
+                "profiler: run ended before the trace window opened "
+                "(start_step=%d, num_steps=%d) — no profile written to %s",
+                self.start_step, self.num_steps, self.log_dir,
+            )
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Trace an arbitrary region: ``with trace('./prof'): run()``. No-op when
+    ``log_dir`` is falsy."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("profiler: trace written to %s", log_dir)
+
+
+def annotate(name: str, **kwargs):
+    """Named host-side region annotation visible on the trace timeline."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name, **kwargs)
+
+
+def save_device_memory_profile(path: str) -> None:
+    """Dump a pprof-format snapshot of live device (HBM) allocations."""
+    import jax
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    jax.profiler.save_device_memory_profile(path)
+    log.info("profiler: device memory profile -> %s", path)

@@ -37,6 +37,37 @@ def test_flash_matches_dense(causal):
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
 
+def test_fully_masked_rows_agree_across_tiers():
+    """sq > skv causal: leading queries have negative end-aligned positions →
+    no attendable keys. All tiers must output exactly 0 for those rows (dense
+    would otherwise degrade to uniform-mean softmax)."""
+    r = np.random.default_rng(5)
+    q = jnp.asarray(r.standard_normal((1, 2, 12, 8)), jnp.float32)
+    k = jnp.asarray(r.standard_normal((1, 2, 8, 8)), jnp.float32)
+    v = jnp.asarray(r.standard_normal((1, 2, 8, 8)), jnp.float32)
+    ref = A.dense_attention(q, k, v, causal=True)
+    np.testing.assert_array_equal(np.asarray(ref[:, :, :4]), 0.0)  # rows 0-3 masked
+    blk = A.blockwise_attention(q, k, v, causal=True, block_kv=4)
+    np.testing.assert_allclose(blk, ref, rtol=2e-5, atol=2e-5)
+    fl = A.flash_attention(q, k, v, causal=True, block_q=4, block_kv=4)
+    np.testing.assert_allclose(fl, ref, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("sq,skv", [(4, 32), (16, 32), (8, 24)])
+def test_causal_cross_length_matches_dense(sq, skv):
+    """Sq != Skv (decode with cached keys): all tiers must share dense's
+    end-aligned causal semantics — query i attends keys <= i + (Skv - Sq)."""
+    r = np.random.default_rng(3)
+    mk = lambda s: jnp.asarray(r.standard_normal((2, 2, s, 8)), jnp.float32)
+    q, k, v = mk(sq), mk(skv), mk(skv)
+    ref = A.dense_attention(q, k, v, causal=True)
+    blk = A.blockwise_attention(q, k, v, causal=True, block_kv=8)
+    np.testing.assert_allclose(blk, ref, rtol=2e-5, atol=2e-5)
+    if sq % 4 == 0 and skv % 8 == 0:
+        fl = A.flash_attention(q, k, v, causal=True, block_q=4, block_kv=8)
+        np.testing.assert_allclose(fl, ref, rtol=2e-5, atol=2e-5)
+
+
 def test_flash_rejects_non_divisible():
     q, k, v = _qkv(s=48)
     with pytest.raises(ValueError, match="divisible"):
