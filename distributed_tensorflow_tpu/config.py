@@ -162,6 +162,13 @@ class RetrainConfig:
     random_scale: int = 0
     random_brightness: int = 0
     seed: int = 0
+    export_stablehlo: bool = field(
+        default=False,
+        metadata={
+            "help": "also export a frozen StableHLO program next to "
+            "--output_graph (closest analog of the reference's frozen .pb)"
+        },
+    )
 
 
 @dataclass

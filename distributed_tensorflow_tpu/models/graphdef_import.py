@@ -1,0 +1,423 @@
+"""GraphDef → flax weight importer for the 2015 Inception-v3 ``.pb``.
+
+The reference loads ``classify_image_graph_def.pb`` as a frozen TF GraphDef
+(``retrain1/retrain.py:26-36,66-74``) and executes it with the TF 1.x C++
+runtime. The TPU build has no TF dependency, so this module reads the same
+file directly: a minimal protocol-buffers *wire-format* parser (no protobuf
+library, no TF) extracts every ``Const`` node's tensor, and a name map
+rewrites the 2015 graph's ``conv``/``mixed``/``tower`` scopes onto the
+slim-style flax module tree in :mod:`.inception_v3`. The result is a regular
+``{'params': ..., 'batch_stats': ...}`` variables dict for ``model.apply`` —
+the frozen graph becomes data, and XLA (not a GraphDef interpreter) runs the
+network.
+
+2015-pb naming recap (one ``Const`` per conv kernel + four per batchnorm):
+
+    <scope>/conv2d_params                (H, W, Cin, Cout)  — HWIO, flax layout
+    <scope>/batchnorm/beta|gamma|moving_mean|moving_variance   (C,)
+    softmax/weights (2048, 1008), softmax/biases (1008,)
+
+``gamma`` is optional: the 2015 graph used batch norm without a learned scale
+(``scale_after_normalization=False``), so missing gammas restore as ones.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "parse_graphdef_consts",
+    "inception_2015_name_map",
+    "import_inception_graphdef",
+    "serialize_graphdef_consts",
+]
+
+# ---------------------------------------------------------------------------
+# Protobuf wire-format primitives — shared with the TensorBoard event writer
+# (one implementation in utils/protowire.py).
+# ---------------------------------------------------------------------------
+
+from distributed_tensorflow_tpu.utils import protowire as pw
+
+_read_varint = pw.read_varint
+_iter_fields = pw.iter_fields
+
+
+def _field(num: int, wire: int, payload: bytes | int) -> bytes:
+    if wire == 0:
+        return pw.field_varint(num, payload)
+    if wire == 2:
+        return pw.field_bytes(num, payload)
+    return pw.tag(num, wire) + payload
+
+
+# ---------------------------------------------------------------------------
+# TensorProto decode / encode (the subset Const nodes use).
+# ---------------------------------------------------------------------------
+
+# tensorflow/core/framework/types.proto enum values.
+_DT_FLOAT, _DT_DOUBLE, _DT_INT32, _DT_STRING, _DT_INT64 = 1, 2, 3, 7, 9
+_NP_DTYPES = {
+    _DT_FLOAT: np.dtype("<f4"),
+    _DT_DOUBLE: np.dtype("<f8"),
+    _DT_INT32: np.dtype("<i4"),
+    _DT_INT64: np.dtype("<i8"),
+}
+
+
+class _UnsupportedDtype(ValueError):
+    """Const tensor of a dtype we don't import (e.g. the 2015 pb's DT_STRING
+    ``DecodeJpeg/contents`` feed node) — skipped, never fatal."""
+
+
+def _parse_shape(buf: bytes) -> list[int]:
+    dims = []
+    for field, _, value in _iter_fields(buf):
+        if field == 2:  # repeated Dim
+            size = 0
+            for f2, _, v2 in _iter_fields(value):
+                if f2 == 1:  # int64 size
+                    # zigzag NOT used; plain varint (two's complement for -1)
+                    size = v2 - (1 << 64) if v2 >> 63 else v2
+            dims.append(size)
+    return dims
+
+
+def _parse_tensor(buf: bytes) -> np.ndarray:
+    dtype_enum = _DT_FLOAT
+    shape: list[int] = []
+    content = b""
+    repeated: list[float | int] = []
+    repeated_packed: bytes | None = None
+    repeated_field = None
+    for field, wire, value in _iter_fields(buf):
+        if field == 1:  # dtype
+            dtype_enum = value
+        elif field == 2:  # tensor_shape
+            shape = _parse_shape(value)
+        elif field == 4:  # tensor_content
+            content = value
+        elif field in (5, 6, 7, 10):  # float_val / double_val / int_val / int64_val
+            # (field 9 is scomplex_val — complex dtypes are rejected by the
+            # dtype check below, so it is deliberately not read here)
+            repeated_field = field
+            if wire == 2:  # packed
+                repeated_packed = value
+            elif wire == 5:
+                repeated.append(struct.unpack("<f", value)[0])
+            elif wire == 1:
+                repeated.append(struct.unpack("<d", value)[0])
+            else:  # unpacked varint — same two's-complement decode as packed
+                repeated.append(value - (1 << 64) if value >> 63 else value)
+    if dtype_enum not in _NP_DTYPES:
+        raise _UnsupportedDtype(f"unsupported TensorProto dtype enum {dtype_enum}")
+    np_dtype = _NP_DTYPES[dtype_enum]
+    if content:
+        arr = np.frombuffer(bytes(content), dtype=np_dtype)
+    elif repeated_packed is not None:
+        if repeated_field in (5, 6):
+            arr = np.frombuffer(bytes(repeated_packed), dtype=np_dtype)
+        else:  # packed varints
+            vals, pos = [], 0
+            while pos < len(repeated_packed):
+                v, pos = _read_varint(repeated_packed, pos)
+                vals.append(v - (1 << 64) if v >> 63 else v)
+            arr = np.asarray(vals, dtype=np_dtype)
+    elif repeated:
+        arr = np.asarray(repeated, dtype=np_dtype)
+    else:
+        arr = np.zeros((0,), dtype=np_dtype)
+    n_elem = int(np.prod(shape)) if shape else 1
+    if arr.size == 1 and n_elem > 1:
+        # TF semantics: a single value broadcasts to the full shape.
+        arr = np.full(n_elem, arr[0], dtype=np_dtype)
+    if shape or arr.size == 1:  # declared shape, incl. scalar () — else keep 1-D
+        arr = arr.reshape(shape)
+    # np.ascontiguousarray promotes 0-d to (1,) — preserve scalar shape.
+    return arr.copy() if arr.ndim == 0 else np.ascontiguousarray(arr)
+
+
+def _encode_tensor(arr: np.ndarray) -> bytes:
+    enum = {v: k for k, v in _NP_DTYPES.items()}[np.dtype(arr.dtype).newbyteorder("<")]
+    shape = b"".join(
+        _field(2, 2, _field(1, 0, int(d))) for d in arr.shape
+    )
+    return (
+        _field(1, 0, enum)
+        + _field(2, 2, shape)
+        + _field(4, 2, np.ascontiguousarray(arr).astype(arr.dtype, copy=False).tobytes())
+    )
+
+
+# ---------------------------------------------------------------------------
+# GraphDef parse / serialize.
+# ---------------------------------------------------------------------------
+
+
+def parse_graphdef_consts(data: bytes) -> dict[str, np.ndarray]:
+    """Extract ``{node_name: ndarray}`` for every Const node in a serialized
+    GraphDef. Non-Const nodes (the 2015 pb's compute ops) are skipped — XLA
+    provides the compute; only the weights matter here."""
+    consts: dict[str, np.ndarray] = {}
+    for field, _, node_buf in _iter_fields(data):
+        if field != 1:  # GraphDef.node
+            continue
+        name, op, tensor_buf = "", "", None
+        for f, _, value in _iter_fields(node_buf):
+            if f == 1:
+                name = bytes(value).decode("utf-8")
+            elif f == 2:
+                op = bytes(value).decode("utf-8")
+            elif f == 5:  # attr map entry {1: key, 2: AttrValue}
+                key, attr_buf = "", b""
+                for f2, _, v2 in _iter_fields(value):
+                    if f2 == 1:
+                        key = bytes(v2).decode("utf-8")
+                    elif f2 == 2:
+                        attr_buf = v2
+                if key == "value":
+                    for f3, _, v3 in _iter_fields(attr_buf):
+                        if f3 == 8:  # AttrValue.tensor
+                            tensor_buf = v3
+        if op == "Const" and tensor_buf is not None:
+            try:
+                consts[name] = _parse_tensor(tensor_buf)
+            except _UnsupportedDtype:
+                continue  # e.g. DT_STRING DecodeJpeg/contents — not a weight
+    return consts
+
+
+def serialize_graphdef_consts(consts: dict[str, np.ndarray]) -> bytes:
+    """Serialize ``{name: ndarray}`` as a GraphDef of Const nodes — the
+    inverse of :func:`parse_graphdef_consts`, used by tests (and usable to
+    write reference-format frozen graphs from our own params)."""
+    out = bytearray()
+    for name, arr in consts.items():
+        attr_value = _field(8, 2, _encode_tensor(arr))
+        attr_entry = _field(1, 2, b"value") + _field(2, 2, attr_value)
+        node = (
+            _field(1, 2, name.encode("utf-8"))
+            + _field(2, 2, b"Const")
+            + _field(5, 2, attr_entry)
+        )
+        out += _field(1, 2, node)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# 2015-pb scope → flax path mapping.
+# ---------------------------------------------------------------------------
+
+_STEM = {
+    "conv": "Conv2d_1a_3x3",
+    "conv_1": "Conv2d_2a_3x3",
+    "conv_2": "Conv2d_2b_3x3",
+    "conv_3": "Conv2d_3b_1x1",
+    "conv_4": "Conv2d_4a_3x3",
+}
+_MIXED_BLOCKS = [
+    "Mixed_5b", "Mixed_5c", "Mixed_5d", "Mixed_6a", "Mixed_6b", "Mixed_6c",
+    "Mixed_6d", "Mixed_6e", "Mixed_7a", "Mixed_7b", "Mixed_7c",
+]
+_BRANCHES_A = {
+    "conv": "branch1x1",
+    "tower/conv": "branch5x5_1",
+    "tower/conv_1": "branch5x5_2",
+    "tower_1/conv": "branch3x3dbl_1",
+    "tower_1/conv_1": "branch3x3dbl_2",
+    "tower_1/conv_2": "branch3x3dbl_3",
+    "tower_2/conv": "branch_pool",
+}
+_BRANCHES_RA = {
+    "conv": "branch3x3",
+    "tower/conv": "branch3x3dbl_1",
+    "tower/conv_1": "branch3x3dbl_2",
+    "tower/conv_2": "branch3x3dbl_3",
+}
+_BRANCHES_B = {
+    "conv": "branch1x1",
+    "tower/conv": "branch7x7_1",
+    "tower/conv_1": "branch7x7_2",
+    "tower/conv_2": "branch7x7_3",
+    "tower_1/conv": "branch7x7dbl_1",
+    "tower_1/conv_1": "branch7x7dbl_2",
+    "tower_1/conv_2": "branch7x7dbl_3",
+    "tower_1/conv_3": "branch7x7dbl_4",
+    "tower_1/conv_4": "branch7x7dbl_5",
+    "tower_2/conv": "branch_pool",
+}
+_BRANCHES_RB = {
+    "tower/conv": "branch3x3_1",
+    "tower/conv_1": "branch3x3_2",
+    "tower_1/conv": "branch7x7x3_1",
+    "tower_1/conv_1": "branch7x7x3_2",
+    "tower_1/conv_2": "branch7x7x3_3",
+    "tower_1/conv_3": "branch7x7x3_4",
+}
+_BRANCHES_C = {
+    "conv": "branch1x1",
+    "tower/conv": "branch3x3_1",
+    "tower/mixed/conv": "branch3x3_2a",
+    "tower/mixed/conv_1": "branch3x3_2b",
+    "tower_1/conv": "branch3x3dbl_1",
+    "tower_1/conv_1": "branch3x3dbl_2",
+    "tower_1/mixed/conv": "branch3x3dbl_3a",
+    "tower_1/mixed/conv_1": "branch3x3dbl_3b",
+    "tower_2/conv": "branch_pool",
+}
+_BLOCK_BRANCHES = [
+    _BRANCHES_A, _BRANCHES_A, _BRANCHES_A,  # mixed, mixed_1, mixed_2
+    _BRANCHES_RA,                           # mixed_3
+    _BRANCHES_B, _BRANCHES_B, _BRANCHES_B, _BRANCHES_B,  # mixed_4..7
+    _BRANCHES_RB,                           # mixed_8
+    _BRANCHES_C, _BRANCHES_C,               # mixed_9, mixed_10
+]
+
+
+def inception_2015_name_map() -> dict[str, tuple[str, ...]]:
+    """pb conv scope → flax module path (under the top-level model), e.g.
+    ``'mixed_4/tower/conv_1' → ('Mixed_6b', 'branch7x7_2')`` and
+    ``'conv' → ('Conv2d_1a_3x3',)``."""
+    out: dict[str, tuple[str, ...]] = {}
+    for pb, ours in _STEM.items():
+        out[pb] = (ours,)
+    for i, branches in enumerate(_BLOCK_BRANCHES):
+        prefix = "mixed" if i == 0 else f"mixed_{i}"
+        block = _MIXED_BLOCKS[i]
+        for pb, ours in branches.items():
+            out[f"{prefix}/{pb}"] = (block, ours)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Importer.
+# ---------------------------------------------------------------------------
+
+
+def import_inception_graphdef(
+    source: str | bytes,
+    model=None,
+    image_size: int | None = None,
+    strict: bool = True,
+):
+    """Build Inception-v3 flax variables from a 2015-format frozen GraphDef.
+
+    Args:
+      source: path to ``classify_image_graph_def.pb`` or raw serialized bytes.
+      model: an :class:`~.inception_v3.InceptionV3`; default 1008-class model.
+      image_size: template init size (trace-only; any size works).
+      strict: raise if a conv kernel or batchnorm stat the model needs is
+        absent or shape-mismatched. ``gamma`` is always optional (ones).
+
+    Returns:
+      (variables, report) — variables is ``{'params', 'batch_stats'}`` with
+      numpy leaves; report maps ``loaded``/``defaulted``/``unused`` to name
+      lists for caller-side logging.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models import inception_v3 as iv3
+
+    if isinstance(source, (bytes, bytearray, memoryview)):
+        data = bytes(source)
+    else:
+        with open(source, "rb") as f:
+            data = f.read()
+    consts = parse_graphdef_consts(data)
+    if not consts:
+        raise ValueError("no Const nodes found — not a frozen GraphDef?")
+
+    if model is None:
+        model = iv3.create_model()
+    size = image_size or iv3.INPUT_SIZE
+    template = jax.eval_shape(
+        model.init,
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, size, size, iv3.INPUT_DEPTH), jnp.float32),
+    )
+
+    loaded: list[str] = []
+    defaulted: list[str] = []
+    used: set[str] = set()
+
+    def take(pb_name: str, want_shape: tuple[int, ...], default=None) -> np.ndarray:
+        arr = consts.get(pb_name)
+        if arr is not None:
+            used.add(pb_name)
+            if tuple(arr.shape) == tuple(want_shape):
+                loaded.append(pb_name)
+                return arr.astype(np.float32)
+            if strict:
+                raise ValueError(
+                    f"{pb_name}: shape {tuple(arr.shape)} != expected {tuple(want_shape)}"
+                )
+            # non-strict: fall through to the default fill below
+        elif default is None and strict:
+            raise KeyError(f"missing Const node {pb_name!r} in GraphDef")
+        defaulted.append(pb_name)
+        return np.full(want_shape, 0.0 if default is None else default, np.float32)
+
+    params: dict[str, Any] = {}
+    stats: dict[str, Any] = {}
+
+    def fill_convbn(pb_scope: str, path: tuple[str, ...]) -> None:
+        tp = template["params"]
+        ts = template["batch_stats"]
+        for p in path:
+            tp, ts = tp[p], ts[p]
+        kshape = tuple(tp["conv"]["kernel"].shape)
+        c = kshape[-1]
+        node_p = {
+            "conv": {"kernel": take(f"{pb_scope}/conv2d_params", kshape)},
+            "bn": {
+                "scale": take(f"{pb_scope}/batchnorm/gamma", (c,), default=1.0),
+                "bias": take(f"{pb_scope}/batchnorm/beta", (c,)),
+            },
+        }
+        node_s = {
+            "bn": {
+                "mean": take(f"{pb_scope}/batchnorm/moving_mean", (c,)),
+                "var": take(f"{pb_scope}/batchnorm/moving_variance", (c,)),
+            }
+        }
+        dp, ds = params, stats
+        for p in path[:-1]:
+            dp = dp.setdefault(p, {})
+            ds = ds.setdefault(p, {})
+        dp[path[-1]] = node_p
+        ds[path[-1]] = node_s
+
+    for pb_scope, path in inception_2015_name_map().items():
+        fill_convbn(pb_scope, path)
+
+    head = template["params"].get("logits")
+    if head is not None:
+        kshape = tuple(head["kernel"].shape)
+        mark = (len(loaded), len(defaulted))
+        try:
+            params["logits"] = {
+                "kernel": take("softmax/weights", kshape),
+                "bias": take("softmax/biases", (kshape[-1],)),
+            }
+        except (KeyError, ValueError):
+            if strict and tuple(kshape)[-1] == iv3.NUM_CLASSES_2015:
+                raise
+            # Custom-class-count model: head is freshly trained anyway. Roll
+            # back any partial bookkeeping so each name is reported once.
+            del loaded[mark[0]:], defaulted[mark[1]:]
+            params["logits"] = {
+                "kernel": np.zeros(kshape, np.float32),
+                "bias": np.zeros((kshape[-1],), np.float32),
+            }
+            defaulted += ["softmax/weights", "softmax/biases"]
+
+    report = {
+        "loaded": loaded,
+        "defaulted": defaulted,
+        "unused": sorted(set(consts) - used),
+    }
+    return {"params": params, "batch_stats": stats}, report

@@ -130,3 +130,62 @@ def load_inference_bundle(path: str, template: Any | None = None):
 def load_labels(path: str) -> list[str]:
     with open(path) as fh:
         return [ln.rstrip("\n") for ln in fh if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# Frozen StableHLO export — the closest TPU-native analog of the reference's
+# ``graph_util.convert_variables_to_constants`` (`retrain1/retrain.py:470-473`):
+# one self-contained compiled-program artifact with the weights baked in as
+# constants, loadable and runnable without the model's Python code.
+# ---------------------------------------------------------------------------
+
+
+def export_frozen_stablehlo(
+    path: str,
+    fn,
+    example_args: tuple,
+    metadata: dict | None = None,
+    platforms: tuple[str, ...] = ("cpu", "tpu"),
+    polymorphic_batch: bool = True,
+) -> None:
+    """Serialize ``jit(fn)`` (params already closed over / baked in) traced at
+    ``example_args``'s shapes to a portable StableHLO artifact via
+    ``jax.export``. Multi-platform by default so an artifact exported on TPU
+    still runs on CPU (and vice versa). With ``polymorphic_batch`` the leading
+    axis of every non-scalar arg becomes one shared symbolic dim, so the
+    loaded program accepts any batch size (the frozen .pb took any batch too)."""
+    from jax import export as jax_export
+
+    batch_dim = jax_export.symbolic_shape("b")[0] if polymorphic_batch else None
+
+    def spec(a):
+        shape = np.shape(a)
+        if batch_dim is not None and len(shape) >= 1:
+            shape = (batch_dim,) + tuple(shape[1:])
+        return jax.ShapeDtypeStruct(shape, np.asarray(a).dtype)
+
+    specs = jax.tree_util.tree_map(spec, example_args)
+    exported = jax_export.export(jax.jit(fn), platforms=list(platforms))(*specs)
+    blob = exported.serialize()
+    header = json.dumps(
+        {"format": "dtf_tpu.stablehlo.v1", "platforms": list(platforms), **(metadata or {})}
+    ).encode()
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path, "wb") as fh:
+        fh.write(len(header).to_bytes(8, "little"))
+        fh.write(header)
+        fh.write(bytes(blob))
+
+
+def load_frozen_stablehlo(path: str):
+    """Returns (callable, metadata): the deserialized exported program. The
+    callable jit-executes on the current default backend — no model code or
+    params needed, exactly like loading the reference's frozen ``.pb``."""
+    from jax import export as jax_export
+
+    with open(path, "rb") as fh:
+        hlen = int.from_bytes(fh.read(8), "little")
+        metadata = json.loads(fh.read(hlen).decode())
+        blob = fh.read()
+    exported = jax_export.deserialize(bytearray(blob))
+    return exported.call, metadata

@@ -52,10 +52,25 @@ log = get_logger(__name__)
 
 
 def build_extractor(cfg: RetrainConfig, image_size: int = iv3.INPUT_SIZE):
-    """Feature extractor with weights from ``--model_dir`` when a converted
-    bundle is present (``inception_v3.msgpack`` / ``.npz``), else random init
-    (this environment cannot download the 2015 .pb — no egress)."""
+    """Feature extractor with weights from ``--model_dir``: the reference's
+    own ``classify_image_graph_def.pb`` (read TF-free by
+    ``models.graphdef_import`` — full parity with ``retrain1/retrain.py:66-74``),
+    a converted bundle (``inception_v3.msgpack`` / ``.npz``), or random init
+    when neither is present (this environment cannot download — no egress)."""
     model = iv3.create_model()
+    pb_path = os.path.join(cfg.model_dir, "classify_image_graph_def.pb")
+    if os.path.exists(pb_path):
+        from distributed_tensorflow_tpu.models.graphdef_import import (
+            import_inception_graphdef,
+        )
+
+        log.info("importing frozen GraphDef weights from %s", pb_path)
+        variables, report = import_inception_graphdef(pb_path, model=model)
+        log.info(
+            "GraphDef import: %d tensors loaded, %d defaulted",
+            len(report["loaded"]), len(report["defaulted"]),
+        )
+        return B.FeatureExtractor(model, variables, image_size)
     for name in ("inception_v3.msgpack", "inception_v3.npz"):
         path = os.path.join(cfg.model_dir, name)
         if os.path.exists(path):
@@ -285,3 +300,20 @@ class RetrainTrainer:
             },
         )
         log.info("exported %s and %s", cfg.output_graph, cfg.output_labels)
+        if cfg.export_stablehlo:
+            from distributed_tensorflow_tpu.train.checkpoint import export_frozen_stablehlo
+
+            params = jax.device_get(self.params)
+            head = self.head
+
+            def frozen_scores(bottlenecks):
+                return jax.nn.softmax(head.apply({"params": params}, bottlenecks), -1)
+
+            hlo_path = cfg.output_graph + ".stablehlo"
+            export_frozen_stablehlo(
+                hlo_path,
+                frozen_scores,
+                (np.zeros((1, iv3.BOTTLENECK_SIZE), np.float32),),
+                metadata={"num_classes": self.class_count},
+            )
+            log.info("exported frozen StableHLO program %s", hlo_path)

@@ -1,0 +1,113 @@
+"""Shared protocol-buffers wire-format primitives (no protobuf dependency).
+
+One implementation for every proto producer/consumer in the framework: the
+TensorBoard event writer (``utils/summary.py``) encodes Event/Summary protos,
+and the GraphDef importer (``models/graphdef_import.py``) decodes the 2015
+Inception ``.pb``. Wire types: 0 = varint, 1 = 64-bit, 2 = length-delimited,
+5 = 32-bit.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Iterator
+
+__all__ = [
+    "varint",
+    "read_varint",
+    "tag",
+    "field_varint",
+    "field_bytes",
+    "field_float",
+    "field_double",
+    "field_packed_doubles",
+    "iter_fields",
+]
+
+
+def varint(value: int) -> bytes:
+    if value < 0:
+        value &= 0xFFFFFFFFFFFFFFFF  # two's-complement 64-bit encoding
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def tag(field: int, wire_type: int) -> bytes:
+    return varint((field << 3) | wire_type)
+
+
+def field_varint(field: int, value: int) -> bytes:
+    return tag(field, 0) + varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def field_bytes(field: int, value: bytes) -> bytes:
+    return tag(field, 2) + varint(len(value)) + value
+
+
+def field_float(field: int, value: float) -> bytes:
+    return tag(field, 5) + struct.pack("<f", value)
+
+
+def field_double(field: int, value: float) -> bytes:
+    return tag(field, 1) + struct.pack("<d", value)
+
+
+def field_packed_doubles(field: int, values) -> bytes:
+    return field_bytes(field, b"".join(struct.pack("<d", float(v)) for v in values))
+
+
+def iter_fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, value) over one message's bytes.
+
+    Length-delimited values are returned as ``bytes`` slices; varints as int;
+    fixed32/64 as raw 4/8-byte chunks.
+    """
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            value, pos = read_varint(buf, pos)
+        elif wire == 2:
+            length, pos = read_varint(buf, pos)
+            if pos + length > n:
+                raise ValueError(f"truncated field {field}")
+            value = buf[pos : pos + length]
+            pos += length
+        elif wire == 5:
+            if pos + 4 > n:
+                raise ValueError(f"truncated field {field}")
+            value = buf[pos : pos + 4]
+            pos += 4
+        elif wire == 1:
+            if pos + 8 > n:
+                raise ValueError(f"truncated field {field}")
+            value = buf[pos : pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire} (field {field})")
+        yield field, wire, value

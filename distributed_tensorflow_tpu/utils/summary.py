@@ -28,6 +28,7 @@ import time
 import numpy as np
 
 from distributed_tensorflow_tpu import _native
+from distributed_tensorflow_tpu.utils import protowire as _pw
 
 # ---------------------------------------------------------------------------
 # CRC32C (Castagnoli), table-driven, with the TFRecord masking scheme.
@@ -67,45 +68,16 @@ def masked_crc32c(data: bytes) -> int:
 
 
 # ---------------------------------------------------------------------------
-# Minimal protobuf wire-format encoders.
+# Protobuf wire-format encoders — shared implementation in utils/protowire.py
+# (also consumed by the GraphDef importer's reader side).
 # ---------------------------------------------------------------------------
 
-
-def _varint(value: int) -> bytes:
-    out = bytearray()
-    while True:
-        bits = value & 0x7F
-        value >>= 7
-        if value:
-            out.append(bits | 0x80)
-        else:
-            out.append(bits)
-            return bytes(out)
-
-
-def _tag(field: int, wire_type: int) -> bytes:
-    return _varint((field << 3) | wire_type)
-
-
-def _f_double(field: int, value: float) -> bytes:
-    return _tag(field, 1) + struct.pack("<d", value)
-
-
-def _f_float(field: int, value: float) -> bytes:
-    return _tag(field, 5) + struct.pack("<f", value)
-
-
-def _f_varint(field: int, value: int) -> bytes:
-    return _tag(field, 0) + _varint(value & 0xFFFFFFFFFFFFFFFF)
-
-
-def _f_bytes(field: int, value: bytes) -> bytes:
-    return _tag(field, 2) + _varint(len(value)) + value
-
-
-def _f_packed_doubles(field: int, values) -> bytes:
-    payload = b"".join(struct.pack("<d", float(v)) for v in values)
-    return _f_bytes(field, payload)
+_varint = _pw.varint
+_f_double = _pw.field_double
+_f_float = _pw.field_float
+_f_varint = _pw.field_varint
+_f_bytes = _pw.field_bytes
+_f_packed_doubles = _pw.field_packed_doubles
 
 
 def encode_histogram(values: np.ndarray) -> bytes:

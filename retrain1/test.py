@@ -36,15 +36,36 @@ def main(argv=None):
     args, _ = parser.parse_known_args(argv)
 
     labels = load_labels(args.labels)  # id → name map, retrain1/test.py:10-16
-    head = BottleneckHead(num_classes=len(labels))
-    template = head.init(jax.random.PRNGKey(0), jnp.zeros((1, iv3.BOTTLENECK_SIZE)))["params"]
-    head_params, _ = load_inference_bundle(args.graph, template=template)
+    if args.graph.endswith(".stablehlo"):
+        # Frozen-program path: weights baked into the artifact, no model code
+        # (exact analog of the reference importing the frozen .pb).
+        from distributed_tensorflow_tpu.train.checkpoint import load_frozen_stablehlo
+
+        frozen_call, frozen_meta = load_frozen_stablehlo(args.graph)
+        baked = frozen_meta.get("num_classes")
+        if baked is not None and baked != len(labels):
+            sys.exit(
+                f"{args.graph} was exported with {baked} classes but "
+                f"{args.labels} lists {len(labels)} — wrong labels file?"
+            )
+
+        def scores_fn(hp, bottlenecks):
+            del hp
+            return frozen_call(np.asarray(bottlenecks, np.float32))
+
+        head_params = None
+    else:
+        head = BottleneckHead(num_classes=len(labels))
+        template = head.init(jax.random.PRNGKey(0), jnp.zeros((1, iv3.BOTTLENECK_SIZE)))[
+            "params"
+        ]
+        head_params, _ = load_inference_bundle(args.graph, template=template)
+
+        @jax.jit
+        def scores_fn(hp, bottlenecks):
+            return jax.nn.softmax(head.apply({"params": hp}, bottlenecks), -1)
 
     extractor = retrain_loop.build_extractor(RetrainConfig(model_dir=args.model_dir))
-
-    @jax.jit
-    def scores_fn(hp, bottlenecks):
-        return jax.nn.softmax(head.apply({"params": hp}, bottlenecks), -1)
 
     # Featurize every image in ONE batched Inception pass (the reference fed
     # images one sess.run at a time, retrain1/test.py:38-39).

@@ -1,0 +1,257 @@
+"""GraphDef importer tests (SURVEY §7 hard part (a)): wire-format parse,
+2015-pb name mapping onto the flax Inception-v3 tree, gamma defaulting,
+strictness, and an end-to-end apply with imported weights."""
+
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import graphdef_import as gd
+from distributed_tensorflow_tpu.models import inception_v3 as iv3
+
+
+@pytest.fixture(scope="module")
+def model():
+    return iv3.create_model(compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def template(model):
+    return jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), jnp.zeros((1, 96, 96, 3), jnp.float32)
+    )
+
+
+def _synthetic_consts(template, rng, include_gamma=True):
+    """Random tensors for every Const node the 2015 pb would carry, with
+    shapes taken from the flax template tree."""
+    consts = {}
+    for pb_scope, path in gd.inception_2015_name_map().items():
+        tp = template["params"]
+        for p in path:
+            tp = tp[p]
+        kshape = tuple(tp["conv"]["kernel"].shape)
+        c = kshape[-1]
+        consts[f"{pb_scope}/conv2d_params"] = rng.standard_normal(kshape).astype(
+            np.float32
+        ) * 0.05
+        if include_gamma:
+            consts[f"{pb_scope}/batchnorm/gamma"] = np.ones(c, np.float32)
+        consts[f"{pb_scope}/batchnorm/beta"] = np.zeros(c, np.float32)
+        consts[f"{pb_scope}/batchnorm/moving_mean"] = rng.standard_normal(c).astype(
+            np.float32
+        ) * 0.01
+        consts[f"{pb_scope}/batchnorm/moving_variance"] = np.ones(c, np.float32)
+    kshape = tuple(template["params"]["logits"]["kernel"].shape)
+    consts["softmax/weights"] = rng.standard_normal(kshape).astype(np.float32) * 0.01
+    consts["softmax/biases"] = np.zeros(kshape[-1], np.float32)
+    return consts
+
+
+def test_wire_roundtrip():
+    rng = np.random.default_rng(0)
+    consts = {
+        "a/b": rng.standard_normal((3, 3, 2, 4)).astype(np.float32),
+        "c": np.arange(5, dtype=np.int32),
+        "scalar": np.float32(2.5).reshape(()),
+        "i64": np.asarray([1, -2, 3], np.int64),
+    }
+    parsed = gd.parse_graphdef_consts(gd.serialize_graphdef_consts(consts))
+    assert set(parsed) == set(consts)
+    for k in consts:
+        np.testing.assert_array_equal(parsed[k], consts[k])
+        assert parsed[k].dtype == consts[k].dtype
+        assert parsed[k].shape == consts[k].shape  # incl. scalar () fidelity
+
+
+def test_non_const_nodes_skipped():
+    # A node with op != Const must be ignored even if it carries a tensor attr.
+    blob = gd.serialize_graphdef_consts({"w": np.ones(2, np.float32)})
+    other = gd._field(1, 2, gd._field(1, 2, b"relu") + gd._field(2, 2, b"Relu"))
+    parsed = gd.parse_graphdef_consts(blob + other)
+    assert set(parsed) == {"w"}
+
+
+def test_scalar_broadcast_fill():
+    # TF semantics: single float_val broadcasts over the declared shape.
+    shape = gd._field(2, 2, gd._field(1, 0, 4))
+    tensor = (
+        gd._field(1, 0, 1)  # DT_FLOAT
+        + gd._field(2, 2, shape)
+        + gd._field(5, 2, struct.pack("<f", 3.0))  # packed float_val, one elem
+    )
+    attr = gd._field(1, 2, b"value") + gd._field(2, 2, gd._field(8, 2, tensor))
+    node = gd._field(1, 2, b"k") + gd._field(2, 2, b"Const") + gd._field(5, 2, attr)
+    parsed = gd.parse_graphdef_consts(gd._field(1, 2, node))
+    np.testing.assert_array_equal(parsed["k"], np.full(4, 3.0, np.float32))
+
+
+def test_truncated_raises():
+    blob = gd.serialize_graphdef_consts({"w": np.ones(8, np.float32)})
+    with pytest.raises(ValueError):
+        gd.parse_graphdef_consts(blob[:-3])
+
+
+def test_name_map_covers_all_blocks():
+    m = gd.inception_2015_name_map()
+    # 5 stem convs + 3 A-blocks x7 + RA x4 + 4 B-blocks x10 + RB x6 + 2 C x9
+    assert len(m) == 5 + 3 * 7 + 4 + 4 * 10 + 6 + 2 * 9
+    assert m["conv"] == ("Conv2d_1a_3x3",)
+    assert m["mixed_4/tower/conv_1"] == ("Mixed_6b", "branch7x7_2")
+    assert m["mixed_10/tower_1/mixed/conv_1"] == ("Mixed_7c", "branch3x3dbl_3b")
+
+
+def test_full_import_and_apply(model, template):
+    rng = np.random.default_rng(1)
+    consts = _synthetic_consts(template, rng)
+    blob = gd.serialize_graphdef_consts(consts)
+    variables, report = gd.import_inception_graphdef(blob, model=model, image_size=96)
+    assert not report["defaulted"]
+    assert not report["unused"]
+    # Spot-check mapping: pb scope mixed_4/tower/conv_1 → Mixed_6b/branch7x7_2.
+    np.testing.assert_array_equal(
+        variables["params"]["Mixed_6b"]["branch7x7_2"]["conv"]["kernel"],
+        consts["mixed_4/tower/conv_1/conv2d_params"],
+    )
+    np.testing.assert_array_equal(
+        variables["batch_stats"]["Conv2d_1a_3x3"]["bn"]["mean"],
+        consts["conv/batchnorm/moving_mean"],
+    )
+    np.testing.assert_array_equal(
+        variables["params"]["logits"]["kernel"], consts["softmax/weights"]
+    )
+    # Tree structure matches the model's own init exactly.
+    init_vars = iv3.init_params(model, image_size=96)
+    chex_paths = jax.tree_util.tree_structure(jax.tree.map(np.asarray, init_vars))
+    assert jax.tree_util.tree_structure(variables) == chex_paths
+    # And the model runs with the imported weights.
+    x = iv3.preprocess(np.random.default_rng(2).integers(0, 255, (1, 96, 96, 3)))
+    b = model.apply(variables, x, return_bottleneck=True)
+    assert b.shape == (1, iv3.BOTTLENECK_SIZE)
+    assert np.all(np.isfinite(np.asarray(b)))
+
+
+def test_gamma_defaults_to_ones(model, template):
+    rng = np.random.default_rng(3)
+    consts = _synthetic_consts(template, rng, include_gamma=False)
+    variables, report = gd.import_inception_graphdef(
+        gd.serialize_graphdef_consts(consts), model=model, image_size=96
+    )
+    assert any(n.endswith("batchnorm/gamma") for n in report["defaulted"])
+    np.testing.assert_array_equal(
+        variables["params"]["Mixed_5b"]["branch1x1"]["bn"]["scale"],
+        np.ones_like(variables["params"]["Mixed_5b"]["branch1x1"]["bn"]["scale"]),
+    )
+
+
+def test_strict_missing_kernel_raises(model, template):
+    rng = np.random.default_rng(4)
+    consts = _synthetic_consts(template, rng)
+    del consts["mixed_7/tower_1/conv_3/conv2d_params"]
+    blob = gd.serialize_graphdef_consts(consts)
+    with pytest.raises(KeyError):
+        gd.import_inception_graphdef(blob, model=model, image_size=96)
+    variables, report = gd.import_inception_graphdef(
+        blob, model=model, image_size=96, strict=False
+    )
+    assert "mixed_7/tower_1/conv_3/conv2d_params" in report["defaulted"]
+
+
+def test_shape_mismatch_raises(model, template):
+    rng = np.random.default_rng(5)
+    consts = _synthetic_consts(template, rng)
+    consts["conv/conv2d_params"] = np.zeros((1, 1, 3, 32), np.float32)
+    with pytest.raises(ValueError):
+        gd.import_inception_graphdef(
+            gd.serialize_graphdef_consts(consts), model=model, image_size=96
+        )
+
+
+def test_custom_head_skips_softmax(model, template):
+    """A model with a non-1008 head imports trunk weights and zero-fills the
+    head (it gets trained fresh in the retrain pipeline anyway)."""
+    rng = np.random.default_rng(6)
+    consts = _synthetic_consts(template, rng)
+    small = iv3.create_model(num_classes=5, compute_dtype=jnp.float32)
+    variables, report = gd.import_inception_graphdef(
+        gd.serialize_graphdef_consts(consts), model=small, image_size=96
+    )
+    assert variables["params"]["logits"]["kernel"].shape == (iv3.BOTTLENECK_SIZE, 5)
+    assert "softmax/weights" in report["defaulted"]
+
+
+def test_unsupported_dtype_const_skipped():
+    """The real 2015 pb carries a DT_STRING Const (DecodeJpeg/contents) —
+    non-weight Consts of unimportable dtypes are skipped, never fatal."""
+    tensor = (
+        gd._field(1, 0, 7)  # DT_STRING
+        + gd._field(8, 2, gd._field(1, 2, b"\xff\xd8jpegbytes"))  # string_val
+    )
+    attr = gd._field(1, 2, b"value") + gd._field(2, 2, gd._field(8, 2, tensor))
+    node = (
+        gd._field(1, 2, b"DecodeJpeg/contents")
+        + gd._field(2, 2, b"Const")
+        + gd._field(5, 2, attr)
+    )
+    blob = gd._field(1, 2, node) + gd.serialize_graphdef_consts(
+        {"w": np.ones(2, np.float32)}
+    )
+    parsed = gd.parse_graphdef_consts(blob)
+    assert set(parsed) == {"w"}
+
+
+def test_unpacked_negative_int_varints():
+    """Unpacked repeated int64_val entries (legal proto encoding) must get the
+    same two's-complement decode as the packed path."""
+    shape = gd._field(2, 2, gd._field(1, 0, 2))
+    neg = (1 << 64) - 3  # varint encoding of int64 -3
+    tensor = (
+        gd._field(1, 0, 9)  # DT_INT64
+        + gd._field(2, 2, shape)
+        + gd._field(10, 0, 5)  # unpacked int64_val: 5
+        + gd._field(10, 0, neg)  # unpacked int64_val: -3
+    )
+    attr = gd._field(1, 2, b"value") + gd._field(2, 2, gd._field(8, 2, tensor))
+    node = gd._field(1, 2, b"shape") + gd._field(2, 2, b"Const") + gd._field(5, 2, attr)
+    parsed = gd.parse_graphdef_consts(gd._field(1, 2, node))
+    np.testing.assert_array_equal(parsed["shape"], np.asarray([5, -3], np.int64))
+
+
+def test_nonstrict_shape_mismatch_defaults(model, template):
+    rng = np.random.default_rng(7)
+    consts = _synthetic_consts(template, rng)
+    consts["conv/conv2d_params"] = np.zeros((1, 1, 3, 32), np.float32)
+    variables, report = gd.import_inception_graphdef(
+        gd.serialize_graphdef_consts(consts), model=model, image_size=96, strict=False
+    )
+    assert "conv/conv2d_params" in report["defaulted"]
+    assert "conv/conv2d_params" not in report["loaded"]
+    assert variables["params"]["Conv2d_1a_3x3"]["conv"]["kernel"].shape == (3, 3, 3, 32)
+
+
+def test_truncated_fixed32_raises():
+    # Unpacked float_val (wire type 5) cut mid-value must raise ValueError,
+    # same as length-delimited truncation.
+    tensor = gd._field(1, 0, 1) + gd.pw.tag(5, 5) + b"\x00\x00"  # 2 of 4 bytes
+    attr = gd._field(1, 2, b"value") + gd._field(2, 2, gd._field(8, 2, tensor))
+    node = gd._field(1, 2, b"k") + gd._field(2, 2, b"Const") + gd._field(5, 2, attr)
+    with pytest.raises(ValueError):
+        gd.parse_graphdef_consts(gd._field(1, 2, node))
+
+
+def test_custom_head_report_counts_consistent(model, template):
+    """Partial softmax (weights present, biases missing) into a custom-head
+    model: no name may appear in both loaded and defaulted."""
+    rng = np.random.default_rng(8)
+    consts = _synthetic_consts(template, rng)
+    del consts["softmax/biases"]
+    small = iv3.create_model(num_classes=4, compute_dtype=jnp.float32)
+    variables, report = gd.import_inception_graphdef(
+        gd.serialize_graphdef_consts(consts), model=small, image_size=96
+    )
+    assert "softmax/weights" not in report["loaded"]
+    assert set(report["loaded"]).isdisjoint(report["defaulted"])
+    assert variables["params"]["logits"]["kernel"].shape == (iv3.BOTTLENECK_SIZE, 4)

@@ -119,3 +119,31 @@ def test_empty_dataset_aborts(tmp_path):
     cfg = _cfg(tmp_path, image_dir=str(tmp_path / "empty"))
     with pytest.raises(ValueError, match="No valid folders"):
         RetrainTrainer(cfg, mesh=make_mesh(num_devices=1), extractor=ColorExtractor())
+
+
+def test_build_extractor_imports_graphdef(tmp_path):
+    """Dropping the reference's classify_image_graph_def.pb into --model_dir
+    loads its weights (retrain1/retrain.py:66-74 parity, TF-free)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models import graphdef_import as gd
+    from distributed_tensorflow_tpu.models import inception_v3 as iv3
+    from distributed_tensorflow_tpu.train.retrain_loop import build_extractor
+    from tests.test_graphdef_import import _synthetic_consts
+
+    model = iv3.create_model()
+    template = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), jnp.zeros((1, 96, 96, 3), jnp.float32)
+    )
+    consts = _synthetic_consts(template, np.random.default_rng(0))
+
+    model_dir = tmp_path / "model"
+    model_dir.mkdir()
+    (model_dir / "classify_image_graph_def.pb").write_bytes(
+        gd.serialize_graphdef_consts(consts)
+    )
+    cfg = _cfg(tmp_path, model_dir=str(model_dir))
+    extractor = build_extractor(cfg, image_size=96)
+    got = extractor.variables["params"]["Conv2d_1a_3x3"]["conv"]["kernel"]
+    np.testing.assert_array_equal(np.asarray(got), consts["conv/conv2d_params"])

@@ -1,0 +1,107 @@
+"""Frozen StableHLO export tests — the TPU-native analog of the reference's
+``convert_variables_to_constants`` frozen-graph export
+(``retrain1/retrain.py:470-475``): params baked into one serialized program,
+loadable and runnable without model code."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.head import BottleneckHead
+from distributed_tensorflow_tpu.train.checkpoint import (
+    export_frozen_stablehlo,
+    load_frozen_stablehlo,
+)
+
+
+@pytest.fixture(scope="module")
+def head_and_params():
+    head = BottleneckHead(num_classes=3)
+    params = head.init(jax.random.PRNGKey(0), jnp.zeros((1, 2048)))["params"]
+    return head, jax.device_get(params)
+
+
+def test_roundtrip_matches_live_apply(tmp_path, head_and_params):
+    head, params = head_and_params
+
+    def scores(b):
+        return jax.nn.softmax(head.apply({"params": params}, b), -1)
+
+    path = str(tmp_path / "frozen.stablehlo")
+    export_frozen_stablehlo(
+        path, scores, (np.zeros((4, 2048), np.float32),), metadata={"num_classes": 3}
+    )
+    call, meta = load_frozen_stablehlo(path)
+    assert meta["num_classes"] == 3
+    assert meta["format"] == "dtf_tpu.stablehlo.v1"
+    x = np.random.default_rng(0).standard_normal((4, 2048)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(call(x)), np.asarray(scores(x)), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_polymorphic_batch(tmp_path, head_and_params):
+    head, params = head_and_params
+
+    def scores(b):
+        return jax.nn.softmax(head.apply({"params": params}, b), -1)
+
+    path = str(tmp_path / "frozen.stablehlo")
+    export_frozen_stablehlo(path, scores, (np.zeros((1, 2048), np.float32),))
+    call, _ = load_frozen_stablehlo(path)
+    for batch in (1, 2, 7):
+        x = np.random.default_rng(batch).standard_normal((batch, 2048)).astype(np.float32)
+        out = np.asarray(call(x))
+        assert out.shape == (batch, 3)
+        np.testing.assert_allclose(out.sum(-1), np.ones(batch), rtol=1e-5)
+
+
+def test_static_shape_rejects_other_batch(tmp_path, head_and_params):
+    head, params = head_and_params
+
+    def scores(b):
+        return head.apply({"params": params}, b)
+
+    path = str(tmp_path / "frozen.stablehlo")
+    export_frozen_stablehlo(
+        path, scores, (np.zeros((2, 2048), np.float32),), polymorphic_batch=False
+    )
+    call, _ = load_frozen_stablehlo(path)
+    assert np.asarray(call(np.zeros((2, 2048), np.float32))).shape == (2, 3)
+    with pytest.raises(ValueError):
+        call(np.zeros((3, 2048), np.float32))
+
+
+def test_params_are_baked_in(tmp_path):
+    """Mutating params after export must not change the artifact's output —
+    the 'variables to constants' property."""
+    head = BottleneckHead(num_classes=2)
+    params = jax.device_get(head.init(jax.random.PRNGKey(1), jnp.zeros((1, 8)))["params"])
+
+    def logits(b):
+        return head.apply({"params": params}, b)
+
+    x = np.ones((2, 8), np.float32)
+    path = str(tmp_path / "frozen.stablehlo")
+    export_frozen_stablehlo(path, logits, (x,))
+    before = np.asarray(logits(x))
+    params["final"]["bias"] = params["final"]["bias"] + 100.0
+    call, _ = load_frozen_stablehlo(path)
+    np.testing.assert_allclose(np.asarray(call(x)), before, rtol=1e-5, atol=1e-6)
+
+
+def test_retrain_loop_exports_stablehlo(tmp_path):
+    """--export_stablehlo wires through RetrainTrainer.export()."""
+    from tests.test_retrain import ColorExtractor, _cfg
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+    from distributed_tensorflow_tpu.train.retrain_loop import RetrainTrainer
+
+    cfg = _cfg(tmp_path, training_steps=10, export_stablehlo=True)
+    trainer = RetrainTrainer(cfg, mesh=make_mesh(num_devices=1), extractor=ColorExtractor())
+    trainer.train()
+    call, meta = load_frozen_stablehlo(cfg.output_graph + ".stablehlo")
+    assert meta["num_classes"] == 2
+    out = np.asarray(call(np.zeros((5, 2048), np.float32)))
+    assert out.shape == (5, 2)
+    np.testing.assert_allclose(out.sum(-1), np.ones(5), rtol=1e-5)
