@@ -93,6 +93,22 @@ class MnistTrainConfig:
         default=10, metadata={"help": "first traced step (after compile warmup)"}
     )
     profile_num_steps: int = field(default=5, metadata={"help": "traced step count"})
+    steps_per_call: int = field(
+        default=1,
+        metadata={
+            "help": "fuse k optimizer steps into one XLA dispatch (lax.scan) — "
+            "amortizes per-step host overhead; semantics identical to k "
+            "single steps"
+        },
+    )
+    device_data: bool = field(
+        default=False,
+        metadata={
+            "help": "keep the training set resident in HBM and sample batches "
+            "on device inside the fused program (uniform per-shard sampling "
+            "instead of epoch shuffling; fastest input path)"
+        },
+    )
 
 
 @dataclass
@@ -167,6 +183,15 @@ class RetrainConfig:
         metadata={
             "help": "also export a frozen StableHLO program next to "
             "--output_graph (closest analog of the reference's frozen .pb)"
+        },
+    )
+    model_download_url: str = field(
+        default="",
+        metadata={
+            "help": "when set and --model_dir has no weights, fetch+extract "
+            "this .tgz first (the reference always downloaded "
+            "inception-2015-12-05.tgz, retrain1/retrain.py:40-62; default off "
+            "because this environment has no egress)"
         },
     )
 

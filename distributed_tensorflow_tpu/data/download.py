@@ -1,0 +1,83 @@
+"""Model/dataset downloader — parity with the reference's
+``maybe_download_and_extract`` (``retrain1/retrain.py:40-62``): fetch a
+``.tgz`` with a progress meter if not already present, then extract into the
+destination directory. Pure stdlib (urllib + tarfile); works for any URL
+scheme urllib supports (https, file:// — the latter is what the offline test
+environment uses).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tarfile
+import urllib.request
+
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# The URL the reference hardcodes (retrain1/retrain.py:27).
+INCEPTION_2015_URL = (
+    "http://download.tensorflow.org/models/image/imagenet/inception-2015-12-05.tgz"
+)
+
+
+def ensure_dir_exists(dir_name: str) -> None:
+    os.makedirs(dir_name, exist_ok=True)
+
+
+def maybe_download_and_extract(
+    dest_directory: str,
+    url: str = INCEPTION_2015_URL,
+    progress: bool = True,
+) -> str:
+    """Download ``url`` into ``dest_directory`` (skipped when the archive is
+    already there) and extract it. Returns the archive path."""
+    ensure_dir_exists(dest_directory)
+    filename = url.split("/")[-1]
+    filepath = os.path.join(dest_directory, filename)
+    if not os.path.exists(filepath):
+
+        def _progress(count, block_size, total_size):
+            if not progress or total_size <= 0:
+                return
+            pct = min(100.0, float(count * block_size) / float(total_size) * 100.0)
+            sys.stdout.write(f"\r>> Downloading {filename} {pct:.1f}%")
+            sys.stdout.flush()
+
+        try:
+            filepath, _ = urllib.request.urlretrieve(url, filepath, _progress)
+        except Exception:
+            # Leave no partial archive behind — a corrupt .tgz would poison
+            # every later run's cache-hit check.
+            if os.path.exists(filepath):
+                os.remove(filepath)
+            raise
+        if progress:
+            sys.stdout.write("\n")
+        log.info(
+            "Successfully downloaded %s %d bytes.", filename, os.stat(filepath).st_size
+        )
+    try:
+        with tarfile.open(filepath, "r:gz") as tar:
+            # Refuse path traversal and link members (a symlink pointing
+            # outside dest would let later members write through it — the
+            # name-only realpath check cannot see that).
+            base = os.path.realpath(dest_directory)
+            for member in tar.getmembers():
+                if member.issym() or member.islnk():
+                    raise ValueError(f"link member not allowed: {member.name!r}")
+                target = os.path.realpath(os.path.join(dest_directory, member.name))
+                if not target.startswith(base + os.sep) and target != base:
+                    raise ValueError(f"unsafe tar member path: {member.name!r}")
+            try:
+                tar.extractall(dest_directory, filter="data")
+            except TypeError:  # filter= needs >=3.10.12/3.11.4; checks above
+                tar.extractall(dest_directory)
+    except (tarfile.TarError, OSError, EOFError):
+        # A cached-but-corrupt archive (e.g. a captive portal's HTML saved as
+        # .tgz) would otherwise cache-hit and fail on every later run.
+        os.remove(filepath)
+        raise
+    return filepath

@@ -1,0 +1,69 @@
+"""ImageNet label lookup for the 2015 Inception-v3 1008-way head.
+
+The reference ships (but never parses — its scripts only consume retrained
+labels) the two files the 2015 model was distributed with
+(``retrain1/inception_model/``):
+
+  * ``imagenet_2012_challenge_label_map_proto.pbtxt`` — text-proto mapping
+    the model's int output index (``target_class``) to a WordNet synset UID
+    (``target_class_string``, e.g. ``n01440764``);
+  * ``imagenet_synset_to_human_label_map.txt`` — tab-separated synset UID →
+    human-readable label.
+
+This module composes the two so raw 1008-class logits (e.g. from a GraphDef
+imported by ``models.graphdef_import``) print as human labels — the classic
+``classify_image.py`` workflow the 2015 bundle was built for.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+LABEL_MAP_PBTXT = "imagenet_2012_challenge_label_map_proto.pbtxt"
+SYNSET_TO_HUMAN = "imagenet_synset_to_human_label_map.txt"
+
+_ENTRY_RE = re.compile(
+    r"entry\s*\{[^}]*?target_class:\s*(\d+)[^}]*?"
+    r'target_class_string:\s*"([^"]+)"[^}]*?\}',
+    re.S,
+)
+
+
+def parse_label_map_pbtxt(text: str) -> dict[int, str]:
+    """target_class (model output index) → synset UID."""
+    return {int(cls): uid for cls, uid in _ENTRY_RE.findall(text)}
+
+
+def parse_synset_to_human(text: str) -> dict[str, str]:
+    """synset UID → human label (first line wins on duplicates)."""
+    out: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        uid, _, human = line.partition("\t")
+        out.setdefault(uid.strip(), human.strip())
+    return out
+
+
+class ImagenetLabels:
+    """node id → human-readable string (ids without an entry → ``''``)."""
+
+    def __init__(self, node_to_uid: dict[int, str], uid_to_human: dict[str, str]):
+        self._node_to_human = {
+            node: uid_to_human.get(uid, "") for node, uid in node_to_uid.items()
+        }
+
+    @classmethod
+    def from_dir(cls, model_dir: str) -> "ImagenetLabels":
+        with open(os.path.join(model_dir, LABEL_MAP_PBTXT)) as fh:
+            node_to_uid = parse_label_map_pbtxt(fh.read())
+        with open(os.path.join(model_dir, SYNSET_TO_HUMAN)) as fh:
+            uid_to_human = parse_synset_to_human(fh.read())
+        return cls(node_to_uid, uid_to_human)
+
+    def __len__(self) -> int:
+        return len(self._node_to_human)
+
+    def name(self, node_id: int) -> str:
+        return self._node_to_human.get(int(node_id), "")

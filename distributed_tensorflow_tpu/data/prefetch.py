@@ -20,7 +20,12 @@ import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator
 
-__all__ = ["Prefetcher", "batches_forever", "bounded_device_batches"]
+__all__ = [
+    "Prefetcher",
+    "batches_forever",
+    "bounded_device_batches",
+    "stacked_device_batches",
+]
 
 _SENTINEL = object()
 
@@ -136,5 +141,28 @@ def bounded_device_batches(dataset, batch_size: int, mesh, num_batches: int, dep
     return Prefetcher(
         itertools.islice(batches_forever(dataset, batch_size), num_batches),
         place_fn=lambda b: dp.shard_batch(b, mesh),
+        depth=depth,
+    )
+
+
+def stacked_device_batches(
+    dataset, batch_size: int, mesh, chunk_sizes: list[int], depth: int = 2
+) -> Prefetcher:
+    """Input pipeline for :func:`~..parallel.data_parallel.build_multi_step`:
+    for each k in ``chunk_sizes``, assemble k consecutive batches and place
+    them as one ``(k, B, ...)`` stacked device batch. The underlying example
+    stream is identical to ``bounded_device_batches`` with
+    ``sum(chunk_sizes)`` batches — fusion changes dispatch, not data order."""
+    from distributed_tensorflow_tpu.parallel import data_parallel as dp
+
+    source = batches_forever(dataset, batch_size)
+
+    def chunks():
+        for k in chunk_sizes:
+            yield [next(source) for _ in range(k)]
+
+    return Prefetcher(
+        chunks(),
+        place_fn=lambda bs: dp.stack_shard_batches(bs, mesh),
         depth=depth,
     )

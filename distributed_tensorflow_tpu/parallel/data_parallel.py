@@ -38,7 +38,12 @@ Batch = dict[str, jnp.ndarray]
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree fully-replicated over the mesh (params/opt state live in
     HBM once per device — the reference instead kept one copy on ps hosts and
-    shipped it over the network every step)."""
+    shipped it over the network every step).
+
+    Caveat: when a leaf is already a device array with a compatible sharding,
+    ``device_put`` may return it as-is (no copy). Donating the result to a
+    train step then invalidates the caller's original array. Keep initial
+    params host-side (numpy) if you need them after training starts."""
     sharding = NamedSharding(mesh, P())
     return jax.device_put(tree, sharding)
 
@@ -47,6 +52,47 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
     """Split dim 0 of every array over the 'data' axis."""
     sharding = NamedSharding(mesh, P(("data", "model")))
     return jax.device_put(batch, sharding)
+
+
+def _shard_index(data_axes: tuple[str, str]):
+    """Flat per-device index over the (data, model) axes — the one identity
+    used by both the dropout stream and the pool-sampling stream."""
+    return lax.axis_index(data_axes[0]) * lax.axis_size(data_axes[1]) + lax.axis_index(
+        data_axes[1]
+    )
+
+
+def _make_shard_step(
+    apply_fn: Callable,
+    tx,
+    loss_fn: Callable,
+    data_axes: tuple[str, str] = ("data", "model"),
+):
+    """The per-step SPMD body shared by :func:`build_train_step` (one step per
+    dispatch) and :func:`build_multi_step` (k steps per dispatch)."""
+
+    def _shard_step(params, opt_state, global_step, batch, rng):
+        # Distinct dropout noise per step (fold in the on-device global step —
+        # no per-step host-side key derivation/dispatch) and per shard.
+        shard_id = _shard_index(data_axes)
+        rng = jax.random.fold_in(jax.random.fold_in(rng, global_step), shard_id)
+
+        def compute_loss(p):
+            logits = apply_fn(
+                {"params": p}, batch["image"], train=True, rngs={"dropout": rng}
+            )
+            return loss_fn(logits, batch["label"]), logits
+
+        (loss, logits), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+        # THE collective: gradient mean over ICI (replaces worker->ps gRPC push).
+        grads = lax.pmean(grads, data_axes)
+        loss = lax.pmean(loss, data_axes)
+        acc = lax.pmean(accuracy(logits, batch["label"]), data_axes)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, global_step + 1, {"loss": loss, "accuracy": acc}
+
+    return _shard_step
 
 
 def build_train_step(
@@ -65,33 +111,8 @@ def build_train_step(
     (``demo2/train.py:146-149``) — here every device holds the same
     replicated counter, incremented exactly once per synchronous step.
     """
-    data_axes = ("data", "model")  # batch sharded over both axes when model dim >1
-
-    def _shard_step(params, opt_state, global_step, batch, rng):
-        # Distinct dropout noise per step (fold in the on-device global step —
-        # no per-step host-side key derivation/dispatch) and per shard.
-        shard_id = lax.axis_index(data_axes[0]) * lax.axis_size(data_axes[1]) + lax.axis_index(
-            data_axes[1]
-        )
-        rng = jax.random.fold_in(jax.random.fold_in(rng, global_step), shard_id)
-
-        def compute_loss(p):
-            logits = apply_fn(
-                {"params": p}, batch["image"], train=True, rngs={"dropout": rng}
-            )
-            return loss_fn(logits, batch["label"]), logits
-
-        (loss, logits), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
-        # THE collective: gradient mean over ICI (replaces worker->ps gRPC push).
-        grads = lax.pmean(grads, data_axes)
-        loss = lax.pmean(loss, data_axes)
-        acc = lax.pmean(accuracy(logits, batch["label"]), data_axes)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-        return params, opt_state, global_step + 1, {"loss": loss, "accuracy": acc}
-
     shard_fn = jax.shard_map(
-        _shard_step,
+        _make_shard_step(apply_fn, tx, loss_fn),
         mesh=mesh,
         in_specs=(P(), P(), P(), P(("data", "model")), P()),
         out_specs=(P(), P(), P(), P()),
@@ -99,6 +120,134 @@ def build_train_step(
     )
     donate_args = (0, 1, 2) if donate else ()
     return jax.jit(shard_fn, donate_argnums=donate_args)
+
+
+def build_multi_step(
+    apply_fn: Callable,
+    tx,
+    mesh: Mesh,
+    loss_fn: Callable = softmax_cross_entropy,
+    donate: bool = True,
+):
+    """k fused train steps per dispatch: ``lax.scan`` over a stacked batch.
+
+    multi_step(params, opt_state, global_step, batches, rng)
+        -> (params, opt_state, global_step, metrics)   # metrics stacked (k,)
+
+    ``batches`` arrays carry a leading steps dim: ``image (k, B, ...)``. One
+    XLA program runs k optimizer steps back-to-back on device, so the
+    per-dispatch Python/runtime overhead — what dominates small-model steps
+    like the reference's MNIST convnet — is paid once per k steps instead of
+    every step. Semantics are identical to k calls of :func:`build_train_step`
+    (same per-step RNG folding via the carried global_step).
+    """
+    step = _make_shard_step(apply_fn, tx, loss_fn)
+
+    def _shard_multi(params, opt_state, global_step, batches, rng):
+        def body(carry, batch):
+            p, o, g = carry
+            p, o, g, metrics = step(p, o, g, batch, rng)
+            return (p, o, g), metrics
+
+        (params, opt_state, global_step), metrics = lax.scan(
+            body, (params, opt_state, global_step), batches
+        )
+        return params, opt_state, global_step, metrics
+
+    shard_fn = jax.shard_map(
+        _shard_multi,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(None, ("data", "model")), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    donate_args = (0, 1, 2) if donate else ()
+    return jax.jit(shard_fn, donate_argnums=donate_args)
+
+
+def build_pool_train_fn(
+    apply_fn: Callable,
+    tx,
+    mesh: Mesh,
+    batch_per_shard: int,
+    steps_per_call: int,
+    loss_fn: Callable = softmax_cross_entropy,
+    donate: bool = True,
+):
+    """Device-resident-dataset training: k steps per dispatch, batches
+    gathered on device from an HBM-resident example pool.
+
+    pool_fn(params, opt_state, global_step, pool, rng)
+        -> (params, opt_state, global_step, metrics)   # metrics stacked (k,)
+
+    ``pool`` is the full (sharded) training set placed once with
+    :func:`shard_batch`; each device samples ``batch_per_shard`` examples per
+    step from its local shard (uniform with replacement, keyed on the carried
+    global step). The hot loop involves the host ONLY to dispatch — no batch
+    assembly, no HBM transfer. This is the logical endpoint of the prefetch
+    story: the reference re-uploaded every batch via feed_dict
+    (``demo1/train.py:153-155``); per-shard independent sampling mirrors the
+    reference's per-worker independent shuffles (``demo2/train.py:182``).
+    """
+    data_axes = ("data", "model")
+    step = _make_shard_step(apply_fn, tx, loss_fn, data_axes)
+
+    def _shard_pool_train(params, opt_state, global_step, pool, rng):
+        n_local = pool["image"].shape[0]
+        shard_id = _shard_index(data_axes)
+
+        def body(carry, _):
+            p, o, g = carry
+            # Separate index stream from the dropout stream (extra fold tag).
+            idx_key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.fold_in(rng, 0x5A11), g), shard_id
+            )
+            idx = jax.random.randint(idx_key, (batch_per_shard,), 0, n_local)
+            batch = {k: jnp.take(v, idx, axis=0) for k, v in pool.items()}
+            p, o, g, metrics = step(p, o, g, batch, rng)
+            return (p, o, g), metrics
+
+        (params, opt_state, global_step), metrics = lax.scan(
+            body, (params, opt_state, global_step), None, length=steps_per_call
+        )
+        return params, opt_state, global_step, metrics
+
+    shard_fn = jax.shard_map(
+        _shard_pool_train,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(("data", "model")), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    donate_args = (0, 1, 2) if donate else ()
+    return jax.jit(shard_fn, donate_argnums=donate_args)
+
+
+def shard_pool(images, labels, mesh: Mesh) -> Batch:
+    """Place a whole training set in HBM for :func:`build_pool_train_fn`,
+    truncated to a multiple of the mesh size (shards must be even; dropped
+    tail examples remain reachable through uniform sampling of other epochs'
+    truncations only if the caller reshuffles — for MNIST-sized pools the
+    loss is <mesh_size examples)."""
+    import numpy as np
+
+    n = np.asarray(images).shape[0]
+    n -= n % mesh.devices.size
+    return shard_batch(
+        {"image": np.asarray(images)[:n], "label": np.asarray(labels)[:n]}, mesh
+    )
+
+
+def stack_shard_batches(batches: list[Batch], mesh: Mesh) -> Batch:
+    """Stack k host batches into one ``(k, B, ...)`` pytree sharded for
+    :func:`build_multi_step` (steps dim replicated, batch dim sharded)."""
+    import numpy as np
+
+    stacked = {
+        k: np.stack([np.asarray(b[k]) for b in batches]) for k in batches[0]
+    }
+    sharding = NamedSharding(mesh, P(None, ("data", "model")))
+    return jax.device_put(stacked, sharding)
 
 
 def build_eval_step(apply_fn: Callable, mesh: Mesh):

@@ -24,7 +24,10 @@ import optax
 
 from distributed_tensorflow_tpu.config import MnistTrainConfig
 from distributed_tensorflow_tpu.data.mnist import DataSet, read_data_sets
-from distributed_tensorflow_tpu.data.prefetch import bounded_device_batches
+from distributed_tensorflow_tpu.data.prefetch import (
+    bounded_device_batches,
+    stacked_device_batches,
+)
 from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
 from distributed_tensorflow_tpu.parallel import data_parallel as dp
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh
@@ -82,6 +85,11 @@ class MnistTrainer:
         self.global_step = dp.replicate(jnp.zeros((), jnp.int32), self.mesh)
 
         self.train_step = dp.build_train_step(self.model.apply, self.tx, self.mesh)
+        self.multi_step = (
+            dp.build_multi_step(self.model.apply, self.tx, self.mesh)
+            if cfg.steps_per_call > 1
+            else None
+        )
         self.eval_step = dp.build_eval_step(self.model.apply, self.mesh)
 
         self.ckpt = CheckpointManager(cfg.log_dir, save_interval_secs=cfg.save_model_secs)
@@ -141,16 +149,25 @@ class MnistTrainer:
         timer = StepTimer()
         step = int(jax.device_get(self.global_step))
         if step < num_steps:
-            # Background input pipeline: batch assembly + HBM transfer overlap
-            # the device step (replaces the reference's serial feed_dict
-            # upload, demo1/train.py:153-155).
-            prefetch = bounded_device_batches(
-                self.datasets.train, self.global_batch, self.mesh, num_steps - step
-            )
-            try:
-                self._train_loop(prefetch, num_steps, step, timer)
-            finally:
-                prefetch.close()
+            if cfg.device_data:
+                self._train_loop(None, num_steps, step, timer)
+            else:
+                # Background input pipeline: batch assembly + HBM transfer
+                # overlap the device step (replaces the reference's serial
+                # feed_dict upload, demo1/train.py:153-155).
+                if self.multi_step is not None:
+                    chunks = self._chunk_sizes(step, num_steps)
+                    prefetch = stacked_device_batches(
+                        self.datasets.train, self.global_batch, self.mesh, chunks
+                    )
+                else:
+                    prefetch = bounded_device_batches(
+                        self.datasets.train, self.global_batch, self.mesh, num_steps - step
+                    )
+                try:
+                    self._train_loop(prefetch, num_steps, step, timer)
+                finally:
+                    prefetch.close()
         step = int(jax.device_get(self.global_step))
         if self.is_chief:
             self.ckpt.maybe_save(step, self._state_dict(), force=True)
@@ -182,42 +199,95 @@ class MnistTrainer:
         finally:
             prof.close()
 
+    def _chunk_sizes(self, step: int, num_steps: int) -> list[int]:
+        """Fused-dispatch sizes: ``steps_per_call`` steps per call, clipped so
+        no call crosses an eval boundary or the end of training (eval needs
+        up-to-date params on the host side of a call)."""
+        interval = self.cfg.eval_step_interval
+        chunks, s = [], step
+        while s < num_steps:
+            boundary = min(num_steps, ((s // interval) + 1) * interval)
+            k = min(self.cfg.steps_per_call, boundary - s)
+            chunks.append(k)
+            s += k
+        return chunks
+
     def _train_steps(self, prefetch, num_steps: int, step: int, timer: StepTimer, prof) -> None:
-        cfg = self.cfg
+        if prefetch is None:
+            self._train_steps_device_data(num_steps, step, timer, prof)
+            return
         while step < num_steps:
             batch = next(prefetch)
             # Base key only: the step fold happens on-device inside the jitted
             # program (keyed on global_step), so the hot loop does zero
             # per-step host dispatches besides the train step itself.
             with prof.step(step):
-                self.params, self.opt_state, self.global_step, metrics = self.train_step(
-                    self.params, self.opt_state, self.global_step, batch, self.rng
-                )
-            timer.tick()
-            step += 1
-            if step % cfg.eval_step_interval == 0 or step == num_steps:
-                test_acc, test_loss = self.evaluate(self.datasets.test)
-                train_acc, _ = self.evaluate(self.datasets.train, max_examples=10000)
-                m = jax.device_get(metrics)
-                log.info(
-                    "step %d: batch loss %.4f, test acc %.4f, train acc %.4f (%.1f steps/s)",
-                    step, float(m["loss"]), test_acc, train_acc, timer.steps_per_sec,
-                )
-                if self.writer:
-                    self.writer.add_scalars(
-                        {
-                            "cross_entropy": float(m["loss"]),
-                            "batch_accuracy": float(m["accuracy"]),
-                            "test_accuracy": test_acc,
-                            "test_loss": test_loss,
-                            "train_accuracy": train_acc,
-                            "steps_per_sec": timer.steps_per_sec,
-                        },
-                        step,
+                if self.multi_step is not None:
+                    k = next(iter(batch.values())).shape[0]
+                    self.params, self.opt_state, self.global_step, metrics = self.multi_step(
+                        self.params, self.opt_state, self.global_step, batch, self.rng
                     )
-                    # variable_summaries parity (demo1/train.py:15-24) at eval
-                    # cadence, for the fc2 layer weights.
-                    p = jax.device_get(self.params)
-                    variable_summaries(self.writer, "fc2/weights", p["fc2"]["kernel"], step)
-            if self.is_chief:
-                self.ckpt.maybe_save(step, self._state_dict())
+                    # Stacked (k,) metrics → report the final step's values,
+                    # matching what a per-step loop would log at this point.
+                    metrics = {name: v[-1] for name, v in metrics.items()}
+                else:
+                    k = 1
+                    self.params, self.opt_state, self.global_step, metrics = self.train_step(
+                        self.params, self.opt_state, self.global_step, batch, self.rng
+                    )
+            timer.tick(k)
+            step += k
+            self._post_step(step, num_steps, metrics, timer)
+
+    def _train_steps_device_data(self, num_steps: int, step: int, timer: StepTimer, prof) -> None:
+        """Hot loop with the training set resident in HBM: one pool upload,
+        then per-dispatch fused steps whose batches are gathered on device
+        (``dp.build_pool_train_fn``) — no host input work at all."""
+        train = self.datasets.train
+        pool = dp.shard_pool(train.images, train.labels, self.mesh)
+        batch_per_shard = self.global_batch // self.mesh_size
+        fns: dict[int, object] = {}  # one compiled program per distinct k
+        for k in set(self._chunk_sizes(step, num_steps)):
+            fns[k] = dp.build_pool_train_fn(
+                self.model.apply, self.tx, self.mesh, batch_per_shard, k
+            )
+        for k in self._chunk_sizes(step, num_steps):
+            with prof.step(step):
+                self.params, self.opt_state, self.global_step, metrics = fns[k](
+                    self.params, self.opt_state, self.global_step, pool, self.rng
+                )
+            # Lazy on-device slice — no host sync in the hot loop; _post_step
+            # device_gets at eval cadence only.
+            metrics = {name: v[-1] for name, v in metrics.items()}
+            timer.tick(k)
+            step += k
+            self._post_step(step, num_steps, metrics, timer)
+
+    def _post_step(self, step: int, num_steps: int, metrics, timer: StepTimer) -> None:
+        cfg = self.cfg
+        if step % cfg.eval_step_interval == 0 or step == num_steps:
+            test_acc, test_loss = self.evaluate(self.datasets.test)
+            train_acc, _ = self.evaluate(self.datasets.train, max_examples=10000)
+            m = jax.device_get(metrics)
+            log.info(
+                "step %d: batch loss %.4f, test acc %.4f, train acc %.4f (%.1f steps/s)",
+                step, float(m["loss"]), test_acc, train_acc, timer.steps_per_sec,
+            )
+            if self.writer:
+                self.writer.add_scalars(
+                    {
+                        "cross_entropy": float(m["loss"]),
+                        "batch_accuracy": float(m["accuracy"]),
+                        "test_accuracy": test_acc,
+                        "test_loss": test_loss,
+                        "train_accuracy": train_acc,
+                        "steps_per_sec": timer.steps_per_sec,
+                    },
+                    step,
+                )
+                # variable_summaries parity (demo1/train.py:15-24) at eval
+                # cadence, for the fc2 layer weights.
+                p = jax.device_get(self.params)
+                variable_summaries(self.writer, "fc2/weights", p["fc2"]["kernel"], step)
+        if self.is_chief:
+            self.ckpt.maybe_save(step, self._state_dict())

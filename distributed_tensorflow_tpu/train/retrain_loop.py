@@ -59,6 +59,10 @@ def build_extractor(cfg: RetrainConfig, image_size: int = iv3.INPUT_SIZE):
     when neither is present (this environment cannot download — no egress)."""
     model = iv3.create_model()
     pb_path = os.path.join(cfg.model_dir, "classify_image_graph_def.pb")
+    if not os.path.exists(pb_path) and getattr(cfg, "model_download_url", ""):
+        from distributed_tensorflow_tpu.data.download import maybe_download_and_extract
+
+        maybe_download_and_extract(cfg.model_dir, url=cfg.model_download_url)
     if os.path.exists(pb_path):
         from distributed_tensorflow_tpu.models.graphdef_import import (
             import_inception_graphdef,

@@ -38,10 +38,11 @@ class StepTimer:
         self._timed_seconds = 0.0
         self._last = None
 
-    def tick(self) -> None:
+    def tick(self, steps: int = 1) -> None:
+        """Record one dispatch covering ``steps`` optimizer steps."""
         now = time.time()
         if self._last is not None and self._count >= self.warmup_steps:
-            self._timed_steps += 1
+            self._timed_steps += steps
             self._timed_seconds += now - self._last
         self._last = now
         self._count += 1

@@ -1,0 +1,209 @@
+"""Downloader (C9 parity: maybe_download_and_extract) and ImageNet label-map
+parsing (C19 assets) tests — offline via file:// URLs and synthetic files."""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data import download as dl
+from distributed_tensorflow_tpu.data import imagenet_labels as il
+
+
+def _make_tgz(path, members):
+    with tarfile.open(path, "w:gz") as tar:
+        for name, data in members.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+
+
+def test_download_and_extract_file_url(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    archive = src / "bundle-1.tgz"
+    _make_tgz(str(archive), {"model.pb": b"weights", "labels.txt": b"a\nb\n"})
+    dest = tmp_path / "dest"
+    out = dl.maybe_download_and_extract(
+        str(dest), url=archive.as_uri(), progress=False
+    )
+    assert os.path.exists(out)
+    assert (dest / "bundle-1.tgz").exists()
+    assert (dest / "model.pb").read_bytes() == b"weights"
+    assert (dest / "labels.txt").read_bytes() == b"a\nb\n"
+
+
+def test_download_skipped_when_cached(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    archive = src / "bundle.tgz"
+    _make_tgz(str(archive), {"f.txt": b"v1"})
+    dest = tmp_path / "dest"
+    dl.maybe_download_and_extract(str(dest), url=archive.as_uri(), progress=False)
+    # Replace the source with different content; cached archive must win.
+    _make_tgz(str(archive), {"f.txt": b"v2"})
+    dl.maybe_download_and_extract(str(dest), url=archive.as_uri(), progress=False)
+    assert (dest / "f.txt").read_bytes() == b"v1"
+
+
+def test_failed_download_leaves_no_partial(tmp_path):
+    dest = tmp_path / "dest"
+    missing = (tmp_path / "nope.tgz").as_uri()
+    with pytest.raises(Exception):
+        dl.maybe_download_and_extract(str(dest), url=missing, progress=False)
+    assert not (dest / "nope.tgz").exists()
+
+
+def test_unsafe_tar_member_rejected(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    archive = src / "evil.tgz"
+    _make_tgz(str(archive), {"../evil.txt": b"x"})
+    with pytest.raises(ValueError, match="unsafe tar member"):
+        dl.maybe_download_and_extract(
+            str(tmp_path / "dest"), url=archive.as_uri(), progress=False
+        )
+    assert not (tmp_path / "evil.txt").exists()
+
+
+_PBTXT = """
+# LabelMap from ImageNet 2012 full data set UID to int32 target class.
+entry {
+  target_class: 449
+  target_class_string: "n01440764"
+}
+entry {
+  target_class: 450
+  target_class_string: "n01443537"
+}
+entry {
+  target_class: 7
+  target_class_string: "n99999999"
+}
+"""
+
+_SYNSET = (
+    "n01440764\ttench, Tinca tinca\n"
+    "n01443537\tgoldfish, Carassius auratus\n"
+    "n00000001\tunused entry\n"
+)
+
+
+def test_label_map_parsing(tmp_path):
+    assert il.parse_label_map_pbtxt(_PBTXT) == {
+        449: "n01440764",
+        450: "n01443537",
+        7: "n99999999",
+    }
+    humans = il.parse_synset_to_human(_SYNSET)
+    assert humans["n01440764"] == "tench, Tinca tinca"
+
+    (tmp_path / il.LABEL_MAP_PBTXT).write_text(_PBTXT)
+    (tmp_path / il.SYNSET_TO_HUMAN).write_text(_SYNSET)
+    labels = il.ImagenetLabels.from_dir(str(tmp_path))
+    assert len(labels) == 3
+    assert labels.name(449) == "tench, Tinca tinca"
+    assert labels.name(450) == "goldfish, Carassius auratus"
+    assert labels.name(7) == ""  # synset with no human mapping
+    assert labels.name(999) == ""  # unmapped node id
+
+
+def test_reference_label_map_parses():
+    """The actual 21k-line assets bundled with the reference parse cleanly
+    (read-only fixture use; code is ours)."""
+    ref_dir = "/root/reference/retrain1/inception_model"
+    if not os.path.exists(os.path.join(ref_dir, il.LABEL_MAP_PBTXT)):
+        pytest.skip("reference assets unavailable")
+    labels = il.ImagenetLabels.from_dir(ref_dir)
+    assert len(labels) >= 1000
+    named = sum(1 for i in range(1, 1009) if labels.name(i))
+    assert named >= 1000
+
+
+def test_classify_image_cli(tmp_path):
+    """End-to-end: synthetic pb + label maps + one jpeg → top-k printout."""
+    import sys
+
+    sys.path.insert(0, "/root/repo/tools")
+    import jax
+    import jax.numpy as jnp
+    from PIL import Image
+
+    from distributed_tensorflow_tpu.models import graphdef_import as gd
+    from distributed_tensorflow_tpu.models import inception_v3 as iv3
+    from tests.test_graphdef_import import _synthetic_consts
+
+    import classify_image
+
+    model = iv3.create_model()
+    template = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), jnp.zeros((1, 96, 96, 3), jnp.float32)
+    )
+    consts = _synthetic_consts(template, np.random.default_rng(0))
+    (tmp_path / "classify_image_graph_def.pb").write_bytes(
+        gd.serialize_graphdef_consts(consts)
+    )
+    (tmp_path / il.LABEL_MAP_PBTXT).write_text(_PBTXT)
+    (tmp_path / il.SYNSET_TO_HUMAN).write_text(_SYNSET)
+    img = np.random.default_rng(1).integers(0, 255, (32, 32, 3)).astype(np.uint8)
+    Image.fromarray(img).save(str(tmp_path / "panda.jpg"))
+
+    results = classify_image.main(
+        ["--model_dir", str(tmp_path), "--num_top_predictions", "3"]
+    )
+    (scores,) = results.values()
+    assert len(scores) == 3
+    assert all(0.0 <= s <= 1.0 for _, s in scores)
+
+
+def test_build_extractor_downloads_when_url_set(tmp_path):
+    """--model_download_url + empty --model_dir → archive fetched/extracted
+    before weight lookup (reference always downloaded; retrain1/retrain.py:379)."""
+    from distributed_tensorflow_tpu.config import RetrainConfig
+    from distributed_tensorflow_tpu.train.retrain_loop import build_extractor
+
+    src = tmp_path / "src"
+    src.mkdir()
+    # Archive carries a (non-pb) marker file: extraction happens, then the
+    # extractor falls back to random init without attempting a network fetch.
+    archive = src / "inception-2015-12-05.tgz"
+    _make_tgz(str(archive), {"marker.txt": b"extracted"})
+    model_dir = tmp_path / "model"
+    cfg = RetrainConfig(model_dir=str(model_dir), model_download_url=archive.as_uri())
+    extractor = build_extractor(cfg, image_size=96)
+    assert (model_dir / "marker.txt").read_bytes() == b"extracted"
+    assert extractor.image_size == 96
+
+
+def test_corrupt_archive_removed_on_extract_failure(tmp_path):
+    """A cached non-gzip 'archive' (captive-portal HTML) must be deleted on
+    extraction failure so the next call re-downloads instead of poisoning."""
+    src = tmp_path / "src"
+    src.mkdir()
+    bogus = src / "bundle.tgz"
+    bogus.write_bytes(b"<html>not a tarball</html>")
+    dest = tmp_path / "dest"
+    with pytest.raises(Exception):
+        dl.maybe_download_and_extract(str(dest), url=bogus.as_uri(), progress=False)
+    assert not (dest / "bundle.tgz").exists()
+    # Fix the source; the retry now succeeds (no stale cache hit).
+    _make_tgz(str(bogus), {"ok.txt": b"fine"})
+    dl.maybe_download_and_extract(str(dest), url=bogus.as_uri(), progress=False)
+    assert (dest / "ok.txt").read_bytes() == b"fine"
+
+
+def test_symlink_member_rejected(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    archive = src / "sym.tgz"
+    with tarfile.open(str(archive), "w:gz") as tar:
+        info = tarfile.TarInfo("link")
+        info.type = tarfile.SYMTYPE
+        info.linkname = "/etc"
+        tar.addfile(info)
+    with pytest.raises(ValueError, match="link member"):
+        dl.maybe_download_and_extract(
+            str(tmp_path / "dest"), url=archive.as_uri(), progress=False
+        )

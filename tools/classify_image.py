@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""ImageNet classifier over the 2015 Inception-v3 bundle — the workflow the
+reference's bundled assets exist for (``retrain*/inception_model/``: frozen
+GraphDef + ``cropped_panda.jpg`` + the two ImageNet label-map files, SURVEY
+§2.1 C19). Loads ``classify_image_graph_def.pb`` TF-free via
+``models.graphdef_import``, runs all images in one jitted batched forward,
+and prints top-k human-readable predictions.
+
+Usage:
+  python tools/classify_image.py --model_dir ./inception_model \
+      --image_file path/to.jpg [--num_top_predictions 5]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model_dir", default="./inception_model")
+    parser.add_argument(
+        "--image_file", default="", help="one image; default: all jpgs in model_dir"
+    )
+    parser.add_argument("--num_top_predictions", type=int, default=5)
+    args, _ = parser.parse_known_args(argv)
+
+    import jax
+
+    from distributed_tensorflow_tpu.data.augment import load_image
+    from distributed_tensorflow_tpu.data.digit import iter_image_files
+    from distributed_tensorflow_tpu.data.imagenet_labels import ImagenetLabels
+    from distributed_tensorflow_tpu.models import inception_v3 as iv3
+    from distributed_tensorflow_tpu.models.graphdef_import import (
+        import_inception_graphdef,
+    )
+
+    pb_path = os.path.join(args.model_dir, "classify_image_graph_def.pb")
+    if not os.path.exists(pb_path):
+        sys.exit(
+            f"{pb_path} not found — fetch the 2015 bundle with "
+            "data.download.maybe_download_and_extract(model_dir) first"
+        )
+    model = iv3.create_model()
+    variables, report = import_inception_graphdef(pb_path, model=model)
+    print(
+        f"imported {len(report['loaded'])} tensors from {pb_path} "
+        f"({len(report['defaulted'])} defaulted)"
+    )
+    labels = ImagenetLabels.from_dir(args.model_dir)
+
+    if args.image_file:
+        paths = [args.image_file]
+    else:
+        paths = list(iter_image_files(args.model_dir))
+    if not paths:
+        sys.exit(f"no images found under {args.model_dir}")
+
+    imgs = np.stack([load_image(p, iv3.INPUT_SIZE) for p in paths])
+
+    @jax.jit
+    def forward(variables, imgs):
+        logits = model.apply(variables, iv3.preprocess(imgs))
+        return jax.nn.softmax(logits, -1)
+
+    scores = np.asarray(forward(variables, imgs))
+    results = {}
+    for path, s in zip(paths, scores):
+        print(path)
+        top = s.argsort()[::-1][: args.num_top_predictions]
+        for node_id in top:
+            human = labels.name(node_id) or f"(node {node_id})"
+            print(f"  {human} (score = {s[node_id]:.5f})")
+        results[path] = [(int(i), float(s[i])) for i in top]
+    return results
+
+
+if __name__ == "__main__":
+    main()
