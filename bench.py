@@ -99,18 +99,16 @@ def main() -> None:
 
         close = lambda: None  # noqa: E731
     else:
-        if STEPS_PER_CALL > 1:
-            train_step = dp.build_multi_step(model.apply, tx, mesh)
-        else:
-            train_step = dp.build_train_step(model.apply, tx, mesh)
-
         # Async input pipeline: batch assembly + HBM transfer overlap device
         # compute (the framework's replacement for the reference's per-step
-        # feed_dict upload, demo1/train.py:153-155).
+        # feed_dict upload, demo1/train.py:153-155). Fused steps pair with
+        # stacked batches; unfused with single batches.
         if STEPS_PER_CALL > 1:
+            train_step = dp.build_multi_step(model.apply, tx, mesh)
             chunks = [STEPS_PER_CALL] * (warmup_calls + timed_calls)
             prefetch = stacked_device_batches(datasets.train, global_batch, mesh, chunks)
         else:
+            train_step = dp.build_train_step(model.apply, tx, mesh)
             prefetch = bounded_device_batches(
                 datasets.train, global_batch, mesh, warmup_calls + timed_calls
             )

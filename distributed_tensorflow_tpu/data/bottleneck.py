@@ -188,24 +188,34 @@ def get_random_cached_bottlenecks(
     bottleneck_dir: str,
     image_dir: str,
     rng: np.random.Generator,
+    memo: dict | None = None,
 ):
     """→ (bottlenecks (N,2048), one-hot truths (N,K), filenames). Sampling
     parity with ``retrain1/retrain.py:318-341``: uniform over labels, uniform
-    index with replacement; ``how_many == -1`` sweeps every image."""
+    index with replacement; ``how_many == -1`` sweeps every image.
+
+    ``memo`` (path → vector) is an optional in-memory layer over the disk
+    cache: the reference re-read and re-parsed cache files every step — its
+    hot loop was disk-bound (SURVEY §7d). First access still goes through
+    disk (corruption recovery preserved); each vector is then served from
+    memory (2048 floats = 8 KB/image)."""
     label_names = list(image_lists.keys())
     pairs = _sample_index_pairs(image_lists, how_many, category, rng)
     bottlenecks, truths, filenames = [], [], []
     for label_index, image_index in pairs:
         label_name = label_names[label_index]
-        bottlenecks.append(
-            get_or_create_bottleneck(
+        ipath = I.get_image_path(image_lists, label_name, image_index, image_dir, category)
+        if memo is not None and ipath in memo:
+            vec = memo[ipath]
+        else:
+            vec = get_or_create_bottleneck(
                 extractor, image_lists, label_name, image_index, image_dir, category, bottleneck_dir
             )
-        )
+            if memo is not None:
+                memo[ipath] = vec
+        bottlenecks.append(vec)
         truths.append(_one_hot(len(label_names), label_index))
-        filenames.append(
-            I.get_image_path(image_lists, label_name, image_index, image_dir, category)
-        )
+        filenames.append(ipath)
     return np.stack(bottlenecks), np.stack(truths), filenames
 
 

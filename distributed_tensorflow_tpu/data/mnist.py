@@ -95,6 +95,14 @@ class DataSet:
     def num_examples(self) -> int:
         return self._num_examples
 
+    def reseed_shuffle(self, seed: int) -> None:
+        """Restart the shuffle stream (dataset content untouched) — used to
+        decorrelate per-process sampling in multi-worker training while every
+        process still holds identical data."""
+        self._rng = np.random.default_rng(seed)
+        self._order = self._rng.permutation(self._num_examples)
+        self._index = 0
+
     def next_batch(self, batch_size: int) -> tuple[np.ndarray, np.ndarray]:
         if self._index + batch_size > self._num_examples:
             self._order = self._rng.permutation(self._num_examples)

@@ -22,6 +22,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -35,23 +36,61 @@ from distributed_tensorflow_tpu.ops.losses import (
 Batch = dict[str, jnp.ndarray]
 
 
+def _to_global(tree: Any, sharding: NamedSharding) -> Any:
+    """Place host data onto a (possibly multi-process) sharding. Single
+    process: plain device_put. Multi-process: every process contributes the
+    block for its own devices via ``make_array_from_process_local_data`` —
+    ``device_put`` cannot address other hosts' devices."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)), tree
+    )
+
+
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree fully-replicated over the mesh (params/opt state live in
     HBM once per device — the reference instead kept one copy on ps hosts and
-    shipped it over the network every step).
+    shipped it over the network every step). Multi-process: every process must
+    pass the same host values (chief-seeded init or a restored checkpoint).
 
     Caveat: when a leaf is already a device array with a compatible sharding,
     ``device_put`` may return it as-is (no copy). Donating the result to a
     train step then invalidates the caller's original array. Keep initial
     params host-side (numpy) if you need them after training starts."""
-    sharding = NamedSharding(mesh, P())
-    return jax.device_put(tree, sharding)
+    return _to_global(tree, NamedSharding(mesh, P()))
 
 
 def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
-    """Split dim 0 of every array over the 'data' axis."""
+    """Split dim 0 of every array over the 'data' axis.
+
+    Multi-process: ``batch`` is this process's LOCAL portion (global dim 0 =
+    local dim 0 × process_count) — each worker feeds its own independently
+    sampled examples, the SPMD analog of the reference's per-worker
+    independent shuffles (``demo2/train.py:182``). For identical-on-all-hosts
+    data (eval sweeps) use :func:`shard_global_batch`."""
     sharding = NamedSharding(mesh, P(("data", "model")))
-    return jax.device_put(batch, sharding)
+    return _to_global(batch, sharding)
+
+
+def shard_global_batch(batch: Batch, mesh: Mesh) -> Batch:
+    """Shard a batch that every process holds IDENTICALLY (deterministic eval
+    chunks): each process slices out its own devices' contiguous block, so
+    the global array equals the logical batch exactly once."""
+    if jax.process_count() == 1:
+        return shard_batch(batch, mesh)
+    pid, pcount = jax.process_index(), jax.process_count()
+
+    def slice_local(x):
+        x = np.asarray(x)
+        if x.shape[0] % pcount:
+            raise ValueError(
+                f"global batch dim {x.shape[0]} not divisible by {pcount} processes"
+            )
+        per = x.shape[0] // pcount
+        return x[pid * per : (pid + 1) * per]
+
+    return shard_batch(jax.tree_util.tree_map(slice_local, batch), mesh)
 
 
 def _shard_index(data_axes: tuple[str, str]):
@@ -225,29 +264,26 @@ def build_pool_train_fn(
 
 def shard_pool(images, labels, mesh: Mesh) -> Batch:
     """Place a whole training set in HBM for :func:`build_pool_train_fn`,
-    truncated to a multiple of the mesh size (shards must be even; dropped
-    tail examples remain reachable through uniform sampling of other epochs'
-    truncations only if the caller reshuffles — for MNIST-sized pools the
-    loss is <mesh_size examples)."""
-    import numpy as np
-
+    truncated to a multiple of the mesh size (shards must be even; the loss
+    is <mesh_size examples). Multi-process: every process holds the same full
+    dataset on the host (each downloads/loads its own copy, as the reference's
+    workers did) and contributes its devices' slice."""
     n = np.asarray(images).shape[0]
     n -= n % mesh.devices.size
-    return shard_batch(
+    return shard_global_batch(
         {"image": np.asarray(images)[:n], "label": np.asarray(labels)[:n]}, mesh
     )
 
 
 def stack_shard_batches(batches: list[Batch], mesh: Mesh) -> Batch:
     """Stack k host batches into one ``(k, B, ...)`` pytree sharded for
-    :func:`build_multi_step` (steps dim replicated, batch dim sharded)."""
-    import numpy as np
-
+    :func:`build_multi_step` (steps dim replicated, batch dim sharded).
+    Multi-process: like :func:`shard_batch`, each process passes its LOCAL
+    k batches (global batch dim = local × process_count)."""
     stacked = {
         k: np.stack([np.asarray(b[k]) for b in batches]) for k in batches[0]
     }
-    sharding = NamedSharding(mesh, P(None, ("data", "model")))
-    return jax.device_put(stacked, sharding)
+    return _to_global(stacked, NamedSharding(mesh, P(None, ("data", "model"))))
 
 
 def build_eval_step(apply_fn: Callable, mesh: Mesh):
@@ -295,8 +331,6 @@ def pad_to_multiple(batch: Batch, multiple: int) -> tuple[Batch, int]:
     """Pad dim 0 up to a multiple of the mesh size (XLA needs static, evenly
     divisible shard shapes) and attach a ``weight`` mask (1=real, 0=padding).
     Returns (padded batch, original size)."""
-    import numpy as np
-
     n = next(iter(batch.values())).shape[0]
     rem = (-n) % multiple
     weight = np.concatenate([np.ones(n, np.float32), np.zeros(rem, np.float32)])

@@ -50,14 +50,22 @@ class CheckpointManager:
         self.save_interval_secs = save_interval_secs
         self._last_save = time.time()
 
+    def should_save(self, force: bool = False) -> bool:
+        """The timed-autosave gate, side-effect free (multi-process callers
+        broadcast the chief's answer so every process enters the collective
+        Orbax save together)."""
+        return force or time.time() - self._last_save >= self.save_interval_secs
+
+    def mark_saved(self) -> None:
+        self._last_save = time.time()
+
     def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
         """Save if ``save_interval_secs`` elapsed since the last save (the
         Supervisor's timed-autosave behavior) or if forced (final save)."""
-        now = time.time()
-        if not force and now - self._last_save < self.save_interval_secs:
+        if not self.should_save(force):
             return False
         self.save(step, state)
-        self._last_save = now
+        self.mark_saved()
         return True
 
     def save(self, step: int, state: Any) -> None:

@@ -72,6 +72,14 @@ class MnistTrainer:
                     f"batch_size {cfg.batch_size} not divisible by mesh size {self.mesh_size}"
                 )
             self.global_batch = cfg.batch_size
+        # Multi-process: each worker samples its own share of the global batch
+        # independently (reference demo2 parity — independent per-worker
+        # shuffles), so the host pipeline assembles feed_batch examples here
+        # and each process gets a decorrelated shuffle stream over the same
+        # dataset copy.
+        self.feed_batch = self.global_batch // jax.process_count()
+        if jax.process_count() > 1:
+            self.datasets.train.reseed_shuffle(cfg.seed + 1000003 * jax.process_index())
 
         self.tx = optax.adam(cfg.learning_rate)  # demo1/train.py:132
         self.rng = jax.random.PRNGKey(cfg.seed)
@@ -134,7 +142,8 @@ class MnistTrainer:
         for lo in range(0, n, self.eval_chunk):
             chunk = {"image": images[lo : lo + self.eval_chunk], "label": labels[lo : lo + self.eval_chunk]}
             padded, real = dp.pad_to_multiple(chunk, self.mesh_size)
-            batch = dp.shard_batch(padded, self.mesh)
+            # Every process holds the same dataset copy — identical-data path.
+            batch = dp.shard_global_batch(padded, self.mesh)
             correct, loss_sum = self.eval_step(self.params, batch)
             total_correct += float(correct)
             total_loss += float(loss_sum)
@@ -158,21 +167,20 @@ class MnistTrainer:
                 if self.multi_step is not None:
                     chunks = self._chunk_sizes(step, num_steps)
                     prefetch = stacked_device_batches(
-                        self.datasets.train, self.global_batch, self.mesh, chunks
+                        self.datasets.train, self.feed_batch, self.mesh, chunks
                     )
                 else:
                     prefetch = bounded_device_batches(
-                        self.datasets.train, self.global_batch, self.mesh, num_steps - step
+                        self.datasets.train, self.feed_batch, self.mesh, num_steps - step
                     )
                 try:
                     self._train_loop(prefetch, num_steps, step, timer)
                 finally:
                     prefetch.close()
         step = int(jax.device_get(self.global_step))
-        if self.is_chief:
-            self.ckpt.maybe_save(step, self._state_dict(), force=True)
-            if self.writer:
-                self.writer.flush()
+        self._maybe_save(step, force=True)
+        if self.is_chief and self.writer:
+            self.writer.flush()
         train_time = clock.elapsed
         log.info("Training time: %.2fs (%.1f steps/s)", train_time, timer.steps_per_sec)
         return {
@@ -289,5 +297,27 @@ class MnistTrainer:
                 # cadence, for the fc2 layer weights.
                 p = jax.device_get(self.params)
                 variable_summaries(self.writer, "fc2/weights", p["fc2"]["kernel"], step)
-        if self.is_chief:
-            self.ckpt.maybe_save(step, self._state_dict())
+        self._maybe_save(step, at_eval_boundary=(
+            step % cfg.eval_step_interval == 0 or step == num_steps
+        ))
+
+    def _maybe_save(self, step: int, force: bool = False, at_eval_boundary: bool = True) -> None:
+        """Timed autosave, multi-process safe. Orbax saves are COLLECTIVE when
+        ``jax.process_count() > 1`` — a chief-only save desynchronizes the
+        process group (observed: gloo size-mismatch crash). So: single process
+        keeps Supervisor semantics exactly; multi-process coordinates at eval
+        boundaries only (no per-step collectives) by broadcasting the chief's
+        timed-gate decision, then every process enters the save together."""
+        if jax.process_count() == 1:
+            if self.is_chief:
+                self.ckpt.maybe_save(step, self._state_dict(), force=force)
+            return
+        if not (at_eval_boundary or force):
+            return
+        from jax.experimental import multihost_utils
+
+        want = self.ckpt.should_save(force)
+        should = bool(multihost_utils.broadcast_one_to_all(np.asarray(want)))
+        if should:
+            self.ckpt.save(step, self._state_dict())
+            self.ckpt.mark_saved()

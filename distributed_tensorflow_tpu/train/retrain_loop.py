@@ -148,6 +148,7 @@ class RetrainTrainer:
         self.rng = np.random.default_rng(cfg.seed)
         self.distort_key = jax.random.PRNGKey(cfg.seed + 1)
         self.step_rng = jax.random.PRNGKey(cfg.seed + 2)
+        self._bn_memo: dict[str, np.ndarray] = {}  # in-memory bottleneck layer
 
         self.train_writer = SummaryWriter(os.path.join(cfg.summaries_dir, "train")) if is_chief else None
         self.val_writer = SummaryWriter(os.path.join(cfg.summaries_dir, "validation")) if is_chief else None
@@ -199,7 +200,7 @@ class RetrainTrainer:
             return b, t, []
         return B.get_random_cached_bottlenecks(
             self.extractor, self.image_lists, how_many, category,
-            cfg.bottleneck_dir, cfg.image_dir, self.rng,
+            cfg.bottleneck_dir, cfg.image_dir, self.rng, memo=self._bn_memo,
         )
 
     def _next_distort_key(self):
@@ -210,7 +211,10 @@ class RetrainTrainer:
         padded, n = dp.pad_to_multiple(
             {"image": bottlenecks, "label": truths}, self.mesh_size
         )
-        correct, loss_sum = self.eval_step(self.params, dp.shard_batch(padded, self.mesh))
+        # Sampling is seed-deterministic — every process holds the same batch.
+        correct, loss_sum = self.eval_step(
+            self.params, dp.shard_global_batch(padded, self.mesh)
+        )
         return float(correct) / n, float(loss_sum) / n
 
     # ------------------------------------------------------------------
@@ -230,7 +234,9 @@ class RetrainTrainer:
         step = int(jax.device_get(self.global_step))
         while step < cfg.training_steps:
             bottlenecks, truths, _ = self._sample(train_bs, "training")
-            batch = dp.shard_batch({"image": bottlenecks, "label": truths}, self.mesh)
+            batch = dp.shard_global_batch(
+                {"image": bottlenecks, "label": truths}, self.mesh
+            )
             # Base key only — the per-step fold happens on-device in the jitted
             # step, keyed on global_step.
             self.params, self.opt_state, self.global_step, metrics = self.train_step(

@@ -156,3 +156,27 @@ def test_atomic_write_no_tmp_residue(tmp_path):
     path = str(tmp_path / "sub" / "x.txt")
     B.write_bottleneck_file(path, vec)
     assert [p.name for p in (tmp_path / "sub").iterdir()] == ["x.txt"]
+
+
+def test_memo_serves_from_memory(dataset):
+    """The in-memory layer over the disk cache: after first access, vectors
+    come from the memo even if the disk cache disappears (reference re-read
+    disk every step — SURVEY §7d hot-loop defect, fixed here)."""
+    import shutil
+
+    image_dir, bn_dir, lists = dataset
+    ex = FakeExtractor()
+    rng = np.random.default_rng(1)
+    memo = {}
+    b1, _, _ = B.get_random_cached_bottlenecks(
+        ex, lists, -1, "training", bn_dir, image_dir, rng, memo=memo
+    )
+    assert len(memo) == b1.shape[0]
+    calls_after_fill = ex.calls
+    shutil.rmtree(bn_dir)  # memory layer must not notice
+    b2, _, _ = B.get_random_cached_bottlenecks(
+        ex, lists, -1, "training", bn_dir, image_dir, rng, memo=memo
+    )
+    assert ex.calls == calls_after_fill  # no recompute, no disk
+    np.testing.assert_array_equal(np.sort(b1, 0), np.sort(b2, 0))
+    assert not os.path.exists(bn_dir)
