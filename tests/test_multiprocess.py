@@ -1,8 +1,8 @@
-"""Real 2-process distributed integration test (SURVEY §5.8): the demo2
-multi-worker path — ``jax.distributed`` process group from reference-style
-cluster flags, a global mesh spanning both processes, a cross-process psum,
-chief election, and a barrier — exercised with two actual OS processes of 2
-CPU devices each. This replaces the reference's only multi-node 'testing'
+"""Real 2-process distributed integration tests (SURVEY §5.8): the multi-
+worker paths — ``jax.distributed`` process group from reference-style cluster
+flags, a global mesh spanning both processes, cross-process collectives,
+chief election, barriers — exercised with actual OS processes of 2 CPU
+devices each. This replaces the reference's only multi-node 'testing'
 (running on the author's 3-machine LAN, ``demo2/train.py:201,207``)."""
 
 import os
@@ -10,10 +10,7 @@ import socket
 import subprocess
 import sys
 
-import pytest
-
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_WORKER = os.path.join(_REPO, "tests", "mp_worker.py")
 
 
 def _free_port() -> int:
@@ -22,7 +19,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_group(tmp_path):  # bounded by communicate(timeout=240)
+def _run_workers(script_name: str, extra_arg: str, ok_marker: str, n: int = 2) -> list[str]:
+    """Spawn n worker subprocesses of tests/<script_name> with args
+    (task_index, free_port, extra_arg); assert all exit 0 and print their
+    ``ok_marker`` (formatted with the worker index). Returns the outputs."""
     port = _free_port()
     env = {
         k: v
@@ -30,16 +30,17 @@ def test_two_process_group(tmp_path):  # bounded by communicate(timeout=240)
         # Strip this pytest process's single-process XLA/JAX overrides.
         if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
     }
+    worker = os.path.join(_REPO, "tests", script_name)
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(i), str(port), str(tmp_path)],
+            [sys.executable, worker, str(i), str(port), extra_arg],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
             env=env,
             cwd=_REPO,
         )
-        for i in range(2)
+        for i in range(n)
     ]
     outs = []
     try:
@@ -51,42 +52,40 @@ def test_two_process_group(tmp_path):  # bounded by communicate(timeout=240)
             if p.poll() is None:
                 p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i} failed:\n{out}"
-        assert f"WORKER_{i}_OK" in out
+        assert p.returncode == 0, f"{script_name} worker {i} failed:\n{out}"
+        assert ok_marker.format(i=i) in out
+    return outs
+
+
+def test_two_process_group(tmp_path):
+    """Process group, global mesh, cross-process psum, chief file, barrier."""
+    _run_workers("mp_worker.py", str(tmp_path), "WORKER_{i}_OK")
     assert (tmp_path / "chief.txt").read_text() == "ok"
 
 
 def test_demo2_two_process_end_to_end(tmp_path):
-    """The full demo2 workload over two real processes: training runs, params
-    stay bitwise-consistent across processes (checked inside demo2.main), and
-    the chief exports the model."""
-    port = _free_port()
-    env = {
-        k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
-    }
-    worker = os.path.join(_REPO, "tests", "mp_demo2_worker.py")
+    """The full demo2 workload over two real processes (fused steps_per_call
+    path): training runs, params stay bitwise-consistent across processes
+    (checked inside demo2.main), and the chief exports the model."""
     log_dir = str(tmp_path / "logs")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(i), str(port), log_dir],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            env=env,
-            cwd=_REPO,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=240)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"demo2 worker {i} failed:\n{out}"
-        assert f"DEMO2_WORKER_{i}_OK" in out
+    _run_workers("mp_demo2_worker.py", log_dir, "DEMO2_WORKER_{i}_OK")
     assert os.path.exists(os.path.join(log_dir, "model.msgpack"))
+
+
+def test_retrain2_two_process_end_to_end(tmp_path):
+    """Distributed retrain (reference C16): stride-sharded bottleneck caching
+    with a barrier + SPMD head training across two real processes."""
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for cls, chan in (("red", 0), ("green", 1)):
+        d = tmp_path / "data" / cls
+        d.mkdir(parents=True)
+        for i in range(25):
+            arr = np.zeros((16, 16, 3), np.uint8)
+            arr[..., chan] = rng.integers(150, 255)
+            Image.fromarray(arr).save(str(d / f"{cls}{i}.jpg"))
+
+    _run_workers("mp_retrain2_worker.py", str(tmp_path), "RETRAIN2_WORKER_{i}_OK")
+    assert os.path.exists(str(tmp_path / "graph.msgpack"))

@@ -136,3 +136,36 @@ def test_device_data_trains_and_evals(tmp_path, tiny_data):
     assert stats["steps"] == 30
     acc, _ = trainer.evaluate(trainer.datasets.test)
     assert acc > 0.2
+
+
+def test_golden_loss_fixed_seed():
+    """Numerical golden test (SURVEY §4 plan): 5 Adam steps on the seeded
+    synthetic dataset reproduce a recorded loss. Catches silent changes to
+    init, RNG folding, data generation, or the train-step math. Recorded on
+    the CPU backend this suite always runs under (conftest)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.data.mnist import read_data_sets
+    from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+    from distributed_tensorflow_tpu.parallel import data_parallel as dp
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    ds = read_data_sets("unused", one_hot=True, seed=0, synthetic=True)
+    model = MnistCNN(compute_dtype=jnp.float32)
+    tx = optax.adam(1e-3)
+    params = jax.device_get(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)), train=False)["params"]
+    )
+    p = dp.replicate(params, mesh)
+    o = dp.replicate(jax.device_get(tx.init(params)), mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    step = dp.build_train_step(model.apply, tx, mesh, donate=False)
+    for _ in range(5):
+        xs, ys = ds.train.next_batch(64)
+        p, o, g, m = step(
+            p, o, g, dp.shard_batch({"image": xs, "label": ys}, mesh), jax.random.PRNGKey(0)
+        )
+    np.testing.assert_allclose(float(jax.device_get(m["loss"])), 11.203433, rtol=1e-3)
