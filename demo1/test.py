@@ -31,6 +31,18 @@ def main(argv=None):
     parser.add_argument("--show", action="store_true", help="display each image")
     args, _ = parser.parse_known_args(argv)
 
+    if args.model.endswith(".stablehlo"):
+        # Frozen-program path: no model code, weights baked in (the analog of
+        # restoring the reference's frozen graph).
+        from distributed_tensorflow_tpu.train.checkpoint import load_frozen_stablehlo
+
+        frozen_call, _ = load_frozen_stablehlo(args.model)
+
+        def predict_one(x):
+            return int(np.argmax(np.asarray(frozen_call(np.asarray(x, np.float32)))[0]))
+
+        return classify_digit_images(predict_one, args.imgs_dir, args.show)
+
     model = MnistCNN()
     template = model.init(jax.random.PRNGKey(0), np.zeros((1, 784), np.float32))["params"]
     params, _ = load_inference_bundle(args.model, template=template)

@@ -109,6 +109,13 @@ class MnistTrainConfig:
             "instead of epoch shuffling; fastest input path)"
         },
     )
+    export_stablehlo: bool = field(
+        default=False,
+        metadata={
+            "help": "also export a frozen StableHLO inference program next to "
+            "the final model bundle (weights baked in, runs without model code)"
+        },
+    )
 
 
 @dataclass

@@ -1,0 +1,297 @@
+"""Tensor parallelism (Megatron-style) over the mesh's ``model`` axis.
+
+The reference has no tensor parallelism (SURVEY §2.3 — its only distribution
+is async data parallelism), but the framework's mesh reserves a ``model``
+axis; this module makes it a first-class compute axis: attention heads and
+the MLP hidden dimension are sharded across it, with the two canonical
+all-reduces per block (after the attention output projection and after the
+MLP down-projection) expressed as explicit ``lax.psum`` collectives riding
+ICI — same shard_map-with-visible-collectives philosophy as
+``data_parallel.py``.
+
+Sharding rules (the Megatron recipe):
+
+    q/k/v kernels   (D, D)   column-parallel  P(None, 'model')  → local heads
+    attn out proj   (D, D)   row-parallel     P('model', None)  → psum
+    mlp_in kernel   (D, F)   column-parallel  P(None, 'model')
+    mlp_out kernel  (F, D)   row-parallel     P('model', None)  → psum
+    embeddings, layer norms, lm head, row-parallel biases: replicated
+
+Gradients: the model axis needs no gradient collective at all — the backward
+``psum`` lives inside the forward graph (Megatron's ``f``: identity forward /
+psum backward at each column-parallel branch input, :func:`_copy_to_tp`), so
+sharded-param grads are shard-owned and replicated-param grads come out
+identical on every shard. Only the data-parallel mean crosses the ``data``
+axis.
+
+:class:`TpTransformerLM` keeps separate q/k/v projections (a fused qkv kernel
+cannot be contiguously column-sharded without interleaving the q/k/v blocks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    _attention_fn,
+    next_token_loss,
+)
+
+__all__ = [
+    "TpTransformerLM",
+    "tp_param_specs",
+    "shard_params",
+    "build_tp_lm_train_step",
+]
+
+
+def _copy_to_tp(x, axis: str):
+    """Megatron's ``f``: identity forward, ``psum`` backward. Placed at the
+    input of every column-parallel branch so each shard's PARTIAL activation
+    cotangent (it only backprops through its own columns) is summed into the
+    full gradient right here — after which every replicated activation's (and
+    therefore replicated parameter's) gradient is identical on all shards and
+    needs no further model-axis sync."""
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    def fwd(v):
+        return v, None
+
+    def bwd(_, g):
+        return (lax.psum(g, axis),)
+
+    f.defvjp(fwd, bwd)
+    return f(x)
+
+
+def _reduce_from_tp(x, axis: str):
+    """Megatron's ``g``, the conjugate of :func:`_copy_to_tp`: ``psum``
+    forward (combine the row-parallel partial outputs), IDENTITY backward.
+    A raw ``lax.psum`` must not be used here: under shard_map AD its
+    transpose is another psum, which multiplies every branch cotangent by the
+    axis size (measured: exactly ×tp grad inflation on the MLP path)."""
+
+    @jax.custom_vjp
+    def g_fn(v):
+        return lax.psum(v, axis)
+
+    def fwd(v):
+        return lax.psum(v, axis), None
+
+    def bwd(_, t):
+        return (t,)
+
+    g_fn.defvjp(fwd, bwd)
+    return g_fn(x)
+
+
+class TpBlock(nn.Module):
+    cfg: TransformerConfig
+    tp_axis: str = "model"
+
+    @nn.compact
+    def __call__(self, x, attend):
+        cfg = self.cfg
+        d = cfg.compute_dtype
+        tp = lax.axis_size(self.tp_axis)
+        if cfg.num_heads % tp:
+            raise ValueError(f"num_heads {cfg.num_heads} not divisible by tp={tp}")
+        local_heads = cfg.num_heads // tp
+        dh = cfg.d_model // cfg.num_heads
+
+        h = _copy_to_tp(nn.LayerNorm(dtype=d, name="ln1")(x), self.tp_axis)
+        b, s, _ = h.shape
+        # Column-parallel projections: local kernels (D, D/tp) produce this
+        # shard's heads directly — no communication in the forward here.
+        # (features are the LOCAL width: flax validates stored-param shapes.)
+        q = nn.Dense(cfg.d_model // tp, dtype=d, name="q")(h)
+        k = nn.Dense(cfg.d_model // tp, dtype=d, name="k")(h)
+        v = nn.Dense(cfg.d_model // tp, dtype=d, name="v")(h)
+        to_heads = lambda t: t.reshape(b, s, local_heads, dh).transpose(0, 2, 1, 3)
+        attn = attend(to_heads(q), to_heads(k), to_heads(v))
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, local_heads * dh)
+        # Row-parallel output projection: partial sums -> THE tp collective.
+        attn = nn.Dense(cfg.d_model, use_bias=False, dtype=d, name="proj")(attn)
+        attn = _reduce_from_tp(attn, self.tp_axis)
+        attn = attn + self.param("proj_bias", nn.initializers.zeros, (cfg.d_model,), jnp.float32).astype(d)
+        x = x + attn
+
+        h = _copy_to_tp(nn.LayerNorm(dtype=d, name="ln2")(x), self.tp_axis)
+        h = nn.Dense(cfg.d_ff // tp, dtype=d, name="mlp_in")(h)  # (D, F/tp) local
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, use_bias=False, dtype=d, name="mlp_out")(h)
+        h = _reduce_from_tp(h, self.tp_axis)
+        h = h + self.param("mlp_out_bias", nn.initializers.zeros, (cfg.d_model,), jnp.float32).astype(d)
+        return x + h
+
+
+class TpTransformerLM(nn.Module):
+    """Tensor-parallel decoder LM. MUST run inside ``shard_map`` over a mesh
+    that has ``tp_axis`` (size 1 degenerates to the plain model)."""
+
+    cfg: TransformerConfig
+    tp_axis: str = "model"
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.cfg
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="tok_embed")(
+            tokens
+        )
+        x = x + nn.Embed(
+            cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
+        )(positions)
+        # Heads are kernel-independent, so the plain model's attention
+        # selection (dense/blockwise/flash/callable) applies unchanged to the
+        # local head shard.
+        attend = _attention_fn(cfg)
+        for i in range(cfg.num_layers):
+            x = TpBlock(cfg, tp_axis=self.tp_axis, name=f"block_{i}")(x, attend)
+        x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Param sharding specs.
+# ---------------------------------------------------------------------------
+
+_COLUMN_PARALLEL = ("q", "k", "v", "mlp_in")
+_ROW_PARALLEL = ("proj", "mlp_out")
+
+
+def _spec_for_path(path) -> P:
+    names = [p.key for p in path if hasattr(p, "key")]
+    if len(names) >= 2 and names[-2] in _COLUMN_PARALLEL:
+        return P(None, "model") if names[-1] == "kernel" else P("model")
+    if len(names) >= 2 and names[-2] in _ROW_PARALLEL and names[-1] == "kernel":
+        return P("model", None)
+    return P()
+
+
+def tp_param_specs(tree: Any) -> Any:
+    """PartitionSpec tree for a :class:`TpTransformerLM` param tree — also
+    valid for optimizer-state trees whose leaves mirror param paths (Adam
+    mu/nu); scalar leaves (e.g. Adam count) map to P()."""
+
+    def spec(path, leaf):
+        if getattr(leaf, "ndim", None) == 0:
+            return P()
+        return _spec_for_path(path)
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def shard_params(tree: Any, mesh: Mesh, specs: Any | None = None) -> Any:
+    """Place a host param/opt tree according to its TP specs. Every process
+    passes the same full GLOBAL tree; multi-process placement uses
+    ``make_array_from_callback`` (each process serves exactly its addressable
+    shards' slices of the global array — correct even when the 'model' axis
+    spans processes)."""
+    specs = specs if specs is not None else tp_param_specs(tree)
+
+    def place(x, s):
+        x = np.asarray(x)
+        sharding = NamedSharding(mesh, s)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+    return jax.tree_util.tree_map(place, tree, specs)
+
+
+# ---------------------------------------------------------------------------
+# Train step: DP over 'data' × TP over 'model', one jitted program.
+# ---------------------------------------------------------------------------
+
+
+def init_tp_params(cfg: TransformerConfig, seed: int = 0, sample_len: int = 8) -> Any:
+    """GLOBAL-shape host param tree for :class:`TpTransformerLM`.
+
+    The module queries ``lax.axis_size`` so init must run inside shard_map;
+    a trivial 1×1 ('data','model') mesh makes every local shape global."""
+    model = TpTransformerLM(cfg)
+    # local_devices: in a multi-process run every process must init on a
+    # device it can address (the shared seed makes all host trees identical).
+    mesh1 = Mesh(np.asarray(jax.local_devices()[:1]).reshape(1, 1), ("data", "model"))
+
+    def _init(rng, tokens):
+        return model.init(rng, tokens)["params"]
+
+    init_fn = jax.shard_map(
+        _init, mesh=mesh1, in_specs=(P(), P()), out_specs=P(), check_vma=False
+    )
+    params = init_fn(
+        jax.random.PRNGKey(seed), jnp.zeros((1, sample_len), jnp.int32)
+    )
+    return jax.device_get(params)
+
+
+def build_tp_lm_train_step(
+    cfg: TransformerConfig,
+    tx,
+    mesh: Mesh,
+    params_template: Any,
+    loss_fn: Callable = next_token_loss,
+    donate: bool = True,
+):
+    """step(params, opt_state, global_step, tokens, rng)
+        -> (params, opt_state, global_step, metrics)
+
+    ``tokens`` (B, S) sharded over 'data', replicated over 'model'; params
+    and optimizer state sharded per :func:`tp_param_specs` (derive the
+    placement with :func:`shard_params`). ``params_template`` is any
+    host/abstract tree with the model's param structure — it only feeds spec
+    derivation, no compute."""
+    if cfg.dropout_rate:
+        raise NotImplementedError(
+            "TP path has no dropout yet — set dropout_rate=0 (the non-TP "
+            "TransformerLM honors it)"
+        )
+    model = TpTransformerLM(cfg)
+    p_specs = tp_param_specs(params_template)
+    o_specs = tp_param_specs(jax.eval_shape(tx.init, params_template))
+
+    def _shard_step(params, opt_state, global_step, tokens, rng):
+        del rng  # no dropout in the TP path (guarded above)
+
+        def compute_loss(p):
+            logits = model.apply({"params": p}, tokens)
+            return loss_fn(logits, tokens)
+
+        loss, grads = jax.value_and_grad(compute_loss)(params)
+
+        # Gradient sync: data-parallel mean only. The model axis needs no
+        # grad collective — sharded params are wholly owned by their shard
+        # (the row-parallel psum's VJP hands every shard the full output
+        # cotangent), and replicated params' grads are already identical on
+        # all shards thanks to _copy_to_tp's backward psum at branch inputs.
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, "data"), grads)
+        loss = lax.pmean(loss, "data")
+        updates, new_opt = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, new_opt, global_step + 1, {"loss": loss}
+
+    shard_fn = jax.shard_map(
+        _shard_step,
+        mesh=mesh,
+        in_specs=(p_specs, o_specs, P(), P("data", None), P()),
+        out_specs=(p_specs, o_specs, P(), P()),
+        check_vma=False,
+    )
+    donate_args = (0, 1, 2) if donate else ()
+    return jax.jit(shard_fn, donate_argnums=donate_args)
