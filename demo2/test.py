@@ -49,6 +49,17 @@ def main(argv=None):
     parser.add_argument("--show", action="store_true")
     args, _ = parser.parse_known_args(argv)
 
+    if args.model and args.model.endswith(".stablehlo"):
+        # Frozen-program path (no model code, weights baked in).
+        from distributed_tensorflow_tpu.train.checkpoint import load_frozen_stablehlo
+
+        frozen_call, _ = load_frozen_stablehlo(args.model)
+
+        def predict_one(x):
+            return int(np.argmax(np.asarray(frozen_call(np.asarray(x, np.float32)))[0]))
+
+        return classify_digit_images(predict_one, args.imgs_dir, args.show)
+
     model = MnistCNN()
     params = load_params(model, args.log_dir, args.model)
     predict = jax.jit(lambda p, x: jax.numpy.argmax(model.apply({"params": p}, x), -1))

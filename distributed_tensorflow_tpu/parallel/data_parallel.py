@@ -48,6 +48,23 @@ def _to_global(tree: Any, sharding: NamedSharding) -> Any:
     )
 
 
+def place_by_specs(tree: Any, mesh: Mesh, specs: Any) -> Any:
+    """Place a host tree leaf-by-leaf per a matching PartitionSpec tree.
+    Every process passes the same full GLOBAL values; the multi-process path
+    uses ``make_array_from_callback`` (each process serves exactly its
+    addressable shards' slices — correct even when a sharded axis spans
+    processes). Used by the TP and PP param placements."""
+
+    def place(x, s):
+        x = np.asarray(x)
+        sharding = NamedSharding(mesh, s)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+    return jax.tree_util.tree_map(place, tree, specs)
+
+
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree fully-replicated over the mesh (params/opt state live in
     HBM once per device — the reference instead kept one copy on ps hosts and

@@ -1,0 +1,270 @@
+"""Pipeline parallelism (GPipe-style microbatching) over the mesh's
+``model`` axis.
+
+The reference has no pipeline parallelism (SURVEY §2.3); with this module the
+framework covers all four classic axes (DP / TP / SP / PP) on the same
+two-axis mesh. Design:
+
+  * the transformer's blocks are split into S = axis_size('model') stages;
+    each stage's block parameters are STACKED along a leading stage dim and
+    sharded ``P('model')`` — device s holds only its own layers;
+  * the batch is split into M microbatches; a ``lax.scan`` over
+    M + S - 1 ticks drives the classic GPipe schedule: stage 0 ingests
+    microbatch t, every stage applies its layers, activations hop to the
+    next stage via ``lax.ppermute`` (differentiable — the backward pass
+    hops in reverse automatically);
+  * embeddings / final-norm / LM head are replicated. Embedding gradients
+    are live only through stage 0's masked ingest path (every other shard
+    contributes exact zeros) and are ``psum``-ed over 'model'; final-norm and
+    head gradients are computed from the broadcast (replicated) outputs and
+    come out identical on every shard — no collective needed there.
+
+Numerics are verified by an exact-parity test against the plain
+``TransformerLM`` with the same (re-stacked) weights — see
+``tests/test_pipeline_parallel.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_tpu.models.transformer import (
+    Block,
+    TransformerConfig,
+    _attention_fn,
+    next_token_loss,
+)
+
+__all__ = [
+    "stack_stage_params",
+    "pp_param_specs",
+    "shard_pp_params",
+    "build_pp_lm_train_step",
+]
+
+
+def _collect_from_last(x, mask, axis: str):
+    """Broadcast the last stage's collected outputs to every shard: forward
+    ``psum(x * mask)`` (all other shards contribute zeros), backward delivers
+    the cotangent ONLY to the last stage (``t * mask``), unscaled. A raw psum
+    would multiply the pipeline's entire backward by the stage count (its
+    shard_map transpose is another psum — same pitfall as tensor_parallel's
+    ``_reduce_from_tp``)."""
+
+    @jax.custom_vjp
+    def f(v, m):
+        return lax.psum(v * m, axis)
+
+    def fwd(v, m):
+        return lax.psum(v * m, axis), m
+
+    def bwd(m, t):
+        return (t * m, None)
+
+    f.defvjp(fwd, bwd)
+    return f(x, mask)
+
+
+def _split_tree(params: dict, keys: tuple[str, ...]) -> tuple[dict, dict]:
+    inside = {k: v for k, v in params.items() if k in keys}
+    outside = {k: v for k, v in params.items() if k not in keys}
+    return inside, outside
+
+
+def stack_stage_params(lm_params: dict, num_stages: int) -> dict:
+    """Regroup a plain ``TransformerLM`` param tree for the pipeline:
+    ``block_i`` subtrees are stacked twice — layers-per-stage inside each
+    stage, stages on the leading dim → leaves ``(S, L/S, ...)``. Embeddings,
+    final norm, and head stay as-is (replicated)."""
+    block_names = sorted(
+        (k for k in lm_params if k.startswith("block_")),
+        key=lambda k: int(k.split("_")[1]),
+    )
+    n = len(block_names)
+    if n % num_stages:
+        raise ValueError(f"{n} layers not divisible into {num_stages} stages")
+    per = n // num_stages
+    blocks, rest = _split_tree(lm_params, tuple(block_names))
+
+    def stack(trees):
+        return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *trees)
+
+    stages = stack(
+        [
+            stack([blocks[block_names[s * per + l]] for l in range(per)])
+            for s in range(num_stages)
+        ]
+    )
+    return {"stages": stages, **rest}
+
+
+def unstack_stage_params(pp_params: dict) -> dict:
+    """Inverse of :func:`stack_stage_params`: back to the plain
+    ``TransformerLM`` tree (for export / checkpoint interchange)."""
+    stages = jax.tree_util.tree_map(np.asarray, jax.device_get(pp_params["stages"]))
+    rest = {k: v for k, v in pp_params.items() if k != "stages"}
+    sample = jax.tree_util.tree_leaves(stages)[0]
+    num_stages, per = sample.shape[0], sample.shape[1]
+    out = dict(jax.device_get(rest))
+    for s in range(num_stages):
+        for l in range(per):
+            out[f"block_{s * per + l}"] = jax.tree_util.tree_map(
+                lambda v: v[s, l], stages
+            )
+    return out
+
+
+def pp_param_specs(tree: Any) -> Any:
+    """'stages' subtree sharded on its leading (stage) dim; everything else
+    replicated. Works for optimizer-state trees too (path-suffix match)."""
+
+    def spec(path, leaf):
+        if getattr(leaf, "ndim", None) == 0:
+            return P()
+        names = [p.key for p in path if hasattr(p, "key")]
+        return P("model") if "stages" in names else P()
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def shard_pp_params(tree: Any, mesh: Mesh, specs: Any | None = None) -> Any:
+    """Place a stacked-stage param/opt tree (see ``data_parallel.place_by_specs``)."""
+    from distributed_tensorflow_tpu.parallel.data_parallel import place_by_specs
+
+    return place_by_specs(tree, mesh, specs if specs is not None else pp_param_specs(tree))
+
+
+def build_pp_lm_train_step(
+    cfg: TransformerConfig,
+    tx,
+    mesh: Mesh,
+    params_template: Any,
+    num_microbatches: int,
+    loss_fn: Callable = next_token_loss,
+    donate: bool = True,
+    pp_axis: str = "model",
+):
+    """step(params, opt_state, global_step, tokens, rng)
+        -> (params, opt_state, global_step, metrics)
+
+    ``params`` is a :func:`stack_stage_params` tree placed with
+    :func:`shard_pp_params`; ``tokens`` (B, T) sharded over 'data' with
+    B divisible by ``num_microbatches``.
+    """
+    if cfg.dropout_rate:
+        raise NotImplementedError("PP path has no dropout yet — set dropout_rate=0")
+    p_specs = pp_param_specs(params_template)
+    o_specs = pp_param_specs(jax.eval_shape(tx.init, params_template))
+    block = Block(cfg)
+    embed_mod = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype)
+    pos_mod = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype)
+    ln_f = nn.LayerNorm(dtype=cfg.compute_dtype)
+    head = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype)
+    attend = _attention_fn(cfg)
+    M = num_microbatches
+
+    def forward(params, tokens):
+        S = lax.axis_size(pp_axis)
+        stage = lax.axis_index(pp_axis)
+        b, t = tokens.shape
+        if b % M:
+            raise ValueError(f"local batch {b} not divisible into {M} microbatches")
+        bm = b // M
+
+        # Replicated embedding of ALL microbatches (only stage 0's ingest
+        # path keeps it live — see the where() below).
+        x = embed_mod.apply({"params": params["tok_embed"]}, tokens)
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        x = x + pos_mod.apply({"params": params["pos_embed"]}, positions)
+        micro = x.reshape(M, bm, t, cfg.d_model)
+
+        my_stage = jax.tree_util.tree_map(
+            lambda v: jnp.squeeze(v, 0), params["stages"]
+        )  # (L/S, ...) local layers
+
+        def apply_stage(h):
+            def layer(h, layer_params):
+                return block.apply({"params": layer_params}, h, attend), None
+
+            h, _ = lax.scan(layer, h, my_stage)
+            return h
+
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        n_ticks = M + S - 1
+
+        def tick(carry, ti):
+            state, outputs = carry
+            # Stage 0 ingests microbatch ti (clamped index; masked when done).
+            ingest = micro[jnp.minimum(ti, M - 1)]
+            inp = jnp.where(stage == 0, ingest, state)
+            out = apply_stage(inp)
+            # Last stage's tick ti output is microbatch ti-(S-1).
+            mi = ti - (S - 1)
+            write = jnp.logical_and(stage == S - 1, mi >= 0)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(write, out, outputs[jnp.maximum(mi, 0)]),
+                jnp.maximum(mi, 0),
+                axis=0,
+            )
+            state = lax.ppermute(out, pp_axis, fwd_perm)
+            return (state, outputs), None
+
+        init_outputs = jnp.zeros((M, bm, t, cfg.d_model), cfg.compute_dtype)
+        (_, outputs), _ = lax.scan(
+            tick,
+            (jnp.zeros((bm, t, cfg.d_model), cfg.compute_dtype), init_outputs),
+            jnp.arange(n_ticks),
+        )
+        # Broadcast the last stage's collected activations to every shard
+        # (all other shards hold zeros).
+        mask = jnp.where(stage == S - 1, 1.0, 0.0).astype(outputs.dtype)
+        outputs = _collect_from_last(outputs, mask, pp_axis)
+        h = outputs.reshape(b, t, cfg.d_model)
+        h = ln_f.apply({"params": params["ln_f"]}, h)
+        return head.apply({"params": params["lm_head"]}, h).astype(jnp.float32)
+
+    def _shard_step(params, opt_state, global_step, tokens, rng):
+        del rng
+
+        def compute_loss(p):
+            return loss_fn(forward(p, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(compute_loss)(params)
+
+        # Gradient sync by param group:
+        #   stages    — shard-owned; cotangents arrived via the reversed
+        #               ppermute chain, no model collective needed;
+        #   embeddings— live only through stage 0's masked ingest path (other
+        #               shards contribute exact zeros) -> psum over 'model';
+        #   ln_f/head — computed from replicated activations with a
+        #               replicated cotangent -> already identical, no-op.
+        # Then the data-parallel mean.
+        def sync(path, g):
+            names = [q.key for q in path if hasattr(q, "key")]
+            if "tok_embed" in names or "pos_embed" in names:
+                g = lax.psum(g, pp_axis)
+            return lax.pmean(g, "data")
+
+        grads = jax.tree_util.tree_map_with_path(sync, grads)
+        loss = lax.pmean(loss, "data")
+        updates, new_opt = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, new_opt, global_step + 1, {"loss": loss}
+
+    shard_fn = jax.shard_map(
+        _shard_step,
+        mesh=mesh,
+        in_specs=(p_specs, o_specs, P(), P("data", None), P()),
+        out_specs=(p_specs, o_specs, P(), P()),
+        check_vma=False,
+    )
+    donate_args = (0, 1, 2) if donate else ()
+    return jax.jit(shard_fn, donate_argnums=donate_args)

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_tensorflow_tpu.models.transformer import (
     TransformerConfig,
@@ -197,21 +197,11 @@ def tp_param_specs(tree: Any) -> Any:
 
 
 def shard_params(tree: Any, mesh: Mesh, specs: Any | None = None) -> Any:
-    """Place a host param/opt tree according to its TP specs. Every process
-    passes the same full GLOBAL tree; multi-process placement uses
-    ``make_array_from_callback`` (each process serves exactly its addressable
-    shards' slices of the global array — correct even when the 'model' axis
-    spans processes)."""
-    specs = specs if specs is not None else tp_param_specs(tree)
+    """Place a host param/opt tree according to its TP specs (every process
+    passes the same full GLOBAL tree; see ``data_parallel.place_by_specs``)."""
+    from distributed_tensorflow_tpu.parallel.data_parallel import place_by_specs
 
-    def place(x, s):
-        x = np.asarray(x)
-        sharding = NamedSharding(mesh, s)
-        if jax.process_count() == 1:
-            return jax.device_put(x, sharding)
-        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
-
-    return jax.tree_util.tree_map(place, tree, specs)
+    return place_by_specs(tree, mesh, specs if specs is not None else tp_param_specs(tree))
 
 
 # ---------------------------------------------------------------------------
