@@ -45,22 +45,13 @@ def main(argv=None):
         export_inference_bundle(out, trainer.params, metadata={"model": "MnistCNN"})
         log.info("Total time: %.2fs; model exported to %s", stats["seconds"], out)
         if cfg.export_stablehlo:
-            import jax as _jax
-            import numpy as np
-
             from distributed_tensorflow_tpu.train.checkpoint import (
-                export_frozen_stablehlo,
+                export_frozen_classifier,
             )
 
-            params = _jax.device_get(trainer.params)
-            model = trainer.model
-
-            def frozen_probs(images):
-                return _jax.nn.softmax(model.apply({"params": params}, images), -1)
-
-            export_frozen_stablehlo(
-                out + ".stablehlo", frozen_probs,
-                (np.zeros((1, 784), np.float32),), metadata={"model": "MnistCNN"},
+            export_frozen_classifier(
+                out + ".stablehlo", trainer.model.apply, trainer.params, (784,),
+                metadata={"model": "MnistCNN"},
             )
             log.info("exported frozen StableHLO program %s.stablehlo", out)
     return stats

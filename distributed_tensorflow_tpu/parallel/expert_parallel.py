@@ -1,0 +1,196 @@
+"""Expert parallelism (switch-style MoE) over the mesh's ``model`` axis.
+
+Completes the framework's parallelism coverage (DP / TP / SP / PP / EP — the
+reference has only async DP, SURVEY §2.3). Design:
+
+  * :class:`MoeMlp` replaces a transformer block's dense MLP with E experts
+    and a top-1 router (Switch Transformer): per token, the router picks one
+    expert; tokens are dispatched into per-expert capacity buffers with
+    deterministic position-priority truncation (capacity
+    ``ceil(tokens/E · capacity_factor)``);
+  * experts are SHARDED over 'model': each shard owns E/P experts (stacked
+    leading dim). Dispatch/combine run on every shard's local tokens; a pair
+    of ``lax.all_to_all`` collectives exchanges the capacity buffers so each
+    expert processes the tokens routed to it from every shard — compute
+    travels to the expert's owner, tokens come back combined;
+  * the router adds the standard load-balance auxiliary loss
+    (E · Σ_e fraction_e · mean_prob_e).
+
+Numerics: ep=P equals ep=1 exactly (same experts, same routing, relocation
+only) — verified in ``tests/test_expert_parallel.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_tpu.models.transformer import TransformerConfig
+
+__all__ = ["MoeMlp", "moe_param_specs", "shard_moe_params", "build_moe_layer_fn"]
+
+
+class MoeMlp(nn.Module):
+    """Top-1 (switch) mixture-of-experts MLP, expert-parallel over ``ep_axis``.
+
+    Call inside shard_map: input (N, D) local tokens → (output (N, D),
+    aux_loss scalar). Experts' params are stacked ``(E, ...)`` globally and
+    sharded ``P('model')`` — inside shard_map each shard sees ``(E/P, ...)``.
+    """
+
+    cfg: TransformerConfig
+    num_experts: int
+    capacity_factor: float = 2.0
+    ep_axis: str = "model"
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        d = cfg.compute_dtype
+        E = self.num_experts
+        ep = lax.axis_size(self.ep_axis)
+        if E % ep:
+            raise ValueError(f"num_experts {E} not divisible by ep={ep}")
+        local_e = E // ep
+        n, _ = x.shape
+        cap = int(np.ceil(n / E * self.capacity_factor))
+
+        # Router (replicated params): top-1 expert per token.
+        logits = nn.Dense(E, dtype=d, param_dtype=jnp.float32, name="router")(x)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+        expert_idx = jnp.argmax(probs, -1)  # (N,)
+        expert_prob = jnp.take_along_axis(probs, expert_idx[:, None], -1)[:, 0]
+
+        # Load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e.
+        one_hot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (N, E)
+        fraction = one_hot.mean(0)
+        mean_prob = probs.mean(0)
+        aux = E * jnp.sum(fraction * mean_prob)
+
+        # Capacity assignment: position-priority within each expert.
+        pos_in_expert = jnp.cumsum(one_hot, axis=0) * one_hot - 1.0  # (N, E)
+        kept = (pos_in_expert < cap) & (one_hot > 0)
+        # dispatch: (N, E, C) one-hot; combine adds the router prob weight.
+        pos = jnp.clip(pos_in_expert, 0, cap - 1).astype(jnp.int32)
+        dispatch = (
+            kept[..., None] & (jax.nn.one_hot(pos, cap, dtype=jnp.bool_))
+        ).astype(d)
+        combine = dispatch.astype(jnp.float32) * expert_prob[:, None, None]
+
+        # To expert buffers: (E, C, D) = tokens grouped by chosen expert.
+        buf = jnp.einsum("nd,nec->ecd", x.astype(d), dispatch)
+        # Exchange: each shard keeps its local_e experts' buffers from EVERY
+        # shard. (E, C, D) -> (ep, local_e, C, D) -> all_to_all over shards
+        # -> (ep, local_e, C, D) where dim0 is now the SOURCE shard.
+        buf = buf.reshape(ep, local_e, cap, cfg.d_model)
+        buf = lax.all_to_all(buf, self.ep_axis, split_axis=0, concat_axis=0, tiled=False)
+        # (ep, local_e, C, D): tokens for MY experts from all source shards.
+        buf = buf.transpose(1, 0, 2, 3).reshape(local_e, ep * cap, cfg.d_model)
+
+        # Apply local experts (stacked params, scanned).
+        w_in = self.param(
+            "w_in",
+            nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+            (local_e, cfg.d_model, cfg.d_ff),
+            jnp.float32,
+        )
+        b_in = self.param("b_in", nn.initializers.zeros, (local_e, cfg.d_ff), jnp.float32)
+        w_out = self.param(
+            "w_out",
+            nn.initializers.variance_scaling(1.0, "fan_in", "truncated_normal"),
+            (local_e, cfg.d_ff, cfg.d_model),
+            jnp.float32,
+        )
+        b_out = self.param(
+            "b_out", nn.initializers.zeros, (local_e, cfg.d_model), jnp.float32
+        )
+
+        def expert(tokens, wi, bi, wo, bo):
+            h = jnp.einsum("cd,df->cf", tokens, wi.astype(d)) + bi.astype(d)
+            h = nn.gelu(h)
+            return jnp.einsum("cf,fd->cd", h, wo.astype(d)) + bo.astype(d)
+
+        out = jax.vmap(expert)(buf, w_in, b_in, w_out, b_out)  # (local_e, ep*C, D)
+
+        # Route back: inverse all_to_all, then combine on the source shard.
+        out = out.reshape(local_e, ep, cap, cfg.d_model).transpose(1, 0, 2, 3)
+        out = lax.all_to_all(out, self.ep_axis, split_axis=0, concat_axis=0, tiled=False)
+        out = out.reshape(E, cap, cfg.d_model)
+        y = jnp.einsum("ecd,nec->nd", out.astype(jnp.float32), combine)
+        return y.astype(d), aux
+
+
+def moe_param_specs(tree: Any) -> Any:
+    """Expert-stacked leaves (w_in/b_in/w_out/b_out) sharded on dim 0 over
+    'model'; router and everything else replicated."""
+
+    def spec(path, leaf):
+        if getattr(leaf, "ndim", None) == 0:
+            return P()
+        names = [p.key for p in path if hasattr(p, "key")]
+        if names and names[-1] in ("w_in", "b_in", "w_out", "b_out"):
+            return P("model")
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def shard_moe_params(tree: Any, mesh: Mesh, specs: Any | None = None) -> Any:
+    from distributed_tensorflow_tpu.parallel.data_parallel import place_by_specs
+
+    return place_by_specs(tree, mesh, specs if specs is not None else moe_param_specs(tree))
+
+
+def init_moe_params(
+    cfg: TransformerConfig, num_experts: int, seed: int = 0, sample_tokens: int = 8, **kw
+) -> Any:
+    """GLOBAL-shape host params (expert dim = full E): init runs inside a
+    trivial 1×1 shard_map (the module queries ``lax.axis_size``)."""
+    layer = MoeMlp(cfg, num_experts=num_experts, **kw)
+    mesh1 = Mesh(np.asarray(jax.local_devices()[:1]).reshape(1, 1), ("data", "model"))
+
+    def _init(rng, x):
+        return layer.init(rng, x)["params"]
+
+    init_fn = jax.shard_map(
+        _init, mesh=mesh1, in_specs=(P(), P()), out_specs=P(), check_vma=False
+    )
+    return jax.device_get(
+        init_fn(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((sample_tokens, cfg.d_model), jnp.float32),
+        )
+    )
+
+
+def build_moe_layer_fn(
+    cfg: TransformerConfig, num_experts: int, mesh: Mesh, params_template: Any, **kw
+):
+    """Jitted shard_map apply: (params, x_local_tokens) -> (y, aux_loss).
+    x (N, D) sharded over 'data', replicated over 'model'; expert params per
+    :func:`moe_param_specs`. Gradient note: expert params are shard-owned and
+    router grads come out identical on every shard (all_to_all's AD transpose
+    is the inverse all_to_all — an orthogonal permutation, no scaling) — only
+    a data-axis mean is needed by callers."""
+    layer = MoeMlp(cfg, num_experts=num_experts, **kw)
+    specs = moe_param_specs(params_template)
+
+    def _apply(params, x):
+        y, aux = layer.apply({"params": params}, x)
+        return y, lax.pmean(aux, "data")
+
+    return jax.jit(
+        jax.shard_map(
+            _apply,
+            mesh=mesh,
+            in_specs=(specs, P(("data",), None)),
+            out_specs=(P(("data",), None), P()),
+            check_vma=False,
+        )
+    )

@@ -1,9 +1,9 @@
 """Pipeline parallelism (GPipe-style microbatching) over the mesh's
 ``model`` axis.
 
-The reference has no pipeline parallelism (SURVEY §2.3); with this module the
-framework covers all four classic axes (DP / TP / SP / PP) on the same
-two-axis mesh. Design:
+The reference has no pipeline parallelism (SURVEY §2.3); this module is the
+PP leg of the framework's five-axis coverage (DP / TP / SP / PP / EP) on the
+same two-axis mesh. Design:
 
   * the transformer's blocks are split into S = axis_size('model') stages;
     each stage's block parameters are STACKED along a leading stage dim and
@@ -160,6 +160,12 @@ def build_pp_lm_train_step(
     """
     if cfg.dropout_rate:
         raise NotImplementedError("PP path has no dropout yet — set dropout_rate=0")
+    stage_leaf = jax.tree_util.tree_leaves(params_template["stages"])[0]
+    if stage_leaf.shape[0] != mesh.shape[pp_axis]:
+        raise ValueError(
+            f"params stacked for {stage_leaf.shape[0]} stages but mesh "
+            f"'{pp_axis}' axis has {mesh.shape[pp_axis]} shards"
+        )
     p_specs = pp_param_specs(params_template)
     o_specs = pp_param_specs(jax.eval_shape(tx.init, params_template))
     block = Block(cfg)
@@ -201,7 +207,13 @@ def build_pp_lm_train_step(
 
         def tick(carry, ti):
             state, outputs = carry
-            # Stage 0 ingests microbatch ti (clamped index; masked when done).
+            # Stage 0 ingests microbatch ti. During the S-1 drain ticks
+            # (ti >= M) the clamped index re-processes microbatch M-1; that
+            # compute is DISCARDED, not masked — its outputs land outside the
+            # written window (tick t reaches the last stage at t+S-1 > the
+            # final tick) and the final carry is dropped, so no spurious
+            # contributions (or cotangents) exist. Keep that invariant if
+            # changing the schedule.
             ingest = micro[jnp.minimum(ti, M - 1)]
             inp = jnp.where(stage == 0, ingest, state)
             out = apply_stage(inp)

@@ -185,6 +185,29 @@ def export_frozen_stablehlo(
         fh.write(bytes(blob))
 
 
+def export_frozen_classifier(
+    path: str,
+    apply_fn,
+    params: Any,
+    input_shape: tuple[int, ...],
+    metadata: dict | None = None,
+) -> None:
+    """The one frozen-classifier export shape every CLI shares: bake
+    ``softmax(apply_fn({'params': params}, x))`` into a polymorphic-batch
+    StableHLO artifact, traced at ``(1, *input_shape)`` float32 input."""
+    params = jax.device_get(params)
+
+    def frozen_probs(x):
+        return jax.nn.softmax(apply_fn({"params": params}, x), -1)
+
+    export_frozen_stablehlo(
+        path,
+        frozen_probs,
+        (np.zeros((1, *input_shape), np.float32),),
+        metadata=metadata,
+    )
+
+
 def load_frozen_stablehlo(path: str):
     """Returns (callable, metadata): the deserialized exported program. The
     callable jit-executes on the current default backend — no model code or

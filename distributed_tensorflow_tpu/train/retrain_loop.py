@@ -311,19 +311,11 @@ class RetrainTrainer:
         )
         log.info("exported %s and %s", cfg.output_graph, cfg.output_labels)
         if cfg.export_stablehlo:
-            from distributed_tensorflow_tpu.train.checkpoint import export_frozen_stablehlo
-
-            params = jax.device_get(self.params)
-            head = self.head
-
-            def frozen_scores(bottlenecks):
-                return jax.nn.softmax(head.apply({"params": params}, bottlenecks), -1)
+            from distributed_tensorflow_tpu.train.checkpoint import export_frozen_classifier
 
             hlo_path = cfg.output_graph + ".stablehlo"
-            export_frozen_stablehlo(
-                hlo_path,
-                frozen_scores,
-                (np.zeros((1, iv3.BOTTLENECK_SIZE), np.float32),),
+            export_frozen_classifier(
+                hlo_path, self.head.apply, self.params, (iv3.BOTTLENECK_SIZE,),
                 metadata={"num_classes": self.class_count},
             )
             log.info("exported frozen StableHLO program %s", hlo_path)

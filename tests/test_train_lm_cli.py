@@ -1,0 +1,43 @@
+"""Smoke tests for the LM training CLI over the parallelism strategies (the
+heavy numerics live in the per-strategy test files)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
+
+def _main():
+    spec = importlib.util.spec_from_file_location(
+        "train_lm", os.path.join(_TOOLS, "train_lm.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+@pytest.mark.parametrize("mode,mp", [("dp", 1), ("tp", 2), ("pp", 2), ("sp", 2)])
+def test_train_lm_runs_and_learns(tmp_path, mode, mp):
+    out = str(tmp_path / "lm.msgpack")
+    loss = _main()(
+        [
+            "--parallelism", mode,
+            "--model_parallel", str(mp),
+            "--training_steps", "12",
+            "--eval_step_interval", "6",
+            "--seq_len", "32",
+            "--batch_size", "8",
+            "--num_layers", "2",
+            "--d_model", "32",
+            "--d_ff", "64",
+            "--num_heads", "2",
+            "--output", out,
+        ]
+    )
+    import numpy as np
+
+    assert np.isfinite(loss)
+    assert os.path.exists(out)
