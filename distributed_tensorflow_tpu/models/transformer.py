@@ -22,7 +22,9 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_tensorflow_tpu.ops import attention as A
 
@@ -56,7 +58,11 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, attend, train: bool = False):
+    def __call__(self, x, attend, train: bool = False, cache=None):
+        """``cache=None`` — training/prefill path (unchanged). With a cache
+        dict ``{'k','v','len'}`` (K/V laid out (B, H, S_max, dh), ``len`` the
+        filled prefix length), runs one-token decode and returns
+        ``(x, new_cache)``."""
         cfg = self.cfg
         h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x)
         b, s, _ = h.shape
@@ -65,7 +71,33 @@ class Block(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         # (B, S, D) -> (B, H, S, dh)
         to_heads = lambda t: t.reshape(b, s, cfg.num_heads, dh).transpose(0, 2, 1, 3)
-        attn = attend(to_heads(q), to_heads(k), to_heads(v))
+        if cache is None:
+            attn = attend(to_heads(q), to_heads(k), to_heads(v))
+        else:
+            # Cached decode (s tokens: 1 for the sampling loop, the whole
+            # prompt for prefill): append K/V at offset `len`, causally
+            # attend over prefix + self. f32 accumulation like
+            # ops.attention.dense_attention; NEG_INF (not -inf) keeps
+            # fully-masked softmax rows NaN-free.
+            ks = jax.lax.dynamic_update_slice(
+                cache["k"], to_heads(k), (0, 0, cache["len"], 0)
+            )
+            vs = jax.lax.dynamic_update_slice(
+                cache["v"], to_heads(v), (0, 0, cache["len"], 0)
+            )
+            qh = to_heads(q)
+            scores = jnp.einsum(
+                "bhqd,bhkd->bhqk", qh, ks, preferred_element_type=jnp.float32
+            ) / np.sqrt(dh)
+            q_pos = cache["len"] + jnp.arange(s)  # (s,)
+            key_pos = jnp.arange(ks.shape[2])  # (S_max,)
+            allowed = key_pos[None, :] <= q_pos[:, None]  # (s, S_max)
+            scores = jnp.where(allowed[None, None, :, :], scores, A.NEG_INF)
+            weights = jax.nn.softmax(scores, -1)
+            attn = jnp.einsum(
+                "bhqk,bhkd->bhqd", weights, vs.astype(jnp.float32)
+            ).astype(qh.dtype)
+            cache = {"k": ks, "v": vs, "len": cache["len"] + s}
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
         attn = nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, name="proj")(attn)
         if cfg.dropout_rate:
@@ -78,7 +110,8 @@ class Block(nn.Module):
         h = nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, name="mlp_out")(h)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
-        return x + h
+        x = x + h
+        return x if cache is None else (x, cache)
 
 
 class TransformerLM(nn.Module):
@@ -91,11 +124,14 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, train: bool = False):
+    def __call__(self, tokens, positions=None, train: bool = False, cache=None):
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+            # Cached decode continues at the filled prefix length; plain
+            # forward starts at 0.
+            start = cache["len"] if cache is not None else 0
+            positions = jnp.broadcast_to(start + jnp.arange(s, dtype=jnp.int32), (b, s))
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="tok_embed")(
             tokens
         )
@@ -103,11 +139,22 @@ class TransformerLM(nn.Module):
             cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
         )(positions)
         attend = _attention_fn(cfg)
-        for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"block_{i}")(x, attend, train=train)
+        if cache is None:
+            for i in range(cfg.num_layers):
+                x = Block(cfg, name=f"block_{i}")(x, attend, train=train)
+        else:
+            # Cache layout: {'layers': [{'k','v'}, ...], 'len': scalar} — one
+            # shared filled-length for all layers (they advance in lockstep).
+            new_layers = []
+            for i in range(cfg.num_layers):
+                layer = dict(cache["layers"][i], len=cache["len"])
+                x, layer = Block(cfg, name=f"block_{i}")(x, attend, train=train, cache=layer)
+                new_layers.append({"k": layer["k"], "v": layer["v"]})
+            cache = {"layers": new_layers, "len": cache["len"] + s}
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        return logits if cache is None else (logits, cache)
 
 
 def next_token_loss(logits, tokens, weight=None):
@@ -115,8 +162,6 @@ def next_token_loss(logits, tokens, weight=None):
 
     ``weight`` (B, S) optionally masks positions (e.g. sequence-shard padding).
     """
-    import jax
-
     targets = tokens[:, 1:]
     lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
     nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
