@@ -44,6 +44,8 @@ def build_generate_fn(
     """Returns jitted ``generate(params, prompt (B, P) int32, rng) ->
     tokens (B, P + max_new_tokens)``. ``temperature == 0`` is greedy.
     P must be ≥ 1 (conditional generation; the model has no BOS token)."""
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     model = TransformerLM(cfg)
 
     def one_token(params, cache, tok):

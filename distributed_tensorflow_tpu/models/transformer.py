@@ -54,55 +54,65 @@ def _attention_fn(cfg: TransformerConfig) -> Callable:
     raise ValueError(f"unknown attention implementation: {cfg.attention!r}")
 
 
+def attention_sublayer(cfg, x, attend, train: bool = False, cache=None, dropout: bool = True):
+    """Pre-norm self-attention + residual, shared by :class:`Block` and the
+    MoE block (``parallel/expert_parallel.py``). MUST be called from inside
+    an ``@nn.compact`` module body — layers are declared with fixed names
+    (``ln1``/``qkv``/``proj``) on the CALLING module, so extracting this
+    helper changed no parameter tree. Returns ``(x, cache)`` (cache None on
+    the plain path)."""
+    h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x)
+    b, s, _ = h.shape
+    dh = cfg.d_model // cfg.num_heads
+    qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.compute_dtype, name="qkv")(h)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    # (B, S, D) -> (B, H, S, dh)
+    to_heads = lambda t: t.reshape(b, s, cfg.num_heads, dh).transpose(0, 2, 1, 3)
+    if cache is None:
+        attn = attend(to_heads(q), to_heads(k), to_heads(v))
+    else:
+        # Cached decode (s tokens: 1 for the sampling loop, the whole
+        # prompt for prefill): append K/V at offset `len`, causally
+        # attend over prefix + self. f32 accumulation like
+        # ops.attention.dense_attention; NEG_INF (not -inf) keeps
+        # fully-masked softmax rows NaN-free.
+        ks = jax.lax.dynamic_update_slice(
+            cache["k"], to_heads(k), (0, 0, cache["len"], 0)
+        )
+        vs = jax.lax.dynamic_update_slice(
+            cache["v"], to_heads(v), (0, 0, cache["len"], 0)
+        )
+        qh = to_heads(q)
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", qh, ks, preferred_element_type=jnp.float32
+        ) / np.sqrt(dh)
+        q_pos = cache["len"] + jnp.arange(s)  # (s,)
+        key_pos = jnp.arange(ks.shape[2])  # (S_max,)
+        allowed = key_pos[None, :] <= q_pos[:, None]  # (s, S_max)
+        scores = jnp.where(allowed[None, None, :, :], scores, A.NEG_INF)
+        weights = jax.nn.softmax(scores, -1)
+        attn = jnp.einsum(
+            "bhqk,bhkd->bhqd", weights, vs.astype(jnp.float32)
+        ).astype(qh.dtype)
+        cache = {"k": ks, "v": vs, "len": cache["len"] + s}
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+    attn = nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, name="proj")(attn)
+    if dropout and cfg.dropout_rate:
+        attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
+    return x + attn, cache
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x, attend, train: bool = False, cache=None):
-        """``cache=None`` — training/prefill path (unchanged). With a cache
-        dict ``{'k','v','len'}`` (K/V laid out (B, H, S_max, dh), ``len`` the
-        filled prefix length), runs one-token decode and returns
+        """``cache=None`` — training/prefill path. With a cache dict
+        ``{'k','v','len'}`` (K/V laid out (B, H, S_max, dh), ``len`` the
+        filled prefix length), runs cached decode and returns
         ``(x, new_cache)``."""
         cfg = self.cfg
-        h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x)
-        b, s, _ = h.shape
-        dh = cfg.d_model // cfg.num_heads
-        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.compute_dtype, name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        # (B, S, D) -> (B, H, S, dh)
-        to_heads = lambda t: t.reshape(b, s, cfg.num_heads, dh).transpose(0, 2, 1, 3)
-        if cache is None:
-            attn = attend(to_heads(q), to_heads(k), to_heads(v))
-        else:
-            # Cached decode (s tokens: 1 for the sampling loop, the whole
-            # prompt for prefill): append K/V at offset `len`, causally
-            # attend over prefix + self. f32 accumulation like
-            # ops.attention.dense_attention; NEG_INF (not -inf) keeps
-            # fully-masked softmax rows NaN-free.
-            ks = jax.lax.dynamic_update_slice(
-                cache["k"], to_heads(k), (0, 0, cache["len"], 0)
-            )
-            vs = jax.lax.dynamic_update_slice(
-                cache["v"], to_heads(v), (0, 0, cache["len"], 0)
-            )
-            qh = to_heads(q)
-            scores = jnp.einsum(
-                "bhqd,bhkd->bhqk", qh, ks, preferred_element_type=jnp.float32
-            ) / np.sqrt(dh)
-            q_pos = cache["len"] + jnp.arange(s)  # (s,)
-            key_pos = jnp.arange(ks.shape[2])  # (S_max,)
-            allowed = key_pos[None, :] <= q_pos[:, None]  # (s, S_max)
-            scores = jnp.where(allowed[None, None, :, :], scores, A.NEG_INF)
-            weights = jax.nn.softmax(scores, -1)
-            attn = jnp.einsum(
-                "bhqk,bhkd->bhqd", weights, vs.astype(jnp.float32)
-            ).astype(qh.dtype)
-            cache = {"k": ks, "v": vs, "len": cache["len"] + s}
-        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
-        attn = nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, name="proj")(attn)
-        if cfg.dropout_rate:
-            attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
-        x = x + attn
+        x, cache = attention_sublayer(cfg, x, attend, train=train, cache=cache)
 
         h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(x)
         h = nn.Dense(cfg.d_ff, dtype=cfg.compute_dtype, name="mlp_in")(h)

@@ -31,9 +31,45 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from distributed_tensorflow_tpu.models.transformer import TransformerConfig
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    _attention_fn,
+    attention_sublayer,
+    next_token_loss,
+)
 
-__all__ = ["MoeMlp", "moe_param_specs", "shard_moe_params", "build_moe_layer_fn"]
+__all__ = [
+    "MoeMlp",
+    "moe_param_specs",
+    "shard_moe_params",
+    "build_moe_layer_fn",
+    "MoeTransformerLM",
+    "init_moe_lm_params",
+    "build_moe_lm_train_step",
+]
+
+
+def _exchange(x, axis: str):
+    """The capacity-buffer exchange as a custom-VJP involution: forward is
+    ``all_to_all`` over dim 0 (shard i's chunk j → shard j's slot i — applying
+    it twice is the identity), backward is the SAME exchange on the cotangent,
+    unscaled. Raw ``lax.all_to_all`` must not be used: its shard_map transpose
+    accumulates the replicated cotangent once per shard (measured: exactly
+    ×ep gradient inflation on every expert parameter — the same AD pitfall as
+    the raw-psum cases in tensor/pipeline parallelism)."""
+
+    @jax.custom_vjp
+    def f(v):
+        return lax.all_to_all(v, axis, split_axis=0, concat_axis=0, tiled=False)
+
+    def fwd(v):
+        return f(v), None
+
+    def bwd(_, t):
+        return (lax.all_to_all(t, axis, split_axis=0, concat_axis=0, tiled=False),)
+
+    f.defvjp(fwd, bwd)
+    return f(x)
 
 
 class MoeMlp(nn.Module):
@@ -89,7 +125,7 @@ class MoeMlp(nn.Module):
         # shard. (E, C, D) -> (ep, local_e, C, D) -> all_to_all over shards
         # -> (ep, local_e, C, D) where dim0 is now the SOURCE shard.
         buf = buf.reshape(ep, local_e, cap, cfg.d_model)
-        buf = lax.all_to_all(buf, self.ep_axis, split_axis=0, concat_axis=0, tiled=False)
+        buf = _exchange(buf, self.ep_axis)
         # (ep, local_e, C, D): tokens for MY experts from all source shards.
         buf = buf.transpose(1, 0, 2, 3).reshape(local_e, ep * cap, cfg.d_model)
 
@@ -120,10 +156,156 @@ class MoeMlp(nn.Module):
 
         # Route back: inverse all_to_all, then combine on the source shard.
         out = out.reshape(local_e, ep, cap, cfg.d_model).transpose(1, 0, 2, 3)
-        out = lax.all_to_all(out, self.ep_axis, split_axis=0, concat_axis=0, tiled=False)
+        out = _exchange(out, self.ep_axis)
         out = out.reshape(E, cap, cfg.d_model)
         y = jnp.einsum("ecd,nec->nd", out.astype(jnp.float32), combine)
         return y.astype(d), aux
+
+
+class MoeBlock(nn.Module):
+    """Transformer block with the dense MLP replaced by :class:`MoeMlp`.
+    Attention is the plain (replicated) path; returns (x, aux_loss)."""
+
+    cfg: TransformerConfig
+    num_experts: int
+    capacity_factor: float = 2.0
+    ep_axis: str = "model"
+
+    @nn.compact
+    def __call__(self, x, attend):
+        cfg = self.cfg
+        d = cfg.compute_dtype
+        x, _ = attention_sublayer(cfg, x, attend, dropout=False)
+        b, s, _unused = x.shape
+
+        h = nn.LayerNorm(dtype=d, name="ln2")(x)
+        y, aux = MoeMlp(
+            cfg,
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor,
+            ep_axis=self.ep_axis,
+            name="moe",
+        )(h.reshape(b * s, cfg.d_model))
+        return x + y.reshape(b, s, cfg.d_model), aux
+
+
+class MoeTransformerLM(nn.Module):
+    """Decoder LM with MoE MLPs in every block (expert-parallel over
+    ``ep_axis``). MUST run inside shard_map. Returns (logits, total_aux)."""
+
+    cfg: TransformerConfig
+    num_experts: int
+    capacity_factor: float = 2.0
+    ep_axis: str = "model"
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.cfg
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="tok_embed")(
+            tokens
+        )
+        x = x + nn.Embed(
+            cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
+        )(positions)
+        attend = _attention_fn(cfg)
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.num_layers):
+            x, aux = MoeBlock(
+                cfg,
+                num_experts=self.num_experts,
+                capacity_factor=self.capacity_factor,
+                ep_axis=self.ep_axis,
+                name=f"block_{i}",
+            )(x, attend)
+            aux_total = aux_total + aux
+        x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32), aux_total / cfg.num_layers
+
+
+def init_moe_lm_params(
+    cfg: TransformerConfig, num_experts: int, seed: int = 0, sample_len: int = 8, **kw
+) -> Any:
+    """GLOBAL-shape host params (1×1 shard_map init, like the MoE layer's)."""
+    from distributed_tensorflow_tpu.parallel.mesh import unit_mesh_init
+
+    model = MoeTransformerLM(cfg, num_experts=num_experts, **kw)
+    return unit_mesh_init(
+        lambda rng, tokens: model.init(rng, tokens)["params"],
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, sample_len), jnp.int32),
+    )
+
+
+def build_moe_lm_train_step(
+    cfg: TransformerConfig,
+    num_experts: int,
+    tx,
+    mesh: Mesh,
+    params_template: Any,
+    aux_weight: float = 0.01,
+    donate: bool = True,
+    **kw,
+):
+    """step(params, opt_state, global_step, tokens, rng)
+        -> (params, opt_state, global_step, metrics)  # loss + aux
+
+    DP over 'data' × EP over 'model' in one program. Gradient sync is a
+    data-axis mean only: expert grads are shard-owned (each model shard owns
+    distinct experts, and the all_to_all AD is exact), replicated-param grads
+    come out identical on every model shard."""
+    if cfg.dropout_rate:
+        raise NotImplementedError("MoE path has no dropout yet — set dropout_rate=0")
+    if kw.get("ep_axis", "model") != "model":
+        # moe_param_specs, the in_specs, and the grad normalization below all
+        # assume the 'model' axis.
+        raise NotImplementedError("build_moe_lm_train_step supports ep_axis='model' only")
+    model = MoeTransformerLM(cfg, num_experts=num_experts, **kw)
+    p_specs = moe_param_specs(params_template)
+    o_specs = moe_param_specs(jax.eval_shape(tx.init, params_template))
+
+    def _shard_step(params, opt_state, global_step, tokens, rng):
+        del rng
+
+        def compute_loss(p):
+            logits, aux = model.apply({"params": p}, tokens)
+            return next_token_loss(logits, tokens) + aux_weight * aux, aux
+
+        (loss, aux), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+
+        # Every model shard dispatches the SAME (model-replicated) tokens, so
+        # each expert processes its tokens once per shard and its owner's
+        # gradient accumulates ep duplicate contributions — normalize by the
+        # axis size (the duplicate compute itself is wall-clock neutral:
+        # per-shard expert work is E·cap tokens regardless of ep; EP buys
+        # expert MEMORY scaling). Replicated params need no model collective.
+        ep_size = lax.axis_size("model")
+
+        def sync(path, g):
+            names = [q.key for q in path if hasattr(q, "key")]
+            if names and names[-1] in ("w_in", "b_in", "w_out", "b_out"):
+                g = g / ep_size
+            return lax.pmean(g, "data")
+
+        grads = jax.tree_util.tree_map_with_path(sync, grads)
+        loss = lax.pmean(loss, "data")
+        aux = lax.pmean(aux, "data")
+        updates, new_opt = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, new_opt, global_step + 1, {"loss": loss, "aux": aux}
+
+    shard_fn = jax.shard_map(
+        _shard_step,
+        mesh=mesh,
+        in_specs=(p_specs, o_specs, P(), P("data", None), P()),
+        out_specs=(p_specs, o_specs, P(), P()),
+        check_vma=False,
+    )
+    donate_args = (0, 1, 2) if donate else ()
+    return jax.jit(shard_fn, donate_argnums=donate_args)
 
 
 def moe_param_specs(tree: Any) -> Any:
@@ -152,20 +334,13 @@ def init_moe_params(
 ) -> Any:
     """GLOBAL-shape host params (expert dim = full E): init runs inside a
     trivial 1×1 shard_map (the module queries ``lax.axis_size``)."""
+    from distributed_tensorflow_tpu.parallel.mesh import unit_mesh_init
+
     layer = MoeMlp(cfg, num_experts=num_experts, **kw)
-    mesh1 = Mesh(np.asarray(jax.local_devices()[:1]).reshape(1, 1), ("data", "model"))
-
-    def _init(rng, x):
-        return layer.init(rng, x)["params"]
-
-    init_fn = jax.shard_map(
-        _init, mesh=mesh1, in_specs=(P(), P()), out_specs=P(), check_vma=False
-    )
-    return jax.device_get(
-        init_fn(
-            jax.random.PRNGKey(seed),
-            jnp.zeros((sample_tokens, cfg.d_model), jnp.float32),
-        )
+    return unit_mesh_init(
+        lambda rng, x: layer.init(rng, x)["params"],
+        jax.random.PRNGKey(seed),
+        jnp.zeros((sample_tokens, cfg.d_model), jnp.float32),
     )
 
 
@@ -174,10 +349,11 @@ def build_moe_layer_fn(
 ):
     """Jitted shard_map apply: (params, x_local_tokens) -> (y, aux_loss).
     x (N, D) sharded over 'data', replicated over 'model'; expert params per
-    :func:`moe_param_specs`. Gradient note: expert params are shard-owned and
-    router grads come out identical on every shard (all_to_all's AD transpose
-    is the inverse all_to_all — an orthogonal permutation, no scaling) — only
-    a data-axis mean is needed by callers."""
+    :func:`moe_param_specs`. Gradient note for callers differentiating
+    through this fn: replicated params (router) come out identical on every
+    shard, but expert-leaf grads accumulate one duplicate contribution per
+    model shard (every shard dispatches the same replicated tokens) — divide
+    them by the axis size before use, as ``build_moe_lm_train_step`` does."""
     layer = MoeMlp(cfg, num_experts=num_experts, **kw)
     specs = moe_param_specs(params_template)
 

@@ -19,6 +19,28 @@ import jax
 from jax.sharding import Mesh
 
 
+def unit_mesh_init(init_fn, *args):
+    """Run a parameter-init function inside a trivial 1×1 ('data','model')
+    shard_map on one LOCAL device and return host numpy — the standard way to
+    get GLOBAL-shape params for modules that query ``lax.axis_size`` (TP/MoE).
+    The shard_map is jitted as a whole: eager shard_map dispatches every
+    primitive as its own program, which takes minutes through the axon tunnel.
+    Multi-process safe (local device + shared seed ⇒ identical host trees)."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh1 = Mesh(np.asarray(jax.local_devices()[:1]).reshape(1, 1), ("data", "model"))
+    fn = jax.jit(
+        jax.shard_map(
+            init_fn,
+            mesh=mesh1,
+            in_specs=tuple(P() for _ in args),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    return jax.device_get(fn(*args))
+
+
 def make_mesh(
     num_devices: int | None = None,
     model_parallel: int = 1,

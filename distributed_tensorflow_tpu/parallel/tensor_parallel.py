@@ -214,21 +214,14 @@ def init_tp_params(cfg: TransformerConfig, seed: int = 0, sample_len: int = 8) -
 
     The module queries ``lax.axis_size`` so init must run inside shard_map;
     a trivial 1×1 ('data','model') mesh makes every local shape global."""
+    from distributed_tensorflow_tpu.parallel.mesh import unit_mesh_init
+
     model = TpTransformerLM(cfg)
-    # local_devices: in a multi-process run every process must init on a
-    # device it can address (the shared seed makes all host trees identical).
-    mesh1 = Mesh(np.asarray(jax.local_devices()[:1]).reshape(1, 1), ("data", "model"))
-
-    def _init(rng, tokens):
-        return model.init(rng, tokens)["params"]
-
-    init_fn = jax.shard_map(
-        _init, mesh=mesh1, in_specs=(P(), P()), out_specs=P(), check_vma=False
+    return unit_mesh_init(
+        lambda rng, tokens: model.init(rng, tokens)["params"],
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, sample_len), jnp.int32),
     )
-    params = init_fn(
-        jax.random.PRNGKey(seed), jnp.zeros((1, sample_len), jnp.int32)
-    )
-    return jax.device_get(params)
 
 
 def build_tp_lm_train_step(

@@ -96,3 +96,68 @@ def test_grads_flow_to_experts(host_params):
     assert np.isfinite(gw).all()
     assert (np.abs(gw).sum(axis=(1, 2)) > 0).sum() >= 2  # several experts active
     assert np.abs(np.asarray(grads["router"]["kernel"])).sum() > 0
+
+
+LM_CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    num_heads=2,
+    num_layers=2,
+    d_ff=64,
+    max_seq_len=32,
+    compute_dtype=jnp.float32,
+)
+
+
+def _moe_lm_one_step(mesh, host, tokens, lr=0.1):
+    import optax
+    from jax.sharding import NamedSharding
+
+    tx = optax.sgd(lr)
+    step = ep.build_moe_lm_train_step(LM_CFG, E, tx, mesh, host, donate=False)
+    params = ep.shard_moe_params(host, mesh)
+    opt = ep.shard_moe_params(jax.device_get(tx.init(host)), mesh)
+    g = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
+    params, opt, g, m = step(params, opt, g, tokens, jax.random.PRNGKey(0))
+    return (
+        jax.device_get(params),
+        float(jax.device_get(m["loss"])),
+        float(jax.device_get(m["aux"])),
+    )
+
+
+def test_moe_lm_ep2_matches_ep1():
+    host = ep.init_moe_lm_params(LM_CFG, num_experts=E, seed=0)
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, LM_CFG.vocab_size, (8, 16)), jnp.int32
+    )
+    p1, loss1, aux1 = _moe_lm_one_step(make_mesh(num_devices=4), host, tokens)
+    p2, loss2, aux2 = _moe_lm_one_step(make_mesh(model_parallel=2), host, tokens)
+    np.testing.assert_allclose(loss1, loss2, rtol=2e-5)
+    np.testing.assert_allclose(aux1, aux2, rtol=2e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5), p1, p2
+    )
+
+
+def test_moe_lm_trains_and_loss_decreases():
+    import optax
+    from jax.sharding import NamedSharding
+
+    host = ep.init_moe_lm_params(LM_CFG, num_experts=E, seed=1)
+    mesh = make_mesh(model_parallel=2)
+    tx = optax.adam(3e-3)
+    step = ep.build_moe_lm_train_step(LM_CFG, E, tx, mesh, host, donate=False)
+    params = ep.shard_moe_params(host, mesh)
+    opt = ep.shard_moe_params(jax.device_get(tx.init(host)), mesh)
+    g = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
+    rng = np.random.default_rng(0)
+    first = last = None
+    for _ in range(25):
+        half = rng.integers(2, LM_CFG.vocab_size, (8, 8))
+        tokens = jnp.asarray(np.concatenate([half, half], 1), jnp.int32)
+        params, opt, g, m = step(params, opt, g, tokens, jax.random.PRNGKey(0))
+        last = float(jax.device_get(m["loss"]))
+        first = last if first is None else first
+    assert int(jax.device_get(g)) == 25
+    assert last < first * 0.9, (first, last)

@@ -19,7 +19,7 @@ def _main():
     return mod.main
 
 
-@pytest.mark.parametrize("mode,mp", [("dp", 1), ("tp", 2), ("pp", 2), ("sp", 2)])
+@pytest.mark.parametrize("mode,mp", [("dp", 1), ("tp", 2), ("pp", 2), ("sp", 2), ("ep", 2)])
 def test_train_lm_runs_and_learns(tmp_path, mode, mp):
     out = str(tmp_path / "lm.msgpack")
     loss = _main()(

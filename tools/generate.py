@@ -54,10 +54,11 @@ def main(argv=None):
         compute_dtype=jnp.float32,
     )
     state, meta = load_inference_bundle(args.model)
-    if meta.get("parallelism") == "tp":
+    if meta.get("parallelism") in ("tp", "ep"):
         sys.exit(
-            "tp bundles use a separate-q/k/v factorization the plain decoder "
-            "cannot load — retrain with dp/sp/pp or export from the tp model"
+            f"{meta['parallelism']} bundles use a different param factorization "
+            "(separate q/k/v for tp, expert-stacked MoE MLPs for ep) that the "
+            "plain decoder cannot load — retrain with dp/sp/pp"
         )
     if "stages" in state:  # pp bundle: back to the plain layout
         from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
@@ -76,6 +77,12 @@ def main(argv=None):
 
     if args.prompt:
         prompt = np.asarray([[int(t) for t in args.prompt.split(",")]], np.int32)
+        bad = prompt[(prompt < 0) | (prompt >= cfg.vocab_size)]
+        if bad.size:
+            sys.exit(
+                f"prompt ids {sorted(set(bad.tolist()))} outside [0, "
+                f"{cfg.vocab_size}) — the embedding would silently clamp them"
+            )
     else:
         prompt = np.random.default_rng(args.seed).integers(
             2, cfg.vocab_size, (1, 8), dtype=np.int32

@@ -7,6 +7,8 @@ framework's parallelism stack. Selectable strategy:
                       ring attention via ppermute (long contexts)
   --parallelism tp    Megatron tensor parallelism: heads/FFN over 'model'
   --parallelism pp    GPipe pipeline parallelism: layer stages over 'model'
+  --parallelism ep    switch-MoE expert parallelism: --num_experts experts
+                      sharded over 'model', all_to_all token exchange
 
 Data: a synthetic copy-structured token stream (deterministic, learnable) —
 this environment has no corpora. One JSON line per eval interval; final
@@ -39,7 +41,10 @@ def synthetic_tokens(rng, batch, seq_len, vocab):
 
 def main(argv=None):
     parser = argparse.ArgumentParser()
-    parser.add_argument("--parallelism", choices=("dp", "sp", "tp", "pp"), default="dp")
+    parser.add_argument(
+        "--parallelism", choices=("dp", "sp", "tp", "pp", "ep"), default="dp"
+    )
+    parser.add_argument("--num_experts", type=int, default=4, help="ep only")
     parser.add_argument("--model_parallel", type=int, default=1)
     parser.add_argument("--training_steps", type=int, default=100)
     parser.add_argument("--eval_step_interval", type=int, default=10)
@@ -88,7 +93,17 @@ def main(argv=None):
     rep = lambda t: dp.replicate(t, mesh)
     g0 = rep(jnp.zeros((), jnp.int32))
 
-    if args.parallelism == "tp":
+    if args.parallelism == "ep":
+        from distributed_tensorflow_tpu.parallel import expert_parallel as epx
+
+        host = epx.init_moe_lm_params(cfg, num_experts=args.num_experts, seed=args.seed)
+        step = epx.build_moe_lm_train_step(
+            cfg, args.num_experts, tx, mesh, host, donate=False
+        )
+        params = epx.shard_moe_params(host, mesh)
+        opt = epx.shard_moe_params(jax.device_get(tx.init(host)), mesh)
+        place = lambda t: dp.shard_global_batch({"x": t}, mesh)["x"]
+    elif args.parallelism == "tp":
         from distributed_tensorflow_tpu.parallel import tensor_parallel as tp
 
         host = tp.init_tp_params(cfg, seed=args.seed)
