@@ -201,6 +201,18 @@ class RetrainConfig:
             "because this environment has no egress)"
         },
     )
+    train_dir: str = field(
+        default="",
+        metadata={
+            "help": "head-training checkpoint dir (Supervisor logdir parity, "
+            "retrain2/retrain2.py:423-429: timed autosave + auto-restore); "
+            "empty disables checkpointing (retrain1 reference behavior)"
+        },
+    )
+    save_model_secs: int = field(
+        default=600,
+        metadata={"help": "autosave interval when --train_dir is set"},
+    )
 
 
 @dataclass

@@ -69,6 +69,12 @@ class CheckpointManager:
         return True
 
     def save(self, step: int, state: Any) -> None:
+        if self._mngr.latest_step() == step:
+            # Re-saving an existing step raises StepAlreadyExistsError in
+            # Orbax — hit when a finished job restarts (restore to step N,
+            # zero-iteration loop, final forced save of N) or when the timed
+            # gate fires on the very last step before the final save.
+            return
         self._mngr.save(step, args=ocp.args.StandardSave(jax.device_get(state)))
         self._mngr.wait_until_finished()
 
@@ -95,6 +101,56 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._mngr.close()
+
+
+def restore_replicated(mngr: CheckpointManager, template: Any, mesh):
+    """Restore the newest checkpoint and place it mesh-replicated, leaf
+    dtypes taken from ``template`` (the live train state). Returns
+    (step, state) or None. Shared by the MNIST and retrain trainers."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.parallel import data_parallel as dp
+
+    restored = mngr.restore_latest(template)
+    if restored is None:
+        return None
+    step, state = restored
+    placed = jax.tree_util.tree_map(
+        lambda a, b: dp.replicate(jnp.asarray(b, a.dtype), mesh)
+        if hasattr(a, "dtype")
+        else b,
+        template,
+        state,
+    )
+    return step, placed
+
+
+def coordinated_maybe_save(
+    mngr: CheckpointManager,
+    step: int,
+    state: Any,
+    is_chief: bool,
+    force: bool = False,
+    at_boundary: bool = True,
+) -> None:
+    """Timed autosave, multi-process safe — the one save gate both trainers
+    use. Orbax saves are COLLECTIVE when ``jax.process_count() > 1``: a
+    chief-only save desynchronizes the process group (observed gloo
+    size-mismatch crash), so the chief's timed-gate decision is broadcast at
+    eval boundaries and every process enters the save together. Single
+    process keeps exact Supervisor semantics (chief-only, per-call gate)."""
+    if jax.process_count() == 1:
+        if is_chief:
+            mngr.maybe_save(step, state, force=force)
+        return
+    if not (at_boundary or force):
+        return
+    from jax.experimental import multihost_utils
+
+    want = mngr.should_save(force)
+    if bool(multihost_utils.broadcast_one_to_all(np.asarray(want))):
+        mngr.save(step, state)
+        mngr.mark_saved()
 
 
 # ---------------------------------------------------------------------------

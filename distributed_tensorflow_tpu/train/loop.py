@@ -130,6 +130,9 @@ class MnistTrainer:
         )
         self.global_step = dp.replicate(jnp.asarray(state["global_step"], jnp.int32), self.mesh)
 
+    # (restore in __init__ goes through restore_latest + _load_state_dict;
+    # saves go through checkpoint.coordinated_maybe_save below.)
+
     # -- eval ------------------------------------------------------------------
 
     def evaluate(self, dataset: DataSet, max_examples: int | None = None):
@@ -302,22 +305,9 @@ class MnistTrainer:
         ))
 
     def _maybe_save(self, step: int, force: bool = False, at_eval_boundary: bool = True) -> None:
-        """Timed autosave, multi-process safe. Orbax saves are COLLECTIVE when
-        ``jax.process_count() > 1`` — a chief-only save desynchronizes the
-        process group (observed: gloo size-mismatch crash). So: single process
-        keeps Supervisor semantics exactly; multi-process coordinates at eval
-        boundaries only (no per-step collectives) by broadcasting the chief's
-        timed-gate decision, then every process enters the save together."""
-        if jax.process_count() == 1:
-            if self.is_chief:
-                self.ckpt.maybe_save(step, self._state_dict(), force=force)
-            return
-        if not (at_eval_boundary or force):
-            return
-        from jax.experimental import multihost_utils
+        from distributed_tensorflow_tpu.train.checkpoint import coordinated_maybe_save
 
-        want = self.ckpt.should_save(force)
-        should = bool(multihost_utils.broadcast_one_to_all(np.asarray(want)))
-        if should:
-            self.ckpt.save(step, self._state_dict())
-            self.ckpt.mark_saved()
+        coordinated_maybe_save(
+            self.ckpt, step, self._state_dict(), self.is_chief,
+            force=force, at_boundary=at_eval_boundary,
+        )

@@ -153,6 +153,48 @@ class RetrainTrainer:
         self.train_writer = SummaryWriter(os.path.join(cfg.summaries_dir, "train")) if is_chief else None
         self.val_writer = SummaryWriter(os.path.join(cfg.summaries_dir, "validation")) if is_chief else None
 
+        # Supervisor-parity checkpointing (retrain2/retrain2.py:423-429):
+        # timed autosave of the head-training state + auto-restore on start.
+        # Opt-in via --train_dir (retrain1's reference had no Supervisor).
+        self.ckpt = None
+        if cfg.train_dir:
+            from distributed_tensorflow_tpu.train.checkpoint import (
+                CheckpointManager,
+                restore_replicated,
+            )
+
+            self.ckpt = CheckpointManager(
+                cfg.train_dir, save_interval_secs=cfg.save_model_secs
+            )
+            restored = restore_replicated(self.ckpt, self._state_dict(), self.mesh)
+            if restored is not None:
+                step, state = restored
+                self.params = state["params"]
+                self.opt_state = state["opt_state"]
+                self.global_step = dp.replicate(
+                    jnp.asarray(jax.device_get(state["global_step"]), jnp.int32),
+                    self.mesh,
+                )
+                log.info("restored head-training checkpoint at step %d from %s",
+                         step, cfg.train_dir)
+
+    def _state_dict(self):
+        return {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "global_step": self.global_step,
+        }
+
+    def _maybe_save(self, step: int, force: bool = False, at_boundary: bool = True) -> None:
+        if self.ckpt is None:
+            return
+        from distributed_tensorflow_tpu.train.checkpoint import coordinated_maybe_save
+
+        coordinated_maybe_save(
+            self.ckpt, step, self._state_dict(), self.is_chief,
+            force=force, at_boundary=at_boundary,
+        )
+
     def _head_apply(self, variables, x, train=False, rngs=None):
         del rngs
         return self.head.apply(variables, x, train=train)
@@ -244,6 +286,10 @@ class RetrainTrainer:
             )
             step += 1
             is_last = step == cfg.training_steps
+            self._maybe_save(
+                step,
+                at_boundary=(step % cfg.eval_step_interval == 0 or is_last),
+            )
             if step % cfg.eval_step_interval == 0 or is_last:
                 m = jax.device_get(metrics)
                 train_acc, train_ce = float(m["accuracy"]), float(m["loss"])
@@ -262,6 +308,7 @@ class RetrainTrainer:
                     self.val_writer.add_scalars(
                         {"accuracy": val_acc, "cross_entropy": val_ce}, step
                     )
+        self._maybe_save(step, force=True)
         train_time = clock.elapsed
         log.info("Training time: %.2fs", train_time)
 

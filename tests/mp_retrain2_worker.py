@@ -49,6 +49,7 @@ def main() -> None:
         testing_percentage=20,
         validation_percentage=20,
         seed=0,
+        train_dir=os.path.join(work, "ckpt"),  # coordinated Supervisor-parity saves
     )
     trainer = RetrainTrainer(
         cfg,
