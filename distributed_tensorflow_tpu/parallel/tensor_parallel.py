@@ -101,7 +101,7 @@ class TpBlock(nn.Module):
     tp_axis: str = "model"
 
     @nn.compact
-    def __call__(self, x, attend):
+    def __call__(self, x, attend, train: bool = False):
         cfg = self.cfg
         d = cfg.compute_dtype
         tp = lax.axis_size(self.tp_axis)
@@ -125,6 +125,10 @@ class TpBlock(nn.Module):
         attn = nn.Dense(cfg.d_model, use_bias=False, dtype=d, name="proj")(attn)
         attn = _reduce_from_tp(attn, self.tp_axis)
         attn = attn + self.param("proj_bias", nn.initializers.zeros, (cfg.d_model,), jnp.float32).astype(d)
+        # Dropout on the REPLICATED (post-psum) activation: every model shard
+        # draws the same mask from the same key, so tp parity is exact.
+        if cfg.dropout_rate:
+            attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
         x = x + attn
 
         h = _copy_to_tp(nn.LayerNorm(dtype=d, name="ln2")(x), self.tp_axis)
@@ -133,6 +137,8 @@ class TpBlock(nn.Module):
         h = nn.Dense(cfg.d_model, use_bias=False, dtype=d, name="mlp_out")(h)
         h = _reduce_from_tp(h, self.tp_axis)
         h = h + self.param("mlp_out_bias", nn.initializers.zeros, (cfg.d_model,), jnp.float32).astype(d)
+        if cfg.dropout_rate:
+            h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         return x + h
 
 
@@ -144,7 +150,7 @@ class TpTransformerLM(nn.Module):
     tp_axis: str = "model"
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, train: bool = False):
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
@@ -160,7 +166,7 @@ class TpTransformerLM(nn.Module):
         # local head shard.
         attend = _attention_fn(cfg)
         for i in range(cfg.num_layers):
-            x = TpBlock(cfg, tp_axis=self.tp_axis, name=f"block_{i}")(x, attend)
+            x = TpBlock(cfg, tp_axis=self.tp_axis, name=f"block_{i}")(x, attend, train=train)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)
@@ -240,20 +246,24 @@ def build_tp_lm_train_step(
     placement with :func:`shard_params`). ``params_template`` is any
     host/abstract tree with the model's param structure — it only feeds spec
     derivation, no compute."""
-    if cfg.dropout_rate:
-        raise NotImplementedError(
-            "TP path has no dropout yet — set dropout_rate=0 (the non-TP "
-            "TransformerLM honors it)"
-        )
     model = TpTransformerLM(cfg)
     p_specs = tp_param_specs(params_template)
     o_specs = tp_param_specs(jax.eval_shape(tx.init, params_template))
 
     def _shard_step(params, opt_state, global_step, tokens, rng):
-        del rng  # no dropout in the TP path (guarded above)
+        # Dropout key: fold the on-device global step and the DATA-shard index
+        # only — model shards must draw identical masks (the dropout sites are
+        # replicated activations; a per-model-shard mask would break the TP
+        # replication invariant).
+        rng = jax.random.fold_in(
+            jax.random.fold_in(rng, global_step), lax.axis_index("data")
+        )
 
         def compute_loss(p):
-            logits = model.apply({"params": p}, tokens)
+            logits = model.apply(
+                {"params": p}, tokens, train=True,
+                rngs={"dropout": rng} if cfg.dropout_rate else None,
+            )
             return loss_fn(logits, tokens)
 
         loss, grads = jax.value_and_grad(compute_loss)(params)

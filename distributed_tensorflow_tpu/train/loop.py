@@ -104,10 +104,14 @@ class MnistTrainer:
         self.writer = SummaryWriter(cfg.log_dir) if is_chief else None
 
         # Supervisor parity: init-or-restore from logdir (demo2/train.py:166-176).
-        restored = self.ckpt.restore_latest(self._state_dict())
+        from distributed_tensorflow_tpu.train.checkpoint import restore_replicated
+
+        restored = restore_replicated(self.ckpt, self._state_dict(), self.mesh)
         if restored is not None:
             step, state = restored
-            self._load_state_dict(state)
+            self.params = state["params"]
+            self.opt_state = state["opt_state"]
+            self.global_step = state["global_step"]
             log.info("restored checkpoint at step %d from %s", step, cfg.log_dir)
 
     # -- state (de)serialization ------------------------------------------------
@@ -119,18 +123,7 @@ class MnistTrainer:
             "global_step": self.global_step,
         }
 
-    def _load_state_dict(self, state):
-        self.params = dp.replicate(state["params"], self.mesh)
-        self.opt_state = jax.tree_util.tree_map(
-            lambda a, b: dp.replicate(jnp.asarray(b, a.dtype), self.mesh)
-            if hasattr(a, "dtype")
-            else b,
-            self.opt_state,
-            state["opt_state"],
-        )
-        self.global_step = dp.replicate(jnp.asarray(state["global_step"], jnp.int32), self.mesh)
-
-    # (restore in __init__ goes through restore_latest + _load_state_dict;
+    # (restore in __init__ goes through checkpoint.restore_replicated;
     # saves go through checkpoint.coordinated_maybe_save below.)
 
     # -- eval ------------------------------------------------------------------

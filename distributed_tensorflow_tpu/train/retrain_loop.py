@@ -171,10 +171,7 @@ class RetrainTrainer:
                 step, state = restored
                 self.params = state["params"]
                 self.opt_state = state["opt_state"]
-                self.global_step = dp.replicate(
-                    jnp.asarray(jax.device_get(state["global_step"]), jnp.int32),
-                    self.mesh,
-                )
+                self.global_step = state["global_step"]
                 log.info("restored head-training checkpoint at step %d from %s",
                          step, cfg.train_dir)
 

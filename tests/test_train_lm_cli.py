@@ -41,3 +41,26 @@ def test_train_lm_runs_and_learns(tmp_path, mode, mp):
 
     assert np.isfinite(loss)
     assert os.path.exists(out)
+
+
+@pytest.mark.parametrize("mode,mp", [("dp", 1), ("tp", 2)])
+def test_train_lm_resume(tmp_path, mode, mp):
+    """--train_dir: a second invocation restores and continues at the saved
+    step — including a TP run with sharded state leaves."""
+    main = _main()
+    shape = [
+        "--parallelism", mode, "--model_parallel", str(mp),
+        "--eval_step_interval", "5", "--seq_len", "32", "--batch_size", "8",
+        "--num_layers", "2", "--d_model", "32", "--d_ff", "64", "--num_heads", "2",
+        "--train_dir", str(tmp_path / "ckpt"),
+    ]
+    main(["--training_steps", "5"] + shape)
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        main(["--training_steps", "10"] + shape)
+    out = buf.getvalue()
+    assert "restored checkpoint at step 5" in out
+    assert '"step": 10' in out

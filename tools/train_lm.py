@@ -60,6 +60,11 @@ def main(argv=None):
                         choices=("dense", "blockwise", "flash"))
     parser.add_argument("--num_microbatches", type=int, default=2, help="pp only")
     parser.add_argument("--output", default="", help="optional params bundle path")
+    parser.add_argument(
+        "--train_dir", default="",
+        help="checkpoint dir: timed autosave + resume (any parallelism mode)",
+    )
+    parser.add_argument("--save_secs", type=int, default=600)
     parser.add_argument("--seed", type=int, default=0)
     args, _ = parser.parse_known_args(argv)
 
@@ -176,9 +181,36 @@ def main(argv=None):
         place = lambda t: dp.shard_batch({"x": t}, mesh)["x"]
 
     g = g0
+    ckpt = None
+    if args.train_dir:
+        from distributed_tensorflow_tpu.train.checkpoint import (
+            CheckpointManager,
+            coordinated_maybe_save,
+        )
+
+        ckpt = CheckpointManager(args.train_dir, save_interval_secs=args.save_secs)
+        # TP/PP/EP states carry sharded leaves; restore host-side then
+        # re-place with the mode's own placement (params/opt were placed
+        # above, so reuse their shardings leaf-by-leaf).
+        template = {"params": params, "opt_state": opt, "global_step": g}
+        restored = ckpt.restore_latest(template)
+        if restored is not None:
+            latest, state = restored
+            params, opt, g = (
+                jax.tree_util.tree_map(
+                    lambda cur, new: jax.device_put(np.asarray(new), cur.sharding),
+                    template[k],
+                    state[k],
+                )
+                for k in ("params", "opt_state", "global_step")
+            )
+            print(f"restored checkpoint at step {latest} from {args.train_dir}")
+
+    start = int(jax.device_get(g))
     timer = StepTimer()
     key = jax.random.PRNGKey(args.seed)
-    for i in range(args.training_steps):
+    m = {"loss": jnp.nan}  # resume-at-completion runs zero steps
+    for i in range(start, args.training_steps):
         tokens = place(
             jnp.asarray(
                 synthetic_tokens(rng, args.batch_size, args.seq_len, args.vocab_size)
@@ -186,7 +218,17 @@ def main(argv=None):
         )
         params, opt, g, m = step(params, opt, g, tokens, key)
         timer.tick()
-        if (i + 1) % args.eval_step_interval == 0 or i + 1 == args.training_steps:
+        boundary = (i + 1) % args.eval_step_interval == 0 or i + 1 == args.training_steps
+        if ckpt is not None:
+            coordinated_maybe_save(
+                ckpt,
+                i + 1,
+                {"params": params, "opt_state": opt, "global_step": g},
+                is_chief=jax.process_index() == 0,
+                force=(i + 1 == args.training_steps),
+                at_boundary=boundary,
+            )
+        if boundary:
             print(
                 json.dumps(
                     {
