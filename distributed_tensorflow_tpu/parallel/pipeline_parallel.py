@@ -158,8 +158,9 @@ def build_pp_lm_train_step(
     :func:`shard_pp_params`; ``tokens`` (B, T) sharded over 'data' with
     B divisible by ``num_microbatches``.
     """
-    if cfg.dropout_rate:
-        raise NotImplementedError("PP path has no dropout yet — set dropout_rate=0")
+    # Dropout note: masks are drawn per (stage, tick) inside the schedule, so
+    # they are valid-but-different from an unpipelined run's masks (exact
+    # parity with the plain model holds at dropout_rate == 0, as tested).
     stage_leaf = jax.tree_util.tree_leaves(params_template["stages"])[0]
     if stage_leaf.shape[0] != mesh.shape[pp_axis]:
         raise ValueError(
@@ -176,9 +177,14 @@ def build_pp_lm_train_step(
     attend = _attention_fn(cfg)
     M = num_microbatches
 
-    def forward(params, tokens):
+    def forward(params, tokens, rng_drop):
         S = lax.axis_size(pp_axis)
         stage = lax.axis_index(pp_axis)
+        # Decorrelate dropout across stages too: within one tick, different
+        # stages process different microbatches at different depths — the
+        # distinct stage params do NOT decorrelate the RNG stream by
+        # themselves.
+        rng_drop = jax.random.fold_in(rng_drop, stage)
         b, t = tokens.shape
         if b % M:
             raise ValueError(f"local batch {b} not divisible into {M} microbatches")
@@ -195,11 +201,20 @@ def build_pp_lm_train_step(
             lambda v: jnp.squeeze(v, 0), params["stages"]
         )  # (L/S, ...) local layers
 
-        def apply_stage(h):
-            def layer(h, layer_params):
-                return block.apply({"params": layer_params}, h, attend), None
+        n_local_layers = jax.tree_util.tree_leaves(my_stage)[0].shape[0]
 
-            h, _ = lax.scan(layer, h, my_stage)
+        def apply_stage(h, key):
+            def layer(h, xs):
+                layer_params, i = xs
+                out = block.apply(
+                    {"params": layer_params}, h, attend, train=cfg.dropout_rate > 0,
+                    rngs={"dropout": jax.random.fold_in(key, i)}
+                    if cfg.dropout_rate
+                    else None,
+                )
+                return out, None
+
+            h, _ = lax.scan(layer, h, (my_stage, jnp.arange(n_local_layers)))
             return h
 
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
@@ -216,7 +231,9 @@ def build_pp_lm_train_step(
             # changing the schedule.
             ingest = micro[jnp.minimum(ti, M - 1)]
             inp = jnp.where(stage == 0, ingest, state)
-            out = apply_stage(inp)
+            out = apply_stage(inp, jax.random.fold_in(rng_drop, ti))
+
+
             # Last stage's tick ti output is microbatch ti-(S-1).
             mi = ti - (S - 1)
             write = jnp.logical_and(stage == S - 1, mi >= 0)
@@ -244,10 +261,14 @@ def build_pp_lm_train_step(
         return head.apply({"params": params["lm_head"]}, h).astype(jnp.float32)
 
     def _shard_step(params, opt_state, global_step, tokens, rng):
-        del rng
+        # Per-step, per-stage, per-data-shard dropout stream (stage identity
+        # enters via my_stage's distinct params; ticks fold in below).
+        rng = jax.random.fold_in(
+            jax.random.fold_in(rng, global_step), lax.axis_index("data")
+        )
 
         def compute_loss(p):
-            return loss_fn(forward(p, tokens), tokens)
+            return loss_fn(forward(p, tokens, rng), tokens)
 
         loss, grads = jax.value_and_grad(compute_loss)(params)
 
