@@ -54,7 +54,7 @@ def _attention_fn(cfg: TransformerConfig) -> Callable:
     raise ValueError(f"unknown attention implementation: {cfg.attention!r}")
 
 
-def attention_sublayer(cfg, x, attend, train: bool = False, cache=None, dropout: bool = True):
+def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
     """Pre-norm self-attention + residual, shared by :class:`Block` and the
     MoE block (``parallel/expert_parallel.py``). MUST be called from inside
     an ``@nn.compact`` module body — layers are declared with fixed names
@@ -97,7 +97,7 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None, dropout:
         cache = {"k": ks, "v": vs, "len": cache["len"] + s}
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
     attn = nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, name="proj")(attn)
-    if dropout and cfg.dropout_rate:
+    if cfg.dropout_rate:
         attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
     return x + attn, cache
 

@@ -172,10 +172,10 @@ class MoeBlock(nn.Module):
     ep_axis: str = "model"
 
     @nn.compact
-    def __call__(self, x, attend):
+    def __call__(self, x, attend, train: bool = False):
         cfg = self.cfg
         d = cfg.compute_dtype
-        x, _ = attention_sublayer(cfg, x, attend, dropout=False)
+        x, _ = attention_sublayer(cfg, x, attend, train=train)
         b, s, _unused = x.shape
 
         h = nn.LayerNorm(dtype=d, name="ln2")(x)
@@ -186,7 +186,12 @@ class MoeBlock(nn.Module):
             ep_axis=self.ep_axis,
             name="moe",
         )(h.reshape(b * s, cfg.d_model))
-        return x + y.reshape(b, s, cfg.d_model), aux
+        y = y.reshape(b, s, cfg.d_model)
+        # Dropout sites live on REPLICATED activations (the MoE output is
+        # identical on every model shard), so ep parity stays exact.
+        if cfg.dropout_rate:
+            y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
+        return x + y, aux
 
 
 class MoeTransformerLM(nn.Module):
@@ -199,7 +204,7 @@ class MoeTransformerLM(nn.Module):
     ep_axis: str = "model"
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, train: bool = False):
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
@@ -219,7 +224,7 @@ class MoeTransformerLM(nn.Module):
                 capacity_factor=self.capacity_factor,
                 ep_axis=self.ep_axis,
                 name=f"block_{i}",
-            )(x, attend)
+            )(x, attend, train=train)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)
@@ -257,8 +262,6 @@ def build_moe_lm_train_step(
     data-axis mean only: expert grads are shard-owned (each model shard owns
     distinct experts, and the all_to_all AD is exact), replicated-param grads
     come out identical on every model shard."""
-    if cfg.dropout_rate:
-        raise NotImplementedError("MoE path has no dropout yet — set dropout_rate=0")
     if kw.get("ep_axis", "model") != "model":
         # moe_param_specs, the in_specs, and the grad normalization below all
         # assume the 'model' axis.
@@ -268,10 +271,17 @@ def build_moe_lm_train_step(
     o_specs = moe_param_specs(jax.eval_shape(tx.init, params_template))
 
     def _shard_step(params, opt_state, global_step, tokens, rng):
-        del rng
+        # Dropout key: fold the global step and DATA-shard index only — model
+        # shards must draw identical masks on the replicated activations.
+        rng = jax.random.fold_in(
+            jax.random.fold_in(rng, global_step), lax.axis_index("data")
+        )
 
         def compute_loss(p):
-            logits, aux = model.apply({"params": p}, tokens)
+            logits, aux = model.apply(
+                {"params": p}, tokens, train=True,
+                rngs={"dropout": rng} if cfg.dropout_rate else None,
+            )
             return next_token_loss(logits, tokens) + aux_weight * aux, aux
 
         (loss, aux), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)

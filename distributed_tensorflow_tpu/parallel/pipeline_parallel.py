@@ -232,8 +232,6 @@ def build_pp_lm_train_step(
             ingest = micro[jnp.minimum(ti, M - 1)]
             inp = jnp.where(stage == 0, ingest, state)
             out = apply_stage(inp, jax.random.fold_in(rng_drop, ti))
-
-
             # Last stage's tick ti output is microbatch ti-(S-1).
             mi = ti - (S - 1)
             write = jnp.logical_and(stage == S - 1, mi >= 0)
@@ -261,8 +259,9 @@ def build_pp_lm_train_step(
         return head.apply({"params": params["lm_head"]}, h).astype(jnp.float32)
 
     def _shard_step(params, opt_state, global_step, tokens, rng):
-        # Per-step, per-stage, per-data-shard dropout stream (stage identity
-        # enters via my_stage's distinct params; ticks fold in below).
+        # Per-step, per-data-shard base key; forward() folds in the stage
+        # index and the tick so every (stage, tick, layer) draws a distinct
+        # mask.
         rng = jax.random.fold_in(
             jax.random.fold_in(rng, global_step), lax.axis_index("data")
         )
