@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """Sample from a TransformerLM trained with ``tools/train_lm.py``.
 
-Loads the exported params bundle, rebuilds the model config from flags (pass
-the same shape flags used for training), and greedy/temperature-samples with
-the KV-cache decode path — the whole generation is one jitted program.
+Loads the exported params bundle, rebuilds the model config from the bundle's
+embedded config metadata (older bundles: pass the same shape flags used for
+training), and greedy/temperature-samples with the KV-cache decode path — the
+whole generation is one jitted program.
 
 Bundles from ``--parallelism dp|sp`` load directly; ``pp`` bundles are
 unstacked back to the plain layout. (``tp`` bundles use a different param
@@ -44,16 +45,17 @@ def main(argv=None):
     from distributed_tensorflow_tpu.models.transformer import TransformerConfig
     from distributed_tensorflow_tpu.train.checkpoint import load_inference_bundle
 
+    state, meta = load_inference_bundle(args.model)
+    shape_meta = meta.get("config") or {}
     cfg = TransformerConfig(
-        vocab_size=args.vocab_size,
-        d_model=args.d_model,
-        num_heads=args.num_heads,
-        num_layers=args.num_layers,
-        d_ff=args.d_ff,
-        max_seq_len=args.seq_len,
+        vocab_size=int(shape_meta.get("vocab_size", args.vocab_size)),
+        d_model=int(shape_meta.get("d_model", args.d_model)),
+        num_heads=int(shape_meta.get("num_heads", args.num_heads)),
+        num_layers=int(shape_meta.get("num_layers", args.num_layers)),
+        d_ff=int(shape_meta.get("d_ff", args.d_ff)),
+        max_seq_len=int(shape_meta.get("max_seq_len", args.seq_len)),
         compute_dtype=jnp.float32,
     )
-    state, meta = load_inference_bundle(args.model)
     if meta.get("parallelism") in ("tp", "ep"):
         sys.exit(
             f"{meta['parallelism']} bundles use a different param factorization "

@@ -9,6 +9,8 @@ framework's parallelism stack. Selectable strategy:
   --parallelism pp    GPipe pipeline parallelism: layer stages over 'model'
   --parallelism ep    switch-MoE expert parallelism: --num_experts experts
                       sharded over 'model', all_to_all token exchange
+  --parallelism fsdp  ZeRO-3: params + Adam moments sharded 1/N per device,
+                      all_gather on use, psum_scatter for grads
 
 Data: a synthetic copy-structured token stream (deterministic, learnable) —
 this environment has no corpora. One JSON line per eval interval; final
@@ -42,7 +44,7 @@ def synthetic_tokens(rng, batch, seq_len, vocab):
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--parallelism", choices=("dp", "sp", "tp", "pp", "ep"), default="dp"
+        "--parallelism", choices=("dp", "sp", "tp", "pp", "ep", "fsdp"), default="dp"
     )
     parser.add_argument("--num_experts", type=int, default=4, help="ep only")
     parser.add_argument("--model_parallel", type=int, default=1)
@@ -130,6 +132,18 @@ def main(argv=None):
         )
         params = pp.shard_pp_params(stacked, mesh)
         opt = pp.shard_pp_params(jax.device_get(tx.init(stacked)), mesh)
+        place = lambda t: dp.shard_global_batch({"x": t}, mesh)["x"]
+    elif args.parallelism == "fsdp":
+        from distributed_tensorflow_tpu.parallel import fsdp
+
+        host = jax.device_get(
+            TransformerLM(cfg).init(
+                jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+        )
+        step = fsdp.build_fsdp_lm_train_step(cfg, tx, mesh, host, donate=False)
+        params = fsdp.shard_fsdp_params(host, mesh)
+        opt = fsdp.init_fsdp_opt_state(tx, host, mesh)
         place = lambda t: dp.shard_global_batch({"x": t}, mesh)["x"]
     elif args.parallelism == "sp":
         from distributed_tensorflow_tpu.parallel import sequence_parallel as sp
@@ -244,10 +258,31 @@ def main(argv=None):
     if args.output:
         from distributed_tensorflow_tpu.train.checkpoint import export_inference_bundle
 
+        if args.parallelism == "fsdp":
+            # Chunked (n_devices, chunk) padded leaves -> real model shapes,
+            # so the bundle loads into a plain TransformerLM (generate.py).
+            from distributed_tensorflow_tpu.parallel import fsdp
+
+            out_params = fsdp.gather_fsdp_params(params, host)
+        else:
+            out_params = jax.device_get(params)
         export_inference_bundle(
             args.output,
-            jax.device_get(params),
-            metadata={"model": "TransformerLM", "parallelism": args.parallelism},
+            out_params,
+            metadata={
+                "model": "TransformerLM",
+                "parallelism": args.parallelism,
+                # Enough to rebuild TransformerConfig at load time —
+                # generate.py prefers this over its shape flags.
+                "config": {
+                    "vocab_size": cfg.vocab_size,
+                    "d_model": cfg.d_model,
+                    "num_heads": cfg.num_heads,
+                    "num_layers": cfg.num_layers,
+                    "d_ff": cfg.d_ff,
+                    "max_seq_len": cfg.max_seq_len,
+                },
+            },
         )
         print(f"exported {args.output}")
     return float(jax.device_get(m["loss"]))
