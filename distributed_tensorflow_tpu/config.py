@@ -109,6 +109,15 @@ class MnistTrainConfig:
             "instead of epoch shuffling; fastest input path)"
         },
     )
+    accum_steps: int = field(
+        default=1,
+        metadata={
+            "help": "gradient accumulation: one optimizer step from k "
+            "microbatch gradient means (effective batch k*batch_size whose "
+            "activations never coexist in HBM); exclusive with "
+            "steps_per_call>1 and device_data"
+        },
+    )
     export_stablehlo: bool = field(
         default=False,
         metadata={

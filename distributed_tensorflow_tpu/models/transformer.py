@@ -40,6 +40,12 @@ class TransformerConfig:
     dropout_rate: float = 0.0
     attention: str | Callable = "dense"  # 'dense' | 'blockwise' | 'flash' | callable
     compute_dtype: Any = jnp.bfloat16
+    # Rematerialise each block on the backward pass (jax.checkpoint): saves
+    # only block boundaries instead of every intermediate — activation memory
+    # drops from O(L·S·(d_ff+4·d_model)) to O(L·S·d_model) + one block's
+    # intermediates, for one extra forward's FLOPs. The standard long-context
+    # trade on TPU, where HBM (not MXU) is the bottleneck.
+    remat: bool = False
 
 
 def _attention_fn(cfg: TransformerConfig) -> Callable:
@@ -150,8 +156,14 @@ class TransformerLM(nn.Module):
         )(positions)
         attend = _attention_fn(cfg)
         if cache is None:
+            # static_argnums count self at 0: attend (callable) and train
+            # (bool) are compile-time constants. Param tree is unchanged —
+            # remat is a transform, not a module.
+            block_cls = (
+                nn.remat(Block, static_argnums=(2, 3)) if cfg.remat else Block
+            )
             for i in range(cfg.num_layers):
-                x = Block(cfg, name=f"block_{i}")(x, attend, train=train)
+                x = block_cls(cfg, name=f"block_{i}")(x, attend, train)
         else:
             # Cache layout: {'layers': [{'k','v'}, ...], 'len': scalar} — one
             # shared filled-length for all layers (they advance in lockstep).

@@ -217,14 +217,20 @@ class MoeTransformerLM(nn.Module):
         )(positions)
         attend = _attention_fn(cfg)
         aux_total = jnp.zeros((), jnp.float32)
+        # cfg.remat: recompute each block on backward. The all_to_all token
+        # exchange replays identically on every shard (pure function of the
+        # saved block input), so recomputation is SPMD-safe.
+        block_cls = (
+            nn.remat(MoeBlock, static_argnums=(2, 3)) if cfg.remat else MoeBlock
+        )
         for i in range(cfg.num_layers):
-            x, aux = MoeBlock(
+            x, aux = block_cls(
                 cfg,
                 num_experts=self.num_experts,
                 capacity_factor=self.capacity_factor,
                 ep_axis=self.ep_axis,
                 name=f"block_{i}",
-            )(x, attend, train=train)
+            )(x, attend, train)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)

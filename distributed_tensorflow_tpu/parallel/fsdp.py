@@ -20,10 +20,13 @@ act identically on any partition of the flattened params, so per-shard
 updates equal the corresponding shard of the full update; optax scalars such
 as the step count stay replicated). Gradient mean + partition is ONE fused
 collective (``lax.psum_scatter``) instead of the all-reduce every device in
-plain DP pays; persistent per-device memory is ``(params + opt state) / N``
-— the ZeRO-3 recipe that lets models larger than one chip's HBM train
-data-parallel. Gradients w.r.t. the gathered full params exist only
-transiently inside the step (XLA frees them at the reduce-scatter).
+plain DP pays; **persistent** per-device memory is ``(params + opt state)/N``
+— the dominant term for Adam (3× params in f32). Honest scope note: this
+implementation gathers the whole param tree per step, so full params + full
+grads still coexist transiently during fwd/bwd — the peak-memory profile of
+ZeRO-1/2, not a per-layer-gather ZeRO-3; what it buys is the 1/N persistent
+state (and the fused reduce-scatter), not training a model whose weights
+alone exceed one chip's HBM.
 """
 
 from __future__ import annotations

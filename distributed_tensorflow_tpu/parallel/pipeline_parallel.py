@@ -203,16 +203,21 @@ def build_pp_lm_train_step(
 
         n_local_layers = jax.tree_util.tree_leaves(my_stage)[0].shape[0]
 
+        def apply_one(h, layer_params, layer_key):
+            return block.apply(
+                {"params": layer_params}, h, attend, train=cfg.dropout_rate > 0,
+                rngs={"dropout": layer_key} if cfg.dropout_rate else None,
+            )
+
+        if cfg.remat:
+            # Recompute each layer on backward: the scan otherwise saves every
+            # layer's intermediates for all ticks of the schedule.
+            apply_one = jax.checkpoint(apply_one)
+
         def apply_stage(h, key):
             def layer(h, xs):
                 layer_params, i = xs
-                out = block.apply(
-                    {"params": layer_params}, h, attend, train=cfg.dropout_rate > 0,
-                    rngs={"dropout": jax.random.fold_in(key, i)}
-                    if cfg.dropout_rate
-                    else None,
-                )
-                return out, None
+                return apply_one(h, layer_params, jax.random.fold_in(key, i)), None
 
             h, _ = lax.scan(layer, h, (my_stage, jnp.arange(n_local_layers)))
             return h

@@ -165,8 +165,12 @@ class TpTransformerLM(nn.Module):
         # selection (dense/blockwise/flash/callable) applies unchanged to the
         # local head shard.
         attend = _attention_fn(cfg)
+        # cfg.remat: recompute each block on backward (same trade as the
+        # plain model; the in-block f/g collectives replay in lockstep on
+        # every shard, so recomputation is SPMD-safe).
+        block_cls = nn.remat(TpBlock, static_argnums=(2, 3)) if cfg.remat else TpBlock
         for i in range(cfg.num_layers):
-            x = TpBlock(cfg, tp_axis=self.tp_axis, name=f"block_{i}")(x, attend, train=train)
+            x = block_cls(cfg, tp_axis=self.tp_axis, name=f"block_{i}")(x, attend, train)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)

@@ -93,9 +93,20 @@ class MnistTrainer:
         self.global_step = dp.replicate(jnp.zeros((), jnp.int32), self.mesh)
 
         self.train_step = dp.build_train_step(self.model.apply, self.tx, self.mesh)
+        if cfg.accum_steps > 1 and (cfg.steps_per_call > 1 or cfg.device_data):
+            raise ValueError(
+                "accum_steps>1 is exclusive with steps_per_call>1 / device_data "
+                "(accumulation trades dispatches for memory; fusion trades the "
+                "other way)"
+            )
         self.multi_step = (
             dp.build_multi_step(self.model.apply, self.tx, self.mesh)
             if cfg.steps_per_call > 1
+            else None
+        )
+        self.accum_step = (
+            dp.build_accum_train_step(self.model.apply, self.tx, self.mesh, cfg.accum_steps)
+            if cfg.accum_steps > 1
             else None
         )
         self.eval_step = dp.build_eval_step(self.model.apply, self.mesh)
@@ -164,6 +175,15 @@ class MnistTrainer:
                     chunks = self._chunk_sizes(step, num_steps)
                     prefetch = stacked_device_batches(
                         self.datasets.train, self.feed_batch, self.mesh, chunks
+                    )
+                elif self.accum_step is not None:
+                    # k microbatches per optimizer step, stacked on a leading
+                    # dim (the accum step scans over them).
+                    prefetch = stacked_device_batches(
+                        self.datasets.train,
+                        self.feed_batch,
+                        self.mesh,
+                        [self.cfg.accum_steps] * (num_steps - step),
                     )
                 else:
                     prefetch = bounded_device_batches(
@@ -234,6 +254,11 @@ class MnistTrainer:
                     # Stacked (k,) metrics → report the final step's values,
                     # matching what a per-step loop would log at this point.
                     metrics = {name: v[-1] for name, v in metrics.items()}
+                elif self.accum_step is not None:
+                    k = 1  # k microbatches, ONE optimizer step
+                    self.params, self.opt_state, self.global_step, metrics = self.accum_step(
+                        self.params, self.opt_state, self.global_step, batch, self.rng
+                    )
                 else:
                     k = 1
                     self.params, self.opt_state, self.global_step, metrics = self.train_step(

@@ -237,3 +237,95 @@ def test_shard_pool_truncates_to_mesh_multiple(setup):
     pool = dp.shard_pool(images, labels, mesh)
     assert pool["image"].shape == (24, 784)
     assert pool["label"].shape == (24, 10)
+
+
+def test_accum_step_matches_full_batch_step():
+    """One accumulated step over k microbatches == one plain step over the
+    concatenated batch (mean of equal-size microbatch grads == full-batch
+    grad mean). Dropout off — the full-batch step draws one mask where
+    accumulation correctly draws one per microbatch."""
+    import optax
+
+    from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+
+    mesh = make_mesh()
+    model = MnistCNN(dropout_rate=0.0, compute_dtype=jnp.float32)
+    host = jax.device_get(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784), jnp.float32))["params"]
+    )
+    tx = optax.adam(1e-3)
+    rng = np.random.default_rng(0)
+    k, bsz = 4, 16
+    micros = [
+        {
+            "image": rng.random((bsz, 784), np.float32),
+            "label": np.eye(10, dtype=np.float32)[rng.integers(0, 10, bsz)],
+        }
+        for _ in range(k)
+    ]
+    full = {kk: np.concatenate([m[kk] for m in micros]) for kk in micros[0]}
+    key = jax.random.PRNGKey(5)
+
+    p = dp.replicate(host, mesh)
+    o = dp.replicate(jax.device_get(tx.init(host)), mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    plain = dp.build_train_step(model.apply, tx, mesh, donate=False)
+    p1, o1, g1, m1 = plain(p, o, g, dp.shard_batch(full, mesh), key)
+
+    pa = dp.replicate(host, mesh)
+    oa = dp.replicate(jax.device_get(tx.init(host)), mesh)
+    ga = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    accum = dp.build_accum_train_step(model.apply, tx, mesh, k, donate=False)
+    stacked = dp.stack_shard_batches(micros, mesh)
+    pa1, oa1, ga1, ma1 = accum(pa, oa, ga, stacked, key)
+
+    assert int(jax.device_get(ga1)) == 1  # one optimizer step, not k
+    np.testing.assert_allclose(
+        float(jax.device_get(ma1["loss"])), float(jax.device_get(m1["loss"])), rtol=1e-6
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(pa1)),
+        jax.tree_util.tree_leaves(jax.device_get(p1)),
+    ):
+        # mean-of-means vs full-batch mean differ in float summation order;
+        # Adam's rsqrt amplifies near-zero second moments slightly.
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_accum_step_distinct_dropout_per_microbatch():
+    """With dropout on, microbatches of identical data must produce
+    different losses within the scan (distinct masks per microbatch)."""
+    import optax
+
+    from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+
+    mesh = make_mesh()
+    model = MnistCNN(dropout_rate=0.5, compute_dtype=jnp.float32)
+    host = jax.device_get(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784), jnp.float32))["params"]
+    )
+    tx = optax.sgd(0.0)  # no update — we only probe the per-micro losses
+    rng = np.random.default_rng(1)
+    one = {
+        "image": rng.random((16, 784), np.float32),
+        "label": np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)],
+    }
+    micros = [one, one]  # identical data
+
+    # Re-build with metrics per micro: reuse the public step and compare the
+    # MEAN loss against a single-micro run — identical masks would make the
+    # 2-micro mean equal the 1-micro loss exactly.
+    key = jax.random.PRNGKey(2)
+    p = dp.replicate(host, mesh)
+    o = dp.replicate(jax.device_get(tx.init(host)), mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    accum2 = dp.build_accum_train_step(model.apply, tx, mesh, 2, donate=False)
+    _, _, _, m2 = accum2(p, o, g, dp.stack_shard_batches(micros, mesh), key)
+
+    p = dp.replicate(host, mesh)
+    o = dp.replicate(jax.device_get(tx.init(host)), mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    accum1 = dp.build_accum_train_step(model.apply, tx, mesh, 1, donate=False)
+    _, _, _, m1 = accum1(p, o, g, dp.stack_shard_batches(micros[:1], mesh), key)
+
+    assert float(jax.device_get(m2["loss"])) != float(jax.device_get(m1["loss"]))

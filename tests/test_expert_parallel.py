@@ -194,3 +194,31 @@ def test_moe_lm_dropout_parity():
     l2 = run(make_mesh(model_parallel=2))
     np.testing.assert_allclose(l1, l2, rtol=2e-5)
     assert len(set(l1)) > 1  # lr 0: only the dropout masks differ
+
+
+def test_moe_remat_matches_plain():
+    """cfg.remat replays the MoE block (incl. all_to_all) — identical step."""
+    import optax
+
+    mesh = make_mesh(model_parallel=2)
+    cfg_r = TransformerConfig(**{**LM_CFG.__dict__, "remat": True})
+    host = ep.init_moe_lm_params(LM_CFG, num_experts=E, seed=0)
+    tok = jnp.asarray(
+        np.random.default_rng(13).integers(0, LM_CFG.vocab_size, (4, 16)), jnp.int32
+    )
+    outs = []
+    for cfg in (LM_CFG, cfg_r):
+        tx = optax.sgd(0.1)
+        step = ep.build_moe_lm_train_step(cfg, E, tx, mesh, host, donate=False)
+        params = ep.shard_moe_params(host, mesh)
+        opt = ep.shard_moe_params(jax.device_get(tx.init(host)), mesh)
+        g = jax.device_put(
+            jnp.zeros((), jnp.int32), jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        )
+        p1, _, _, m = step(params, opt, g, tok, jax.random.PRNGKey(0))
+        outs.append((float(jax.device_get(m["loss"])), jax.device_get(p1)))
+    assert outs[0][0] == outs[1][0]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(outs[0][1]), jax.tree_util.tree_leaves(outs[1][1])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -161,3 +161,29 @@ def test_pp_dropout_trains():
         last = float(jax.device_get(m["loss"]))
         first = last if first is None else first
     assert last < first * 0.8, (first, last)
+
+
+def test_pp_remat_matches_plain(plain_params):
+    """cfg.remat recomputes each layer in the schedule — identical results."""
+    mesh = make_mesh(model_parallel=2)
+    cfg_r = TransformerConfig(**{**CFG.__dict__, "remat": True})
+    tok = _tokens(8, 16, seed=11)  # local batch 2 per data shard, 2 microbatches
+    outs = []
+    for cfg in (CFG, cfg_r):
+        tx = optax.sgd(0.1)
+        stacked = pp.stack_stage_params(plain_params, num_stages=2)
+        step = pp.build_pp_lm_train_step(
+            cfg, tx, mesh, stacked, num_microbatches=2, donate=False
+        )
+        params = pp.shard_pp_params(stacked, mesh)
+        opt = pp.shard_pp_params(jax.device_get(tx.init(stacked)), mesh)
+        g = jax.device_put(
+            jnp.zeros((), jnp.int32), jax.sharding.NamedSharding(mesh, P())
+        )
+        p1, _, _, m = step(params, opt, g, tok, jax.random.PRNGKey(0))
+        outs.append((float(jax.device_get(m["loss"])), jax.device_get(p1)))
+    assert outs[0][0] == outs[1][0]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(outs[0][1]), jax.tree_util.tree_leaves(outs[1][1])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -137,3 +137,27 @@ def test_tp_dropout_parity_and_stochasticity():
     l2 = run(make_mesh(model_parallel=2))  # 4x2
     np.testing.assert_allclose(l1, l2, rtol=2e-5)  # tp parity holds w/ dropout
     assert len(set(np.round(l1, 6))) > 1  # masks advance with global step
+
+
+def test_tp_remat_matches_plain():
+    """cfg.remat replays the same ops — one tp step must match bitwise."""
+    mesh = make_mesh(model_parallel=2)
+    cfg_r = TransformerConfig(**{**CFG.__dict__, "remat": True})
+    host = tp.init_tp_params(CFG, seed=0)
+    tok = _tokens(4, 16, seed=9)
+    outs = []
+    for cfg in (CFG, cfg_r):
+        tx = optax.sgd(0.1)
+        step = tp.build_tp_lm_train_step(cfg, tx, mesh, host, donate=False)
+        params = tp.shard_params(host, mesh)
+        opt = tp.shard_params(jax.device_get(tx.init(host)), mesh)
+        g = jax.device_put(
+            jnp.zeros((), jnp.int32), jax.sharding.NamedSharding(mesh, P())
+        )
+        p1, _, _, m = step(params, opt, g, tok, jax.random.PRNGKey(0))
+        outs.append((float(jax.device_get(m["loss"])), jax.device_get(p1)))
+    assert outs[0][0] == outs[1][0]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(outs[0][1]), jax.tree_util.tree_leaves(outs[1][1])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

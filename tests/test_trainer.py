@@ -169,3 +169,22 @@ def test_golden_loss_fixed_seed():
             p, o, g, dp.shard_batch({"image": xs, "label": ys}, mesh), jax.random.PRNGKey(0)
         )
     np.testing.assert_allclose(float(jax.device_get(m["loss"])), 11.203433, rtol=1e-3)
+
+
+def test_accum_steps_trains_and_counts_optimizer_steps(tmp_path, tiny_data):
+    """accum_steps=4: k microbatch grad passes per ONE optimizer step —
+    global_step counts updates, training still learns."""
+    cfg = _cfg(tmp_path, training_steps=30, batch_size=8, accum_steps=4)
+    model = MnistCNN(compute_dtype=jnp.float32, dropout_rate=0.1)
+    trainer = MnistTrainer(cfg, mesh=make_mesh(), datasets=tiny_data, model=model)
+    stats = trainer.train()
+    acc, _ = trainer.evaluate(tiny_data.test)
+    assert stats["steps"] == 30  # optimizer steps, not microbatches
+    assert acc > 0.5
+
+
+def test_accum_steps_exclusive_with_fusion(tmp_path, tiny_data):
+    cfg = _cfg(tmp_path, accum_steps=2, steps_per_call=4)
+    with pytest.raises(ValueError, match="accum_steps"):
+        MnistTrainer(cfg, mesh=make_mesh(), datasets=tiny_data,
+                     model=MnistCNN(compute_dtype=jnp.float32))

@@ -124,3 +124,34 @@ def test_sp_training_reduces_loss():
         p, o, g, m = step_fn(p, o, g, tok, rng)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_remat_matches_plain_forward_and_grads():
+    """cfg.remat=True recomputes instead of storing — values and gradients
+    must be identical (same ops, replayed), incl. through dropout rng."""
+    params = _init_params()
+    tokens = _tokens(2, 32, seed=3)
+    cfg_r = TransformerConfig(**{**CFG.__dict__, "remat": True})
+
+    ref = TransformerLM(CFG).apply({"params": params}, tokens)
+    out = TransformerLM(cfg_r).apply({"params": params}, tokens)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def loss(cfg):
+        def f(p):
+            return next_token_loss(
+                TransformerLM(cfg).apply(
+                    {"params": p}, tokens, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(9)},
+                ),
+                tokens,
+            )
+        return f
+
+    cfg_d = TransformerConfig(**{**CFG.__dict__, "dropout_rate": 0.1})
+    cfg_dr = TransformerConfig(**{**CFG.__dict__, "dropout_rate": 0.1, "remat": True})
+    l1, g1 = jax.value_and_grad(loss(cfg_d))(params)
+    l2, g2 = jax.value_and_grad(loss(cfg_dr))(params)
+    assert float(l1) == float(l2)
+    for a, b_ in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))

@@ -60,6 +60,11 @@ def main(argv=None):
     parser.add_argument("--learning_rate", type=float, default=3e-3)
     parser.add_argument("--attention", default="dense",
                         choices=("dense", "blockwise", "flash"))
+    parser.add_argument(
+        "--remat", action="store_true",
+        help="rematerialise transformer blocks on backward (activation "
+             "memory O(L*S*d_model) instead of every intermediate)",
+    )
     parser.add_argument("--num_microbatches", type=int, default=2, help="pp only")
     parser.add_argument("--output", default="", help="optional params bundle path")
     parser.add_argument(
@@ -93,6 +98,7 @@ def main(argv=None):
         d_ff=args.d_ff,
         max_seq_len=args.seq_len,
         attention=args.attention,
+        remat=args.remat,
         compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
     )
     tx = optax.adam(args.learning_rate)
