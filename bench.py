@@ -7,12 +7,13 @@ completion barrier (steps are counted from the on-device global_step, so the
 number cannot overcount).
 
 Default configuration is the framework's fastest honest path: the training
-set resident in HBM (BENCH_MODE=pool) and 100 fused optimizer steps per
+set resident in HBM (BENCH_MODE=pool) and 1000 fused optimizer steps per
 dispatch (BENCH_STEPS_PER_CALL) — one lax.scan'd XLA program per dispatch,
 batches gathered on device. BENCH_MODE=host instead measures the
 prefetched-host-batch path. Measured v5e-1 context: per-dispatch tunnel
 latency ~6 ms makes the unfused path (~170 steps/s) dispatch-bound; fusion +
-resident data reach ~3,100 steps/s (compute-bound at ~0.3 ms/step).
+resident data saturate at ~3,700-3,800 steps/s (compute-bound, ~0.27 ms/step;
+k=100 leaves ~15% dispatch overhead on the table, k=1000 recovers it).
 
 The reference publishes no numbers (BASELINE.md; BASELINE.json "published" is
 empty). ``vs_baseline`` is therefore computed against a documented estimate of
@@ -31,11 +32,11 @@ import time
 REFERENCE_STEPS_PER_SEC_ESTIMATE = 20.0
 BATCH_PER_CHIP = 100
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP_STEPS", 10))
-TIMED_STEPS = int(os.environ.get("BENCH_TIMED_STEPS", 1000))
+TIMED_STEPS = int(os.environ.get("BENCH_TIMED_STEPS", 3000))
 # Fused steps per dispatch (framework --steps_per_call): k optimizer steps run
 # as one lax.scan'd XLA program, so per-dispatch host overhead — the dominant
 # cost for a model this small — is paid once per k steps. 1 = unfused.
-STEPS_PER_CALL = int(os.environ.get("BENCH_STEPS_PER_CALL", 100))
+STEPS_PER_CALL = int(os.environ.get("BENCH_STEPS_PER_CALL", 1000))
 # Input mode: "pool" = device-resident dataset, batches gathered on device
 # inside the fused program (zero host work in the hot loop); "host" = async
 # prefetched host batches (the feed_dict-replacement path).

@@ -118,6 +118,21 @@ def _shard_index(data_axes: tuple[str, str]):
     )
 
 
+def _grad_and_metrics(apply_fn: Callable, loss_fn: Callable, params, batch, rng):
+    """One forward+backward on a local batch shard: the single source of
+    truth for the train-step loss body (plain, fused, pool and accumulation
+    paths all call this)."""
+
+    def compute_loss(p):
+        logits = apply_fn(
+            {"params": p}, batch["image"], train=True, rngs={"dropout": rng}
+        )
+        return loss_fn(logits, batch["label"]), logits
+
+    (loss, logits), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+    return grads, loss, accuracy(logits, batch["label"])
+
+
 def _make_shard_step(
     apply_fn: Callable,
     tx,
@@ -132,18 +147,11 @@ def _make_shard_step(
         # no per-step host-side key derivation/dispatch) and per shard.
         shard_id = _shard_index(data_axes)
         rng = jax.random.fold_in(jax.random.fold_in(rng, global_step), shard_id)
-
-        def compute_loss(p):
-            logits = apply_fn(
-                {"params": p}, batch["image"], train=True, rngs={"dropout": rng}
-            )
-            return loss_fn(logits, batch["label"]), logits
-
-        (loss, logits), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
+        grads, loss, acc = _grad_and_metrics(apply_fn, loss_fn, params, batch, rng)
         # THE collective: gradient mean over ICI (replaces worker->ps gRPC push).
         grads = lax.pmean(grads, data_axes)
         loss = lax.pmean(loss, data_axes)
-        acc = lax.pmean(accuracy(logits, batch["label"]), data_axes)
+        acc = lax.pmean(acc, data_axes)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, global_step + 1, {"loss": loss, "accuracy": acc}
@@ -225,51 +233,40 @@ def build_accum_train_step(
     apply_fn: Callable,
     tx,
     mesh: Mesh,
-    accum_steps: int,
     loss_fn: Callable = softmax_cross_entropy,
     donate: bool = True,
 ):
-    """Gradient accumulation: ONE optimizer step from ``accum_steps``
-    microbatch gradient means — the way to train at an effective batch size
-    whose activations don't fit HBM (each microbatch's activations are freed
-    before the next; only the gradient accumulator persists).
+    """Gradient accumulation: ONE optimizer step from k microbatch gradient
+    means — the way to train at an effective batch size whose activations
+    don't fit HBM (each microbatch's activations are freed before the next;
+    only the gradient accumulator persists).
 
     accum_step(params, opt_state, global_step, batches, rng)
         -> (params, opt_state, global_step, metrics)
 
     ``batches`` arrays carry a leading microbatch dim: ``image
-    (accum_steps, B_micro, ...)`` (shard with :func:`stack_shard_batches`).
-    With equal microbatch sizes, the mean-of-means equals the full-batch
-    gradient mean, so semantics match one :func:`build_train_step` call on
-    the concatenated batch (exact up to float summation order). Unlike
-    :func:`build_multi_step` — k *optimizer* steps per dispatch — this runs
-    k *gradient* passes and one update; ``global_step`` advances by 1.
-    Dropout noise is folded per microbatch (distinct masks, as k separate
-    forward passes would get).
+    (k, B_micro, ...)`` (shard with :func:`stack_shard_batches`); k is taken
+    from that dim, so the same compiled step serves any microbatch count of
+    the same shape. With equal microbatch sizes, the mean-of-means equals
+    the full-batch gradient mean, so semantics match one
+    :func:`build_train_step` call on the concatenated batch (exact up to
+    float summation order). Unlike :func:`build_multi_step` — k *optimizer*
+    steps per dispatch — this runs k *gradient* passes and one update;
+    ``global_step`` advances by 1. Dropout noise is folded per microbatch
+    (distinct masks, as k separate forward passes would get).
     """
     data_axes = ("data", "model")
 
     def _shard_accum(params, opt_state, global_step, batches, rng):
+        k = jax.tree_util.tree_leaves(batches)[0].shape[0]
         shard_id = _shard_index(data_axes)
         base = jax.random.fold_in(jax.random.fold_in(rng, global_step), shard_id)
 
-        def micro_grads(micro_idx, batch):
-            key = jax.random.fold_in(base, micro_idx)
-
-            def compute_loss(p):
-                logits = apply_fn(
-                    {"params": p}, batch["image"], train=True, rngs={"dropout": key}
-                )
-                return loss_fn(logits, batch["label"]), logits
-
-            (loss, logits), grads = jax.value_and_grad(compute_loss, has_aux=True)(
-                params
-            )
-            return grads, loss, accuracy(logits, batch["label"])
-
         def body(carry, inp):
             acc, i = carry
-            grads, loss, acc_metric = micro_grads(i, inp)
+            grads, loss, acc_metric = _grad_and_metrics(
+                apply_fn, loss_fn, params, inp, jax.random.fold_in(base, i)
+            )
             acc = jax.tree_util.tree_map(lambda a, g_: a + g_, acc, grads)
             return (acc, i + 1), (loss, acc_metric)
 
@@ -277,7 +274,7 @@ def build_accum_train_step(
         (grad_sum, _), (losses, accs) = lax.scan(
             body, (zero, jnp.zeros((), jnp.int32)), batches
         )
-        grads = jax.tree_util.tree_map(lambda g_: g_ / accum_steps, grad_sum)
+        grads = jax.tree_util.tree_map(lambda g_: g_ / k, grad_sum)
         grads = lax.pmean(grads, data_axes)
         loss = lax.pmean(jnp.mean(losses), data_axes)
         acc = lax.pmean(jnp.mean(accs), data_axes)

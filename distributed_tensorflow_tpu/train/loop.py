@@ -105,7 +105,7 @@ class MnistTrainer:
             else None
         )
         self.accum_step = (
-            dp.build_accum_train_step(self.model.apply, self.tx, self.mesh, cfg.accum_steps)
+            dp.build_accum_train_step(self.model.apply, self.tx, self.mesh)
             if cfg.accum_steps > 1
             else None
         )

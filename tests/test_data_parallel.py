@@ -275,7 +275,7 @@ def test_accum_step_matches_full_batch_step():
     pa = dp.replicate(host, mesh)
     oa = dp.replicate(jax.device_get(tx.init(host)), mesh)
     ga = dp.replicate(jnp.zeros((), jnp.int32), mesh)
-    accum = dp.build_accum_train_step(model.apply, tx, mesh, k, donate=False)
+    accum = dp.build_accum_train_step(model.apply, tx, mesh, donate=False)
     stacked = dp.stack_shard_batches(micros, mesh)
     pa1, oa1, ga1, ma1 = accum(pa, oa, ga, stacked, key)
 
@@ -319,13 +319,13 @@ def test_accum_step_distinct_dropout_per_microbatch():
     p = dp.replicate(host, mesh)
     o = dp.replicate(jax.device_get(tx.init(host)), mesh)
     g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
-    accum2 = dp.build_accum_train_step(model.apply, tx, mesh, 2, donate=False)
+    accum2 = dp.build_accum_train_step(model.apply, tx, mesh, donate=False)
     _, _, _, m2 = accum2(p, o, g, dp.stack_shard_batches(micros, mesh), key)
 
     p = dp.replicate(host, mesh)
     o = dp.replicate(jax.device_get(tx.init(host)), mesh)
     g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
-    accum1 = dp.build_accum_train_step(model.apply, tx, mesh, 1, donate=False)
+    accum1 = dp.build_accum_train_step(model.apply, tx, mesh, donate=False)
     _, _, _, m1 = accum1(p, o, g, dp.stack_shard_batches(micros[:1], mesh), key)
 
     assert float(jax.device_get(m2["loss"])) != float(jax.device_get(m1["loss"]))
