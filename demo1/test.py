@@ -20,7 +20,6 @@ import jax
 import numpy as np
 
 from distributed_tensorflow_tpu.data.digit import classify_digit_images
-from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
 from distributed_tensorflow_tpu.train.checkpoint import load_inference_bundle
 
 
@@ -43,9 +42,14 @@ def main(argv=None):
 
         return classify_digit_images(predict_one, args.imgs_dir, args.show)
 
-    model = MnistCNN()
+    state, meta = load_inference_bundle(args.model)
+    from distributed_tensorflow_tpu.models import digit_classifier
+
+    model = digit_classifier(meta.get("model", "MnistCNN"))
+    from flax import serialization
+
     template = model.init(jax.random.PRNGKey(0), np.zeros((1, 784), np.float32))["params"]
-    params, _ = load_inference_bundle(args.model, template=template)
+    params = serialization.from_state_dict(template, state)
     predict = jax.jit(lambda p, x: jax.numpy.argmax(model.apply({"params": p}, x), -1))
     return classify_digit_images(lambda x: predict(params, x)[0], args.imgs_dir, args.show)
 

@@ -27,14 +27,14 @@ def main(argv=None):
     # Final model export (reference: saver.save(sess, 'model/train.ckpt'),
     # demo1/train.py:165) — a params bundle the test CLI restores.
     out = os.path.join(cfg.model_dir, "train.msgpack")
-    export_inference_bundle(out, trainer.params, metadata={"model": "MnistCNN"})
+    export_inference_bundle(out, trainer.params, metadata={"model": type(trainer.model).__name__})
     log.info("Total time: %.2fs; model exported to %s", stats["seconds"], out)
     if cfg.export_stablehlo:
         from distributed_tensorflow_tpu.train.checkpoint import export_frozen_classifier
 
         export_frozen_classifier(
             out + ".stablehlo", trainer.model.apply, trainer.params, (784,),
-            metadata={"model": "MnistCNN"},
+            metadata={"model": type(trainer.model).__name__},
         )
         log.info("exported frozen StableHLO program %s.stablehlo", out)
     return stats

@@ -14,19 +14,27 @@ import jax
 import numpy as np
 
 from distributed_tensorflow_tpu.data.digit import classify_digit_images
-from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+from distributed_tensorflow_tpu.models import digit_classifier
 from distributed_tensorflow_tpu.train.checkpoint import (
     CheckpointManager,
     load_inference_bundle,
 )
 
 
-def load_params(model, log_dir: str, bundle: str | None):
-    template = model.init(jax.random.PRNGKey(0), np.zeros((1, 784), np.float32))["params"]
+def load_model_and_params(log_dir: str, bundle: str | None):
+    """Returns (model, params). The bundle's metadata selects the classifier
+    family (cnn/vit); the Orbax-autosave fallback has no metadata and
+    restores as the default MnistCNN."""
+    from flax import serialization
+
     bundle = bundle or os.path.join(log_dir, "model.msgpack")
     if os.path.exists(bundle):
-        params, _ = load_inference_bundle(bundle, template=template)
-        return params
+        state, meta = load_inference_bundle(bundle)
+        model = digit_classifier(meta.get("model", "MnistCNN"))
+        template = model.init(
+            jax.random.PRNGKey(0), np.zeros((1, 784), np.float32)
+        )["params"]
+        return model, serialization.from_state_dict(template, state)
     # Fall back to the latest autosaved training checkpoint (Supervisor-ckpt
     # parity: demo2/test.py:182 restored logs/model.ckpt-<step>). Check the
     # dir first: constructing a CheckpointManager would mkdir it.
@@ -36,9 +44,9 @@ def load_params(model, log_dir: str, bundle: str | None):
     restored = mngr.restore_latest_raw()
     if restored is None:
         raise FileNotFoundError(f"no model bundle or checkpoint found in {log_dir}")
-    from flax import serialization
-
-    return serialization.from_state_dict(template, restored[1]["params"])
+    model = digit_classifier("MnistCNN")
+    template = model.init(jax.random.PRNGKey(0), np.zeros((1, 784), np.float32))["params"]
+    return model, serialization.from_state_dict(template, restored[1]["params"])
 
 
 def main(argv=None):
@@ -60,8 +68,7 @@ def main(argv=None):
 
         return classify_digit_images(predict_one, args.imgs_dir, args.show)
 
-    model = MnistCNN()
-    params = load_params(model, args.log_dir, args.model)
+    model, params = load_model_and_params(args.log_dir, args.model)
     predict = jax.jit(lambda p, x: jax.numpy.argmax(model.apply({"params": p}, x), -1))
     return classify_digit_images(lambda x: predict(params, x)[0], args.imgs_dir, args.show)
 

@@ -42,7 +42,7 @@ def main(argv=None):
     consistency.check_cross_process_consistency(trainer.params)
     if distributed.is_chief():
         out = os.path.join(cfg.log_dir, "model.msgpack")
-        export_inference_bundle(out, trainer.params, metadata={"model": "MnistCNN"})
+        export_inference_bundle(out, trainer.params, metadata={"model": type(trainer.model).__name__})
         log.info("Total time: %.2fs; model exported to %s", stats["seconds"], out)
         if cfg.export_stablehlo:
             from distributed_tensorflow_tpu.train.checkpoint import (
@@ -51,7 +51,7 @@ def main(argv=None):
 
             export_frozen_classifier(
                 out + ".stablehlo", trainer.model.apply, trainer.params, (784,),
-                metadata={"model": "MnistCNN"},
+                metadata={"model": type(trainer.model).__name__},
             )
             log.info("exported frozen StableHLO program %s.stablehlo", out)
     return stats

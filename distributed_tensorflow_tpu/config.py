@@ -73,7 +73,29 @@ class MnistTrainConfig:
     model_dir: str = field(default="./model", metadata={"help": "final checkpoint dir"})
     training_steps: int = 10000
     batch_size: int = 100
+    model: str = field(
+        default="cnn",
+        metadata={"help": "classifier family: cnn (reference convnet) | vit"},
+    )
+    remat: bool = field(
+        default=False,
+        metadata={"help": "rematerialise transformer blocks (vit only)"},
+    )
     learning_rate: float = 1e-4
+    optimizer: str = field(
+        default="adam",
+        metadata={"help": "adam (reference demo parity) | adamw | sgd | momentum"},
+    )
+    lr_schedule: str = field(
+        default="constant",
+        metadata={"help": "constant (parity) | cosine | warmup_cosine | linear"},
+    )
+    warmup_steps: int = field(
+        default=0, metadata={"help": "warmup_cosine ramp length"}
+    )
+    grad_clip_norm: float = field(
+        default=0.0, metadata={"help": "global-norm gradient clip; 0 = off"}
+    )
     dropout_rate: float = field(
         default=0.3, metadata={"help": "1 - keep_prob(0.7) from demo1/train.py:155"}
     )
@@ -176,6 +198,18 @@ class RetrainConfig:
     summaries_dir: str = "./retrain_logs"
     training_steps: int = 10000
     learning_rate: float = 0.01
+    optimizer: str = field(
+        default="sgd",
+        metadata={"help": "sgd (reference retrain parity) | adam | adamw | momentum"},
+    )
+    lr_schedule: str = field(
+        default="constant",
+        metadata={"help": "constant (parity) | cosine | warmup_cosine | linear"},
+    )
+    warmup_steps: int = 0
+    grad_clip_norm: float = field(
+        default=0.0, metadata={"help": "global-norm gradient clip; 0 = off"}
+    )
     testing_percentage: int = 10
     validation_percentage: int = 10
     eval_step_interval: int = 10

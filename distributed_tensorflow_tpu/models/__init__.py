@@ -1,1 +1,16 @@
 from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN  # noqa: F401
+
+
+def digit_classifier(name: str = "MnistCNN", **model_kwargs):
+    """The single name→constructor registry for the MNIST classifier
+    families. Keys are BOTH the config spellings ('cnn'/'vit',
+    ``MnistTrainConfig.model``) and the exported class names
+    ('MnistCNN'/'ViT', bundle ``metadata['model']``), so the trainer and the
+    test CLIs restore through one mapping instead of per-CLI copies."""
+    if name in ("cnn", "MnistCNN"):
+        return MnistCNN(**model_kwargs)
+    if name in ("vit", "ViT"):
+        from distributed_tensorflow_tpu.models.vit import ViT, ViTConfig
+
+        return ViT(ViTConfig(**model_kwargs))
+    raise ValueError(f"unknown classifier {name!r} (choices: cnn/MnistCNN, vit/ViT)")

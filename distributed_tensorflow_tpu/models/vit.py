@@ -1,0 +1,121 @@
+"""Vision Transformer classifier — the framework's second image-model family.
+
+The reference's two image models are the MNIST convnet (``demo1/train.py:
+49-123``) and frozen Inception-v3 (``retrain1/retrain.py:66-74``); this adds
+the attention-based image classifier the same trainer drives (``demo1/
+train.py --model vit``), reusing the transformer ``Block`` stack — so the
+long-context machinery (dense/blockwise/flash attention, ``cfg.remat``) and
+the image pipeline serve one more consumer.
+
+TPU-first choices:
+  * patchify = a single strided Conv (one big MXU matmul over P*P*C), not a
+    gather of P*P crops
+  * mean-pool over patch tokens instead of a class token — one fewer
+    dynamic concat, better for small data, same accuracy class
+  * bf16 compute / f32 params, static shapes; blocks are the SAME module as
+    the LM's, so remat/attention selection apply unchanged
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu.models.transformer import Block, TransformerConfig
+from distributed_tensorflow_tpu.ops import attention as A
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 28
+    patch_size: int = 4
+    channels: int = 1
+    num_classes: int = 10
+    d_model: int = 64
+    num_heads: int = 4
+    num_layers: int = 4
+    d_ff: int = 256
+    dropout_rate: float = 0.0
+    attention: str = "dense"  # 49 patch tokens at MNIST shapes — dense is right
+    remat: bool = False
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def num_patches(self) -> int:
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by "
+                f"patch_size {self.patch_size}"
+            )
+        return (self.image_size // self.patch_size) ** 2
+
+    def block_cfg(self) -> TransformerConfig:
+        return TransformerConfig(
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            num_layers=self.num_layers,
+            d_ff=self.d_ff,
+            max_seq_len=self.num_patches,
+            dropout_rate=self.dropout_rate,
+            attention=self.attention,
+            remat=self.remat,
+            compute_dtype=self.compute_dtype,
+        )
+
+
+class ViT(nn.Module):
+    """``apply(variables, images, train=False) -> logits`` (f32).
+
+    ``images``: (B, H, W, C) or flattened (B, H*W*C) — the MNIST trainer
+    feeds (B, 784), same convention as ``MnistCNN``.
+    """
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        if x.ndim == 2:
+            x = x.reshape((-1, cfg.image_size, cfg.image_size, cfg.channels))
+        x = x.astype(cfg.compute_dtype)
+        # Patchify: one strided conv == linear projection of each P*P*C patch.
+        x = nn.Conv(
+            cfg.d_model,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            dtype=cfg.compute_dtype,
+            name="patch_embed",
+        )(x)
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.d_model)  # (B, tokens, D)
+        pos = nn.Embed(
+            cfg.num_patches, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
+        )(jnp.arange(x.shape[1], dtype=jnp.int32))
+        x = x + pos[None]
+        if cfg.dropout_rate:
+            x = nn.Dropout(cfg.dropout_rate, deterministic=not train)(x)
+
+        bcfg = cfg.block_cfg()
+        # Bidirectional: every patch attends to every patch (the LM's
+        # _attention_fn closures are causal — wrong for images).
+        impl = {
+            "dense": A.dense_attention,
+            "blockwise": A.blockwise_attention,
+            "flash": A.flash_attention,
+        }[cfg.attention]
+        attend = lambda q, k, v: impl(q, k, v, causal=False)  # noqa: E731
+        block_cls = nn.remat(Block, static_argnums=(2, 3)) if cfg.remat else Block
+        for i in range(cfg.num_layers):
+            x = block_cls(bcfg, name=f"block_{i}")(x, attend, train)
+        x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
+        x = x.mean(axis=1)  # mean-pool patch tokens
+        logits = nn.Dense(cfg.num_classes, dtype=cfg.compute_dtype, name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+def create_model(**overrides) -> ViT:
+    return ViT(ViTConfig(**overrides))

@@ -40,6 +40,18 @@ from distributed_tensorflow_tpu.utils.timer import StepTimer, WallClock
 log = get_logger(__name__)
 
 
+def build_model(cfg: MnistTrainConfig):
+    """cfg.model selects the MNIST classifier family: the reference convnet
+    (``demo1/train.py:49-123`` shape) or the ViT (``models/vit.py``) — same
+    (B, 784) apply convention, same trainer/ckpt/export machinery."""
+    from distributed_tensorflow_tpu.models import digit_classifier
+
+    kwargs = {"dropout_rate": cfg.dropout_rate}
+    if cfg.model in ("vit", "ViT"):
+        kwargs["remat"] = cfg.remat
+    return digit_classifier(cfg.model, **kwargs)
+
+
 class MnistTrainer:
     def __init__(
         self,
@@ -53,7 +65,7 @@ class MnistTrainer:
     ):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(num_devices=1)
-        self.model = model or MnistCNN(dropout_rate=cfg.dropout_rate)
+        self.model = model if model is not None else build_model(cfg)
         self.datasets = datasets or read_data_sets(
             cfg.data_dir, one_hot=True, seed=cfg.seed, synthetic=cfg.synthetic_data
         )
@@ -81,7 +93,17 @@ class MnistTrainer:
         if jax.process_count() > 1:
             self.datasets.train.reseed_shuffle(cfg.seed + 1000003 * jax.process_index())
 
-        self.tx = optax.adam(cfg.learning_rate)  # demo1/train.py:132
+        # Default adam/constant == demo1/train.py:132 parity.
+        from distributed_tensorflow_tpu.train.optimizers import make_optimizer
+
+        self.tx = make_optimizer(
+            cfg.optimizer,
+            cfg.learning_rate,
+            total_steps=cfg.training_steps,
+            schedule=cfg.lr_schedule,
+            warmup_steps=cfg.warmup_steps,
+            grad_clip_norm=cfg.grad_clip_norm,
+        )
         self.rng = jax.random.PRNGKey(cfg.seed)
 
         params = self.model.init(
@@ -315,9 +337,15 @@ class MnistTrainer:
                     step,
                 )
                 # variable_summaries parity (demo1/train.py:15-24) at eval
-                # cadence, for the fc2 layer weights.
+                # cadence, for the classifier-head weights (fc2 on the
+                # convnet; the ViT's head otherwise).
                 p = jax.device_get(self.params)
-                variable_summaries(self.writer, "fc2/weights", p["fc2"]["kernel"], step)
+                head_name = "fc2" if "fc2" in p else "head"
+                if head_name in p and "kernel" in p[head_name]:
+                    variable_summaries(
+                        self.writer, f"{head_name}/weights",
+                        p[head_name]["kernel"], step,
+                    )
         self._maybe_save(step, at_eval_boundary=(
             step % cfg.eval_step_interval == 0 or step == num_steps
         ))

@@ -1,0 +1,90 @@
+"""Optimizer + learning-rate-schedule factory.
+
+The reference hardcodes two optimizers: ``AdamOptimizer(1e-4)`` for the MNIST
+demos (``demo1/train.py:132``) and ``GradientDescentOptimizer(FLAGS.
+learning_rate)`` for retrain (``retrain1/retrain.py:285-287``), both at a
+constant rate. Those stay the defaults (parity); this module adds the
+schedule/optimizer selection a framework needs — optax transforms compose
+into the jitted train step like any other pure function, so a schedule costs
+nothing at runtime (the step count rides the optimizer state).
+
+Schedules take ``total_steps`` because cosine needs the horizon; ``constant``
+ignores it.
+"""
+
+from __future__ import annotations
+
+import optax
+
+OPTIMIZERS = ("adam", "adamw", "sgd", "momentum")
+SCHEDULES = ("constant", "cosine", "warmup_cosine", "linear")
+
+
+def make_schedule(
+    name: str,
+    learning_rate: float,
+    total_steps: int,
+    warmup_steps: int = 0,
+    final_scale: float = 0.0,
+) -> optax.Schedule:
+    """Build a learning-rate schedule.
+
+    ``final_scale`` is the end-of-training rate as a fraction of the peak
+    (cosine/linear decay to ``learning_rate * final_scale``).
+    """
+    if name == "constant":
+        return optax.constant_schedule(learning_rate)
+    if name == "cosine":
+        return optax.cosine_decay_schedule(
+            learning_rate, max(total_steps, 1), alpha=final_scale
+        )
+    if name == "warmup_cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=learning_rate,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=max(total_steps, warmup_steps + 1),
+            end_value=learning_rate * final_scale,
+        )
+    if name == "linear":
+        return optax.linear_schedule(
+            learning_rate, learning_rate * final_scale, max(total_steps, 1)
+        )
+    raise ValueError(f"unknown schedule {name!r} (choices: {SCHEDULES})")
+
+
+def make_optimizer(
+    name: str,
+    learning_rate: float,
+    total_steps: int,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    weight_decay: float = 1e-4,
+    momentum: float = 0.9,
+    grad_clip_norm: float = 0.0,
+) -> optax.GradientTransformation:
+    """Build the train-step optimizer.
+
+    ``grad_clip_norm > 0`` prepends global-norm clipping (computed on the
+    already-psum-averaged gradients inside the jitted step).
+    """
+    if schedule == "constant":
+        # A plain float, NOT constant_schedule: a schedule adds a
+        # ScaleByScheduleState(count) leaf to the opt state, which would
+        # break restoring checkpoints written by the pre-factory optimizers.
+        lr = learning_rate
+    else:
+        lr = make_schedule(schedule, learning_rate, total_steps, warmup_steps)
+    if name == "adam":
+        tx = optax.adam(lr)
+    elif name == "adamw":
+        tx = optax.adamw(lr, weight_decay=weight_decay)
+    elif name == "sgd":
+        tx = optax.sgd(lr)
+    elif name == "momentum":
+        tx = optax.sgd(lr, momentum=momentum)
+    else:
+        raise ValueError(f"unknown optimizer {name!r} (choices: {OPTIMIZERS})")
+    if grad_clip_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    return tx

@@ -133,9 +133,19 @@ class RetrainTrainer:
             cfg.flip_left_right, cfg.random_crop, cfg.random_scale, cfg.random_brightness
         )
 
-        # Head model + optimizer (GD at cfg.learning_rate, retrain parity).
+        # Head model + optimizer (default sgd/constant == the reference's GD
+        # at cfg.learning_rate, retrain1/retrain.py:285-287).
+        from distributed_tensorflow_tpu.train.optimizers import make_optimizer
+
         self.head = BottleneckHead(num_classes=class_count)
-        self.tx = optax.sgd(cfg.learning_rate)
+        self.tx = make_optimizer(
+            cfg.optimizer,
+            cfg.learning_rate,
+            total_steps=cfg.training_steps,
+            schedule=cfg.lr_schedule,
+            warmup_steps=cfg.warmup_steps,
+            grad_clip_norm=cfg.grad_clip_norm,
+        )
         params = self.head.init(
             jax.random.PRNGKey(cfg.seed), jnp.zeros((1, iv3.BOTTLENECK_SIZE), jnp.float32)
         )["params"]

@@ -1,0 +1,134 @@
+"""Optimizer/schedule factory (train/optimizers.py).
+
+The reference hardcodes Adam(1e-4) (demo1/train.py:132) and GD
+(retrain1/retrain.py:285-287) at constant rates — those stay the defaults;
+these tests pin the added schedule/optimizer selection and its wiring into
+the trainers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.train.optimizers import (
+    OPTIMIZERS,
+    SCHEDULES,
+    make_optimizer,
+    make_schedule,
+)
+
+
+def test_constant_schedule():
+    s = make_schedule("constant", 0.5, total_steps=100)
+    assert float(s(0)) == 0.5
+    assert float(s(99)) == 0.5
+
+
+def test_cosine_decays_to_final_scale():
+    s = make_schedule("cosine", 1.0, total_steps=100, final_scale=0.1)
+    assert float(s(0)) == pytest.approx(1.0)
+    assert float(s(100)) == pytest.approx(0.1)
+    assert float(s(50)) < float(s(10))
+
+
+def test_warmup_cosine_ramps_then_decays():
+    s = make_schedule("warmup_cosine", 1.0, total_steps=100, warmup_steps=10)
+    assert float(s(0)) == pytest.approx(0.0)
+    assert float(s(10)) == pytest.approx(1.0)
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_linear_schedule():
+    s = make_schedule("linear", 1.0, total_steps=10, final_scale=0.5)
+    assert float(s(5)) == pytest.approx(0.75)
+
+
+@pytest.mark.parametrize("name", OPTIMIZERS)
+@pytest.mark.parametrize("sched", SCHEDULES)
+def test_every_optimizer_schedule_combo_steps(name, sched):
+    tx = make_optimizer(name, 1e-2, total_steps=10, schedule=sched, warmup_steps=2)
+    params = {"w": jnp.ones((4,))}
+    state = tx.init(params)
+    grads = {"w": jnp.full((4,), 0.5)}
+    updates, state = tx.update(grads, state, params)
+    assert np.all(np.isfinite(np.asarray(updates["w"])))
+
+
+def test_grad_clip_bounds_update_norm():
+    # sgd lr=1: update == -clipped grad, so the norm bound is directly visible.
+    tx = make_optimizer("sgd", 1.0, total_steps=1, grad_clip_norm=1.0)
+    params = {"w": jnp.zeros((3,))}
+    state = tx.init(params)
+    huge = {"w": jnp.full((3,), 1e6)}
+    updates, _ = tx.update(huge, state, params)
+    assert np.linalg.norm(np.asarray(updates["w"])) == pytest.approx(1.0, rel=1e-5)
+
+
+def test_unknown_names_raise():
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        make_optimizer("lion", 1e-3, total_steps=1)
+    with pytest.raises(ValueError, match="unknown schedule"):
+        make_schedule("step", 1e-3, total_steps=1)
+
+
+def test_trainer_runs_with_warmup_cosine_adamw(tmp_path):
+    """The config fields flow through MnistTrainer into the jitted step."""
+    from distributed_tensorflow_tpu.config import MnistTrainConfig
+    from distributed_tensorflow_tpu.data.mnist import read_data_sets
+    from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+    from distributed_tensorflow_tpu.train.loop import MnistTrainer
+
+    data = read_data_sets(
+        "/nonexistent", synthetic=True, num_synthetic_train=256, num_synthetic_test=64
+    )
+    cfg = MnistTrainConfig(
+        data_dir=str(tmp_path / "d"),
+        log_dir=str(tmp_path / "logs"),
+        model_dir=str(tmp_path / "m"),
+        training_steps=20,
+        batch_size=16,
+        learning_rate=1e-3,
+        optimizer="adamw",
+        lr_schedule="warmup_cosine",
+        warmup_steps=5,
+        grad_clip_norm=1.0,
+        eval_step_interval=10,
+        synthetic_data=True,
+    )
+    trainer = MnistTrainer(
+        cfg, mesh=make_mesh(), datasets=data,
+        model=MnistCNN(compute_dtype=jnp.float32, dropout_rate=0.1),
+    )
+    stats = trainer.train()
+    assert stats["steps"] == 20
+    assert int(jax.device_get(trainer.global_step)) == 20
+
+
+def test_constant_default_preserves_opt_state_structure():
+    """The factory's constant default must produce the SAME opt-state pytree
+    as the pre-factory optax.adam(float) — otherwise checkpoints written
+    before the factory existed fail to restore (from_state_dict structure
+    mismatch on ScaleByScheduleState)."""
+    import optax
+    from flax import serialization
+
+    params = {"w": jnp.ones((3,))}
+    old = optax.adam(1e-4).init(params)
+    new_tx = make_optimizer("adam", 1e-4, total_steps=100)  # schedule default
+    restored = serialization.from_state_dict(
+        new_tx.init(params), serialization.to_state_dict(old)
+    )
+    jax.tree_util.tree_structure(restored)  # no mismatch raised
+
+
+def test_digit_classifier_registry():
+    from distributed_tensorflow_tpu.models import digit_classifier
+
+    assert type(digit_classifier("cnn")).__name__ == "MnistCNN"
+    assert type(digit_classifier("MnistCNN")).__name__ == "MnistCNN"
+    assert type(digit_classifier("vit")).__name__ == "ViT"
+    assert type(digit_classifier("ViT")).__name__ == "ViT"
+    with pytest.raises(ValueError, match="unknown classifier"):
+        digit_classifier("resnet")

@@ -58,6 +58,12 @@ def main(argv=None):
     parser.add_argument("--num_layers", type=int, default=4)
     parser.add_argument("--d_ff", type=int, default=512)
     parser.add_argument("--learning_rate", type=float, default=3e-3)
+    parser.add_argument("--optimizer", default="adam",
+                        choices=("adam", "adamw", "sgd", "momentum"))
+    parser.add_argument("--lr_schedule", default="constant",
+                        choices=("constant", "cosine", "warmup_cosine", "linear"))
+    parser.add_argument("--warmup_steps", type=int, default=0)
+    parser.add_argument("--grad_clip_norm", type=float, default=0.0)
     parser.add_argument("--attention", default="dense",
                         choices=("dense", "blockwise", "flash"))
     parser.add_argument(
@@ -78,7 +84,6 @@ def main(argv=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    import optax
     from jax.sharding import PartitionSpec as P
 
     from distributed_tensorflow_tpu.models.transformer import (
@@ -101,7 +106,16 @@ def main(argv=None):
         remat=args.remat,
         compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
     )
-    tx = optax.adam(args.learning_rate)
+    from distributed_tensorflow_tpu.train.optimizers import make_optimizer
+
+    tx = make_optimizer(
+        args.optimizer,
+        args.learning_rate,
+        total_steps=args.training_steps,
+        schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
+        grad_clip_norm=args.grad_clip_norm,
+    )
     rng = np.random.default_rng(args.seed)
     rep = lambda t: dp.replicate(t, mesh)
     g0 = rep(jnp.zeros((), jnp.int32))
