@@ -44,9 +44,12 @@ def load_model_and_params(log_dir: str, bundle: str | None):
     restored = mngr.restore_latest_raw()
     if restored is None:
         raise FileNotFoundError(f"no model bundle or checkpoint found in {log_dir}")
-    model = digit_classifier("MnistCNN")
+    # The autosave records no model name — dispatch on the param structure
+    # (ViT has patch_embed; the convnet has conv1/fc2).
+    restored_params = restored[1]["params"]
+    model = digit_classifier("ViT" if "patch_embed" in restored_params else "MnistCNN")
     template = model.init(jax.random.PRNGKey(0), np.zeros((1, 784), np.float32))["params"]
-    return model, serialization.from_state_dict(template, restored[1]["params"])
+    return model, serialization.from_state_dict(template, restored_params)
 
 
 def main(argv=None):

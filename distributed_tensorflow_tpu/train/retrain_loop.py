@@ -33,7 +33,6 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from distributed_tensorflow_tpu.config import RetrainConfig
 from distributed_tensorflow_tpu.data import bottleneck as B

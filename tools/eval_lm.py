@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Perplexity evaluation of an exported TransformerLM bundle on a text file.
+
+The LM analog of the reference's final full-test-set accuracy sweep
+(``retrain1/retrain.py:459-467``): sequential non-overlapping byte windows
+over the file's holdout tail (the same split ``train_lm.py --text_file``
+excluded from training — pass ``--holdout_fraction 0`` to score the whole
+file), mean next-token NLL aggregated exactly over all windows, one jitted
+forward program reused for every batch.
+
+Example:
+  python tools/eval_lm.py --model lm.msgpack --text_file corpus.txt
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="lm.msgpack")
+    parser.add_argument("--text_file", required=True)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument(
+        "--holdout_fraction", type=float, default=0.05,
+        help="score only this tail fraction (match the training flag); "
+             "0 scores the whole file",
+    )
+    # Shape fallbacks for bundles predating embedded config metadata.
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--d_model", type=int, default=128)
+    parser.add_argument("--num_heads", type=int, default=4)
+    parser.add_argument("--num_layers", type=int, default=4)
+    parser.add_argument("--d_ff", type=int, default=512)
+    args, _ = parser.parse_known_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_tpu.data.text import ByteTextDataset, load_byte_tokens
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+    from distributed_tensorflow_tpu.train.checkpoint import load_lm_bundle
+
+    try:
+        cfg, params, meta = load_lm_bundle(
+            args.model,
+            fallback_shapes={
+                "d_model": args.d_model,
+                "num_heads": args.num_heads,
+                "num_layers": args.num_layers,
+                "d_ff": args.d_ff,
+                "max_seq_len": args.seq_len,
+            },
+        )
+    except ValueError as e:
+        sys.exit(str(e))
+    model = TransformerLM(cfg)
+
+    tokens = load_byte_tokens(args.text_file)
+    data = ByteTextDataset(tokens, cfg.max_seq_len, holdout_fraction=args.holdout_fraction)
+    if args.holdout_fraction == 0:
+        data.eval_tokens = tokens  # score the whole file
+
+    @jax.jit
+    def nll_sums(p, tokens):
+        logits = model.apply({"params": p}, tokens)
+        lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        nll = -jnp.take_along_axis(lp, tokens[:, 1:, None], axis=-1)[..., 0]
+        return nll.sum(), nll.size
+
+    total, count = 0.0, 0
+    for batch in data.eval_batches(args.batch_size):
+        s, n = nll_sums(params, jnp.asarray(batch))
+        total += float(jax.device_get(s))
+        count += int(n)
+    if count == 0:
+        sys.exit(
+            "holdout too short for one eval batch — lower --batch_size or "
+            "--holdout_fraction"
+        )
+    mean_nll = total / count
+    print(
+        json.dumps(
+            {
+                "text_file": args.text_file,
+                "tokens_scored": count,
+                "nll_per_byte": round(mean_nll, 4),
+                "perplexity": round(float(np.exp(mean_nll)), 4),
+                "bits_per_byte": round(mean_nll / np.log(2), 4),
+            }
+        )
+    )
+    return mean_nll
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,11 @@ def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="lm.msgpack")
     parser.add_argument("--prompt", default="", help="comma-separated token ids")
+    parser.add_argument(
+        "--text", default="",
+        help="UTF-8 text prompt for byte-level (vocab 256) models; output is "
+             "decoded back to text",
+    )
     parser.add_argument("--max_new_tokens", type=int, default=16)
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--seq_len", type=int, default=128)
@@ -42,42 +47,35 @@ def main(argv=None):
     import numpy as np
 
     from distributed_tensorflow_tpu.models.decoding import build_generate_fn
-    from distributed_tensorflow_tpu.models.transformer import TransformerConfig
-    from distributed_tensorflow_tpu.train.checkpoint import load_inference_bundle
+    from distributed_tensorflow_tpu.train.checkpoint import load_lm_bundle
 
-    state, meta = load_inference_bundle(args.model)
-    shape_meta = meta.get("config") or {}
-    cfg = TransformerConfig(
-        vocab_size=int(shape_meta.get("vocab_size", args.vocab_size)),
-        d_model=int(shape_meta.get("d_model", args.d_model)),
-        num_heads=int(shape_meta.get("num_heads", args.num_heads)),
-        num_layers=int(shape_meta.get("num_layers", args.num_layers)),
-        d_ff=int(shape_meta.get("d_ff", args.d_ff)),
-        max_seq_len=int(shape_meta.get("max_seq_len", args.seq_len)),
-        compute_dtype=jnp.float32,
-    )
-    if meta.get("parallelism") in ("tp", "ep"):
-        sys.exit(
-            f"{meta['parallelism']} bundles use a different param factorization "
-            "(separate q/k/v for tp, expert-stacked MoE MLPs for ep) that the "
-            "plain decoder cannot load — retrain with dp/sp/pp"
+    try:
+        cfg, params, meta = load_lm_bundle(
+            args.model,
+            fallback_shapes={
+                "vocab_size": args.vocab_size,
+                "d_model": args.d_model,
+                "num_heads": args.num_heads,
+                "num_layers": args.num_layers,
+                "d_ff": args.d_ff,
+                "max_seq_len": args.seq_len,
+            },
         )
-    if "stages" in state:  # pp bundle: back to the plain layout
-        from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
-            unstack_stage_params,
-        )
+    except ValueError as e:
+        sys.exit(str(e))
 
-        state = unstack_stage_params(state)
+    if args.text:
+        from distributed_tensorflow_tpu.data.text import encode_text
 
-    from distributed_tensorflow_tpu.models.transformer import TransformerLM
-    from flax import serialization
-
-    template = TransformerLM(cfg).init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
-    )["params"]
-    params = serialization.from_state_dict(template, state)
-
-    if args.prompt:
+        if cfg.vocab_size < 256:
+            sys.exit(
+                f"--text needs a byte-level model (vocab 256); bundle has "
+                f"vocab {cfg.vocab_size}"
+            )
+        prompt = encode_text(args.text).astype(np.int32)[None]
+        if prompt.shape[1] == 0:
+            sys.exit("--text encoded to zero bytes")
+    elif args.prompt:
         prompt = np.asarray([[int(t) for t in args.prompt.split(",")]], np.int32)
         bad = prompt[(prompt < 0) | (prompt >= cfg.vocab_size)]
         if bad.size:
@@ -92,8 +90,14 @@ def main(argv=None):
 
     gen = build_generate_fn(cfg, args.max_new_tokens, temperature=args.temperature)
     out = np.asarray(gen(params, jnp.asarray(prompt), jax.random.PRNGKey(args.seed)))
-    print("prompt :", ",".join(map(str, prompt[0])))
-    print("output :", ",".join(map(str, out[0])))
+    if args.text:
+        from distributed_tensorflow_tpu.data.text import decode_tokens
+
+        print("prompt :", args.text)
+        print("output :", decode_tokens(out[0]))
+    else:
+        print("prompt :", ",".join(map(str, prompt[0])))
+        print("output :", ",".join(map(str, out[0])))
     return out
 
 

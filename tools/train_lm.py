@@ -12,9 +12,11 @@ framework's parallelism stack. Selectable strategy:
   --parallelism fsdp  ZeRO-3: params + Adam moments sharded 1/N per device,
                       all_gather on use, psum_scatter for grads
 
-Data: a synthetic copy-structured token stream (deterministic, learnable) —
-this environment has no corpora. One JSON line per eval interval; final
-params exported as an inference bundle.
+Data: ``--text_file`` trains byte-level (vocab 256) on any file via random
+windows (`data/text.py`; a holdout tail is reserved for tools/eval_lm.py);
+without it, a synthetic copy-structured token stream (deterministic,
+learnable — this environment has no corpora). One JSON line per eval
+interval; final params exported as an inference bundle.
 
 Example (8-device CPU mesh):
   PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \\
@@ -52,6 +54,12 @@ def main(argv=None):
     parser.add_argument("--eval_step_interval", type=int, default=10)
     parser.add_argument("--batch_size", type=int, default=8, help="global batch")
     parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument(
+        "--text_file", default="",
+        help="train byte-level (vocab 256) on this file instead of the "
+             "synthetic stream; a holdout tail is reserved for eval_lm.py",
+    )
+    parser.add_argument("--holdout_fraction", type=float, default=0.05)
     parser.add_argument("--vocab_size", type=int, default=256)
     parser.add_argument("--d_model", type=int, default=128)
     parser.add_argument("--num_heads", type=int, default=4)
@@ -93,6 +101,22 @@ def main(argv=None):
     from distributed_tensorflow_tpu.parallel import data_parallel as dp
     from distributed_tensorflow_tpu.parallel.mesh import make_mesh
     from distributed_tensorflow_tpu.utils.timer import StepTimer
+
+    if args.text_file:
+        from distributed_tensorflow_tpu.data.text import (
+            ByteTextDataset,
+            load_byte_tokens,
+        )
+
+        text_data = ByteTextDataset(
+            load_byte_tokens(args.text_file),
+            args.seq_len,
+            holdout_fraction=args.holdout_fraction,
+            seed=args.seed + 1000003 * jax.process_index(),
+        )
+        args.vocab_size = 256  # bytes
+    else:
+        text_data = None
 
     mesh = make_mesh(model_parallel=args.model_parallel)
     cfg = TransformerConfig(
@@ -245,11 +269,13 @@ def main(argv=None):
     key = jax.random.PRNGKey(args.seed)
     m = {"loss": jnp.nan}  # resume-at-completion runs zero steps
     for i in range(start, args.training_steps):
-        tokens = place(
-            jnp.asarray(
-                synthetic_tokens(rng, args.batch_size, args.seq_len, args.vocab_size)
+        if text_data is not None:
+            host_tokens = text_data.train_batch(args.batch_size)
+        else:
+            host_tokens = synthetic_tokens(
+                rng, args.batch_size, args.seq_len, args.vocab_size
             )
-        )
+        tokens = place(jnp.asarray(host_tokens))
         params, opt, g, m = step(params, opt, g, tokens, key)
         timer.tick()
         boundary = (i + 1) % args.eval_step_interval == 0 or i + 1 == args.training_steps
