@@ -64,23 +64,30 @@ class ByteTextDataset:
         self.seq_len = seq_len
         self.train_tokens = tokens[:split]
         self.eval_tokens = tokens[split:]
-        self._rng = np.random.default_rng(seed)
+        self._seed = seed
 
-    def train_batch(self, batch_size: int) -> np.ndarray:
-        """(batch, seq_len) int32 random windows from the train split."""
+    def train_batch(self, batch_size: int, step: int = 0) -> np.ndarray:
+        """(batch, seq_len) int32 random windows from the train split.
+
+        Windows are a pure function of ``(seed, step)`` — no mutable rng
+        state — so a checkpoint-resumed run at global step N draws exactly
+        the windows an uninterrupted run would have drawn at step N."""
+        rng = np.random.default_rng((self._seed, step))
         hi = len(self.train_tokens) - self.seq_len
-        starts = self._rng.integers(0, hi + 1, batch_size)
+        starts = rng.integers(0, hi + 1, batch_size)
         return np.stack(
             [self.train_tokens[s : s + self.seq_len] for s in starts]
         ).astype(np.int32)
 
     def eval_batches(self, batch_size: int):
         """Non-overlapping sequential (batch, seq_len) windows over the
-        holdout; the trailing remainder (< batch_size windows) is dropped so
-        shapes stay static. Yields nothing if the holdout is too short."""
+        holdout, covering EVERY full window: full batches first, then one
+        final smaller batch for the remainder (callers pay at most one extra
+        jit compile for that shape). Yields nothing only if the holdout has
+        no full window."""
         n_windows = len(self.eval_tokens) // self.seq_len
         windows = self.eval_tokens[: n_windows * self.seq_len].reshape(
             n_windows, self.seq_len
         )
-        for lo in range(0, n_windows - batch_size + 1, batch_size):
+        for lo in range(0, n_windows, batch_size):
             yield windows[lo : lo + batch_size].astype(np.int32)

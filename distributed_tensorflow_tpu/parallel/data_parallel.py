@@ -90,12 +90,15 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
     return _to_global(batch, sharding)
 
 
-def shard_global_batch(batch: Batch, mesh: Mesh) -> Batch:
+def shard_global_batch(batch: Batch, mesh: Mesh, spec: P | None = None) -> Batch:
     """Shard a batch that every process holds IDENTICALLY (deterministic eval
     chunks): each process slices out its own devices' contiguous block, so
-    the global array equals the logical batch exactly once."""
+    the global array equals the logical batch exactly once. ``spec`` defaults
+    to the 2-axis batch sharding; pass e.g. ``P('data', None)`` on a
+    ('data','pipe','model') mesh."""
+    sharding = NamedSharding(mesh, spec if spec is not None else P(("data", "model")))
     if jax.process_count() == 1:
-        return shard_batch(batch, mesh)
+        return _to_global(batch, sharding)
     pid, pcount = jax.process_index(), jax.process_count()
 
     def slice_local(x):
@@ -107,7 +110,7 @@ def shard_global_batch(batch: Batch, mesh: Mesh) -> Batch:
         per = x.shape[0] // pcount
         return x[pid * per : (pid + 1) * per]
 
-    return shard_batch(jax.tree_util.tree_map(slice_local, batch), mesh)
+    return _to_global(jax.tree_util.tree_map(slice_local, batch), sharding)
 
 
 def _shard_index(data_axes: tuple[str, str]):

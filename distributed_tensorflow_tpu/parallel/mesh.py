@@ -41,6 +41,34 @@ def unit_mesh_init(init_fn, *args):
     return jax.device_get(fn(*args))
 
 
+def make_mesh3(
+    num_devices: int | None = None,
+    pipeline_parallel: int = 1,
+    model_parallel: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ('data', 'pipe', 'model') mesh for 3D parallelism
+    (DP × PP × TP, ``parallel/three_d.py``). 'model' is the innermost axis —
+    the tensor-parallel all-reduces are the most frequent collective, so they
+    get the contiguous-neighbor ICI links; pipeline hops are next; the
+    data-parallel gradient mean (once per step) crosses the outermost axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices but only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    n = len(devices)
+    inner = pipeline_parallel * model_parallel
+    if n % inner:
+        raise ValueError(
+            f"{n} devices not divisible by pipeline_parallel*model_parallel={inner}"
+        )
+    arr = np.array(devices).reshape(n // inner, pipeline_parallel, model_parallel)
+    return Mesh(arr, axis_names=("data", "pipe", "model"))
+
+
 def make_mesh(
     num_devices: int | None = None,
     model_parallel: int = 1,

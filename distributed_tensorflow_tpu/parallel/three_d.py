@@ -1,0 +1,235 @@
+"""3D parallelism: DP × PP × TP in one jitted program over a
+('data', 'pipe', 'model') mesh.
+
+The composition of the framework's pipeline schedule
+(``pipeline_parallel.py`` — GPipe microbatch scan, ``ppermute`` stage hops
+over 'pipe') with Megatron tensor parallelism (``tensor_parallel.py`` —
+``TpBlock`` with the f/g conjugate collectives over 'model') under the usual
+data-parallel batch sharding over 'data'. This is the canonical large-model
+recipe: TP inside a stage rides the innermost (fastest) mesh axis, PP hops
+cross the middle axis once per tick, and the once-per-step DP gradient mean
+crosses the outermost axis.
+
+Composition is clean precisely because of two earlier design choices:
+  * the pipeline schedule is block-agnostic — it scans whatever layer apply
+    it is given, so a ``TpBlock`` drops in for ``Block``;
+  * ``TpBlock`` owns its collectives via custom-VJP pairs (identity-fwd/
+    psum-bwd at branch inputs, psum-fwd/identity-bwd at branch outputs), so
+    NO model-axis gradient collective is needed no matter what outer
+    machinery differentiates through it.
+
+Gradient sync by param group (see the pp/tp modules for derivations):
+  stages     — pipe-shard-owned, tp semantics inside      → pmean('data')
+  embeddings — live only via stage 0's masked ingest path → psum('pipe'),
+               identical across 'model' (_copy_to_tp bwd) → pmean('data')
+  ln_f/head  — computed from activations replicated over both 'pipe' and
+               'model' with replicated cotangents          → pmean('data')
+
+Verified by exact parity against the 2-axis TP step on the same global
+params and batch (which is itself exact against the plain model) —
+``tests/test_three_d.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    _attention_fn,
+    next_token_loss,
+)
+from distributed_tensorflow_tpu.parallel.pipeline_parallel import (
+    _collect_from_last,
+    stack_stage_params,
+    unstack_stage_params,
+)
+from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+    TpBlock,
+    _spec_for_path,
+    init_tp_params,
+)
+
+__all__ = [
+    "init_3d_params",
+    "three_d_param_specs",
+    "shard_3d_params",
+    "build_3d_lm_train_step",
+    "stack_stage_params",
+    "unstack_stage_params",
+]
+
+
+def init_3d_params(cfg: TransformerConfig, num_stages: int, seed: int = 0) -> Any:
+    """GLOBAL-shape host tree: TP-factorized blocks (separate q/k/v, global
+    widths) regrouped into pipeline stages — leaves ``(S, L/S, ...)``."""
+    return stack_stage_params(init_tp_params(cfg, seed=seed), num_stages)
+
+
+def three_d_param_specs(tree: Any) -> Any:
+    """'stages' leaves: leading stage dim on 'pipe', the layer dim
+    replicated, then the TP spec on the param dims (column-parallel kernels
+    ``P('pipe', None, None, 'model')``, row-parallel
+    ``P('pipe', None, 'model', None)``); everything else replicated. Valid
+    for optimizer-state trees too (path-suffix match; scalars → P())."""
+
+    def spec(path, leaf):
+        if getattr(leaf, "ndim", None) == 0:
+            return P()
+        names = [p.key for p in path if hasattr(p, "key")]
+        if "stages" not in names:
+            return P()
+        tp = _spec_for_path(path)  # spec for the UNSTACKED param dims
+        return P("pipe", None, *tp)
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def shard_3d_params(tree: Any, mesh: Mesh, specs: Any | None = None) -> Any:
+    from distributed_tensorflow_tpu.parallel.data_parallel import place_by_specs
+
+    return place_by_specs(
+        tree, mesh, specs if specs is not None else three_d_param_specs(tree)
+    )
+
+
+def build_3d_lm_train_step(
+    cfg: TransformerConfig,
+    tx,
+    mesh: Mesh,
+    params_template: Any,
+    num_microbatches: int,
+    loss_fn: Callable = next_token_loss,
+    donate: bool = True,
+):
+    """step(params, opt_state, global_step, tokens, rng)
+        -> (params, opt_state, global_step, metrics)
+
+    ``params`` from :func:`init_3d_params` placed with
+    :func:`shard_3d_params`; ``tokens`` (B, T) sharded over 'data'
+    (replicated over 'pipe' and 'model'), local B divisible by
+    ``num_microbatches``.
+    """
+    stage_leaf = jax.tree_util.tree_leaves(params_template["stages"])[0]
+    if stage_leaf.shape[0] != mesh.shape["pipe"]:
+        raise ValueError(
+            f"params stacked for {stage_leaf.shape[0]} stages but mesh "
+            f"'pipe' axis has {mesh.shape['pipe']} shards"
+        )
+    p_specs = three_d_param_specs(params_template)
+    o_specs = three_d_param_specs(jax.eval_shape(tx.init, params_template))
+    block = TpBlock(cfg, tp_axis="model")
+    embed_mod = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype)
+    pos_mod = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype)
+    ln_f = nn.LayerNorm(dtype=cfg.compute_dtype)
+    head = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype)
+    attend = _attention_fn(cfg)
+    M = num_microbatches
+
+    def forward(params, tokens, rng_drop):
+        S = lax.axis_size("pipe")
+        stage = lax.axis_index("pipe")
+        # Per-stage dropout decorrelation; model shards share the stream
+        # (TpBlock dropout sites are replicated activations).
+        rng_drop = jax.random.fold_in(rng_drop, stage)
+        b, t = tokens.shape
+        if b % M:
+            raise ValueError(f"local batch {b} not divisible into {M} microbatches")
+        bm = b // M
+
+        x = embed_mod.apply({"params": params["tok_embed"]}, tokens)
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        x = x + pos_mod.apply({"params": params["pos_embed"]}, positions)
+        micro = x.reshape(M, bm, t, cfg.d_model)
+
+        my_stage = jax.tree_util.tree_map(
+            lambda v: jnp.squeeze(v, 0), params["stages"]
+        )  # (L/S, ...) local layers, tp-local widths
+        n_local_layers = jax.tree_util.tree_leaves(my_stage)[0].shape[0]
+
+        def apply_one(h, layer_params, layer_key):
+            return block.apply(
+                {"params": layer_params}, h, attend, cfg.dropout_rate > 0,
+                rngs={"dropout": layer_key} if cfg.dropout_rate else None,
+            )
+
+        if cfg.remat:
+            apply_one = jax.checkpoint(apply_one)
+
+        def apply_stage(h, key):
+            def layer(h, xs):
+                layer_params, i = xs
+                return apply_one(h, layer_params, jax.random.fold_in(key, i)), None
+
+            h, _ = lax.scan(layer, h, (my_stage, jnp.arange(n_local_layers)))
+            return h
+
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        n_ticks = M + S - 1
+
+        def tick(carry, ti):
+            state, outputs = carry
+            # Same drain-tick discard invariant as pipeline_parallel.tick.
+            ingest = micro[jnp.minimum(ti, M - 1)]
+            inp = jnp.where(stage == 0, ingest, state)
+            out = apply_stage(inp, jax.random.fold_in(rng_drop, ti))
+            mi = ti - (S - 1)
+            write = jnp.logical_and(stage == S - 1, mi >= 0)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(write, out, outputs[jnp.maximum(mi, 0)]),
+                jnp.maximum(mi, 0),
+                axis=0,
+            )
+            state = lax.ppermute(out, "pipe", fwd_perm)
+            return (state, outputs), None
+
+        init_outputs = jnp.zeros((M, bm, t, cfg.d_model), cfg.compute_dtype)
+        (_, outputs), _ = lax.scan(
+            tick,
+            (jnp.zeros((bm, t, cfg.d_model), cfg.compute_dtype), init_outputs),
+            jnp.arange(n_ticks),
+        )
+        mask = jnp.where(stage == S - 1, 1.0, 0.0).astype(outputs.dtype)
+        outputs = _collect_from_last(outputs, mask, "pipe")
+        h = outputs.reshape(b, t, cfg.d_model)
+        h = ln_f.apply({"params": params["ln_f"]}, h)
+        return head.apply({"params": params["lm_head"]}, h).astype(jnp.float32)
+
+    def _shard_step(params, opt_state, global_step, tokens, rng):
+        rng = jax.random.fold_in(
+            jax.random.fold_in(rng, global_step), lax.axis_index("data")
+        )
+
+        def compute_loss(p):
+            return loss_fn(forward(p, tokens, rng), tokens)
+
+        loss, grads = jax.value_and_grad(compute_loss)(params)
+
+        def sync(path, g):
+            names = [q.key for q in path if hasattr(q, "key")]
+            if "tok_embed" in names or "pos_embed" in names:
+                g = lax.psum(g, "pipe")
+            return lax.pmean(g, "data")
+
+        grads = jax.tree_util.tree_map_with_path(sync, grads)
+        loss = lax.pmean(loss, "data")
+        updates, new_opt = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, new_opt, global_step + 1, {"loss": loss}
+
+    shard_fn = jax.shard_map(
+        _shard_step,
+        mesh=mesh,
+        in_specs=(p_specs, o_specs, P(), P("data", None), P()),
+        out_specs=(p_specs, o_specs, P(), P()),
+        check_vma=False,
+    )
+    donate_args = (0, 1, 2) if donate else ()
+    return jax.jit(shard_fn, donate_argnums=donate_args)

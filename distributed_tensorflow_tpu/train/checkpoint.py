@@ -209,11 +209,11 @@ def load_lm_bundle(path: str, fallback_shapes: dict | None = None):
     )
 
     state, meta = load_inference_bundle(path)
-    if meta.get("parallelism") in ("tp", "ep"):
+    if meta.get("parallelism") in ("tp", "ep", "3d"):
         raise ValueError(
             f"{meta['parallelism']} bundles use a different param "
-            "factorization (separate q/k/v for tp, expert-stacked MoE MLPs "
-            "for ep) that the plain decoder cannot load — retrain with "
+            "factorization (separate q/k/v for tp/3d, expert-stacked MoE "
+            "MLPs for ep) that the plain decoder cannot load — retrain with "
             "dp/fsdp/sp/pp"
         )
     if "stages" in state:

@@ -28,27 +28,42 @@ def test_load_byte_tokens(tmp_path):
 
 
 def test_holdout_split_and_windows():
-    tokens = np.arange(1000) % 251
+    # Sentinel: the holdout tail is all 255, the train split never contains
+    # it — any train window touching the holdout is detectable.
+    tokens = np.concatenate([np.arange(900) % 200, np.full(100, 255)]).astype(np.uint8)
     ds = ByteTextDataset(tokens, seq_len=32, holdout_fraction=0.1, seed=0)
     assert len(ds.train_tokens) == 900
     assert len(ds.eval_tokens) == 100
-    b = ds.train_batch(4)
-    assert b.shape == (4, 32) and b.dtype == np.int32
-    # Training windows never touch the holdout.
-    assert b.max() <= tokens[:900].max()
+    for step in range(20):
+        b = ds.train_batch(4, step=step)
+        assert b.shape == (4, 32) and b.dtype == np.int32
+        assert b.max() < 255, "train window leaked into the holdout"
 
     evs = list(ds.eval_batches(1))
-    assert len(evs) == 3  # 100 // 32 windows, batch 1
+    assert len(evs) == 3  # 100 // 32 full windows
     np.testing.assert_array_equal(evs[0][0], ds.eval_tokens[:32].astype(np.int32))
 
 
-def test_train_batches_deterministic_per_seed():
+def test_eval_batches_cover_every_window():
+    """The final partial batch is yielded, so perplexity is independent of
+    batch_size (the remainder is not silently dropped)."""
+    tokens = np.arange(1000).astype(np.uint8)
+    ds = ByteTextDataset(tokens, seq_len=32, holdout_fraction=0.2, seed=0)
+    n_windows = len(ds.eval_tokens) // 32
+    for bs in (1, 4, 8):
+        got = sum(b.shape[0] for b in ds.eval_batches(bs))
+        assert got == n_windows, (bs, got, n_windows)
+
+
+def test_train_batches_deterministic_per_seed_and_step():
     tokens = np.arange(500) % 256
-    a = ByteTextDataset(tokens, 16, seed=7).train_batch(8)
-    b = ByteTextDataset(tokens, 16, seed=7).train_batch(8)
-    np.testing.assert_array_equal(a, b)
-    c = ByteTextDataset(tokens, 16, seed=8).train_batch(8)
+    a = ByteTextDataset(tokens, 16, seed=7).train_batch(8, step=3)
+    b = ByteTextDataset(tokens, 16, seed=7).train_batch(8, step=3)
+    np.testing.assert_array_equal(a, b)  # pure function of (seed, step)
+    c = ByteTextDataset(tokens, 16, seed=8).train_batch(8, step=3)
     assert not np.array_equal(a, c)
+    d = ByteTextDataset(tokens, 16, seed=7).train_batch(8, step=4)
+    assert not np.array_equal(a, d)
 
 
 def test_too_short_text_raises():

@@ -1,0 +1,146 @@
+"""3D parallelism (DP × PP × TP, parallel/three_d.py) on the 8-device mesh:
+(data=2, pipe=2, model=2). Exactness chain: the 3D step is compared against
+the 2-axis TP step on the same global params/batch, and the TP step is
+exact against the plain model (test_tensor_parallel.py) — so 3D is pinned
+transitively to the unsharded model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.models.transformer import TransformerConfig
+from distributed_tensorflow_tpu.parallel import tensor_parallel as tp
+from distributed_tensorflow_tpu.parallel import three_d as td
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh, make_mesh3
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    num_heads=4,
+    num_layers=2,
+    d_ff=64,
+    max_seq_len=32,
+    compute_dtype=jnp.float32,
+)
+
+
+def _tokens(batch, seq, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, CFG.vocab_size, (batch, seq)), jnp.int32
+    )
+
+
+def test_mesh3_axes():
+    mesh = make_mesh3(8, pipeline_parallel=2, model_parallel=2)
+    assert mesh.axis_names == ("data", "pipe", "model")
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 2, "pipe": 2, "model": 2,
+    }
+    with pytest.raises(ValueError, match="not divisible"):
+        make_mesh3(8, pipeline_parallel=3, model_parallel=2)
+
+
+def test_3d_param_specs():
+    host = td.init_3d_params(CFG, num_stages=2, seed=0)
+    specs = td.three_d_param_specs(host)
+    st = specs["stages"]
+    # column-parallel kernel: (S, L/S, D, D/tp)
+    assert st["q"]["kernel"] == P("pipe", None, None, "model")
+    assert st["q"]["bias"] == P("pipe", None, "model")
+    # row-parallel kernel: (S, L/S, F/tp, D)
+    assert st["mlp_out"]["kernel"] == P("pipe", None, "model", None)
+    assert st["proj_bias"] == P("pipe", None)
+    assert st["ln1"]["scale"] == P("pipe", None)
+    assert specs["tok_embed"]["embedding"] == P()
+    assert specs["lm_head"]["kernel"] == P()
+
+
+def _run(step, params, opt, mesh, tokens_sharded, n_steps, key):
+    g = jax.device_put(jnp.zeros((), jnp.int32), NamedSharding(mesh, P()))
+    losses = []
+    for _ in range(n_steps):
+        params, opt, g, m = step(params, opt, g, tokens_sharded, key)
+        losses.append(float(jax.device_get(m["loss"])))
+    return params, losses
+
+
+def test_3d_matches_tp_exactly():
+    """dp2×pp2×tp2 == dp4×tp2 on the same global params + batch. Step-1 loss
+    is bitwise equal (identical forward math); later steps accumulate only
+    data-axis reduction-order noise (4-way vs 2-way gradient mean).
+
+    SGD, not Adam: the k-projection bias's true gradient is exactly zero
+    (a per-query constant shift of every attention score — softmax is
+    shift-invariant), so its computed gradient is pure float noise; Adam's
+    g/sqrt(v) normalizes that noise to a full-size update of arbitrary
+    sign, which would make the comparison meaningless for that one leaf.
+    SGD keeps noise at noise scale."""
+    host_tp = tp.init_tp_params(CFG, seed=0)
+    stacked = td.stack_stage_params(host_tp, num_stages=2)
+    tx = optax.sgd(0.1)
+    tokens = _tokens(8, 32, seed=5)
+    key = jax.random.PRNGKey(0)
+
+    mesh2 = make_mesh(8, model_parallel=2)  # data=4, model=2
+    step2 = tp.build_tp_lm_train_step(CFG, tx, mesh2, host_tp, donate=False)
+    p2 = tp.shard_params(host_tp, mesh2)
+    o2 = tp.shard_params(jax.device_get(tx.init(host_tp)), mesh2)
+    t2 = jax.device_put(tokens, NamedSharding(mesh2, P("data", None)))
+    p2, losses2 = _run(step2, p2, o2, mesh2, t2, 3, key)
+
+    mesh3 = make_mesh3(8, pipeline_parallel=2, model_parallel=2)
+    step3 = td.build_3d_lm_train_step(CFG, tx, mesh3, stacked, num_microbatches=2, donate=False)
+    p3 = td.shard_3d_params(stacked, mesh3)
+    o3 = td.shard_3d_params(jax.device_get(tx.init(stacked)), mesh3)
+    t3 = jax.device_put(tokens, NamedSharding(mesh3, P("data", None)))
+    p3, losses3 = _run(step3, p3, o3, mesh3, t3, 3, key)
+
+    assert losses3[0] == losses2[0]  # identical forward math, bitwise
+    np.testing.assert_allclose(losses3, losses2, rtol=1e-6, atol=2e-6)
+
+    # Params: unstack the 3D stages back to block_i and compare leaf-wise.
+    plain3 = td.unstack_stage_params(jax.device_get(p3))
+    base = jax.device_get(p2)
+    for k in base:
+        for a, b in zip(
+            jax.tree_util.tree_leaves(plain3[k]), jax.tree_util.tree_leaves(base[k])
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_3d_remat_matches_plain():
+    cfg_r = TransformerConfig(**{**CFG.__dict__, "remat": True})
+    host = tp.init_tp_params(CFG, seed=0)
+    stacked = td.stack_stage_params(host, num_stages=2)
+    mesh3 = make_mesh3(8, pipeline_parallel=2, model_parallel=2)
+    tokens = _tokens(8, 32, seed=7)
+    outs = []
+    for cfg in (CFG, cfg_r):
+        tx = optax.sgd(0.1)
+        step = td.build_3d_lm_train_step(cfg, tx, mesh3, stacked, num_microbatches=2, donate=False)
+        p = td.shard_3d_params(stacked, mesh3)
+        o = td.shard_3d_params(jax.device_get(tx.init(stacked)), mesh3)
+        t = jax.device_put(tokens, NamedSharding(mesh3, P("data", None)))
+        p, losses = _run(step, p, o, mesh3, t, 1, jax.random.PRNGKey(0))
+        outs.append((losses[0], jax.device_get(p)))
+    assert outs[0][0] == outs[1][0]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(outs[0][1]), jax.tree_util.tree_leaves(outs[1][1])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_3d_trains_and_loss_decreases():
+    host = tp.init_tp_params(CFG, seed=1)
+    stacked = td.stack_stage_params(host, num_stages=2)
+    mesh3 = make_mesh3(8, pipeline_parallel=2, model_parallel=2)
+    tx = optax.adam(1e-2)
+    step = td.build_3d_lm_train_step(CFG, tx, mesh3, stacked, num_microbatches=2, donate=False)
+    p = td.shard_3d_params(stacked, mesh3)
+    o = td.shard_3d_params(jax.device_get(tx.init(stacked)), mesh3)
+    t = jax.device_put(_tokens(8, 32, seed=9), NamedSharding(mesh3, P("data", None)))
+    _, losses = _run(step, p, o, mesh3, t, 12, jax.random.PRNGKey(0))
+    assert losses[-1] < losses[0] * 0.7, losses

@@ -67,10 +67,11 @@ def main(argv=None):
     if args.text:
         from distributed_tensorflow_tpu.data.text import encode_text
 
-        if cfg.vocab_size < 256:
+        if cfg.vocab_size != 256:
             sys.exit(
-                f"--text needs a byte-level model (vocab 256); bundle has "
-                f"vocab {cfg.vocab_size}"
+                f"--text needs a byte-level model (vocab exactly 256); bundle "
+                f"has vocab {cfg.vocab_size} — ids outside 0-255 would alias "
+                "to wrong bytes"
             )
         prompt = encode_text(args.text).astype(np.int32)[None]
         if prompt.shape[1] == 0:

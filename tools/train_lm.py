@@ -11,6 +11,9 @@ framework's parallelism stack. Selectable strategy:
                       sharded over 'model', all_to_all token exchange
   --parallelism fsdp  ZeRO-3: params + Adam moments sharded 1/N per device,
                       all_gather on use, psum_scatter for grads
+  --parallelism 3d    DP x PP x TP on a ('data','pipe','model') mesh:
+                      --pipeline_parallel stages of --model_parallel-way
+                      Megatron blocks under the GPipe schedule
 
 Data: ``--text_file`` trains byte-level (vocab 256) on any file via random
 windows (`data/text.py`; a holdout tail is reserved for tools/eval_lm.py);
@@ -46,10 +49,12 @@ def synthetic_tokens(rng, batch, seq_len, vocab):
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--parallelism", choices=("dp", "sp", "tp", "pp", "ep", "fsdp"), default="dp"
+        "--parallelism", choices=("dp", "sp", "tp", "pp", "ep", "fsdp", "3d"),
+        default="dp",
     )
     parser.add_argument("--num_experts", type=int, default=4, help="ep only")
     parser.add_argument("--model_parallel", type=int, default=1)
+    parser.add_argument("--pipeline_parallel", type=int, default=1, help="3d only")
     parser.add_argument("--training_steps", type=int, default=100)
     parser.add_argument("--eval_step_interval", type=int, default=10)
     parser.add_argument("--batch_size", type=int, default=8, help="global batch")
@@ -118,7 +123,15 @@ def main(argv=None):
     else:
         text_data = None
 
-    mesh = make_mesh(model_parallel=args.model_parallel)
+    if args.parallelism == "3d":
+        from distributed_tensorflow_tpu.parallel.mesh import make_mesh3
+
+        mesh = make_mesh3(
+            pipeline_parallel=args.pipeline_parallel,
+            model_parallel=args.model_parallel,
+        )
+    else:
+        mesh = make_mesh(model_parallel=args.model_parallel)
     cfg = TransformerConfig(
         vocab_size=args.vocab_size,
         d_model=args.d_model,
@@ -177,6 +190,16 @@ def main(argv=None):
         params = pp.shard_pp_params(stacked, mesh)
         opt = pp.shard_pp_params(jax.device_get(tx.init(stacked)), mesh)
         place = lambda t: dp.shard_global_batch({"x": t}, mesh)["x"]
+    elif args.parallelism == "3d":
+        from distributed_tensorflow_tpu.parallel import three_d as td
+
+        host = td.init_3d_params(cfg, num_stages=args.pipeline_parallel, seed=args.seed)
+        step = td.build_3d_lm_train_step(
+            cfg, tx, mesh, host, num_microbatches=args.num_microbatches, donate=False
+        )
+        params = td.shard_3d_params(host, mesh)
+        opt = td.shard_3d_params(jax.device_get(tx.init(host)), mesh)
+        place = lambda t: dp.shard_global_batch({"x": t}, mesh, spec=P("data", None))["x"]
     elif args.parallelism == "fsdp":
         from distributed_tensorflow_tpu.parallel import fsdp
 
@@ -270,7 +293,9 @@ def main(argv=None):
     m = {"loss": jnp.nan}  # resume-at-completion runs zero steps
     for i in range(start, args.training_steps):
         if text_data is not None:
-            host_tokens = text_data.train_batch(args.batch_size)
+            # Step-keyed windows: resume at step i draws exactly what an
+            # uninterrupted run would have drawn at step i.
+            host_tokens = text_data.train_batch(args.batch_size, step=i)
         else:
             host_tokens = synthetic_tokens(
                 rng, args.batch_size, args.seq_len, args.vocab_size
