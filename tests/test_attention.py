@@ -68,10 +68,17 @@ def test_causal_cross_length_matches_dense(sq, skv):
         np.testing.assert_allclose(fl, ref, rtol=2e-5, atol=2e-5)
 
 
-def test_flash_rejects_non_divisible():
+def test_flash_autofits_non_divisible_blocks():
+    """Requested blocks that don't divide the sequence shrink to the largest
+    divisor satisfying Mosaic's sublane rule (multiple of 8), falling back to
+    the full sequence for odd lengths."""
+    assert A._fit_block(512, 768) == 384
+    assert A._fit_block(32, 48) == 24
+    assert A._fit_block(512, 509) == 509  # prime -> whole sequence
     q, k, v = _qkv(s=48)
-    with pytest.raises(ValueError, match="divisible"):
-        A.flash_attention(q, k, v, block_q=32, block_kv=32)
+    ref = A.dense_attention(q, k, v, causal=True)
+    out = A.flash_attention(q, k, v, causal=True, block_q=32, block_kv=32)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
 
 def test_blockwise_gradients_match_dense():
@@ -155,3 +162,80 @@ def test_ring_gradients_match_dense_on_mesh():
     gr = jax.jit(jax.grad(lambda *a: jnp.sum(ring_f(*a) ** 2), (0, 1, 2)))(q, k, v)
     for a, b in zip(gd, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_backward_multiblock_noncausal():
+    """Pallas backward over several q AND kv tiles, full attention."""
+    q, k, v = _qkv(s=64)
+    g = jnp.asarray(np.random.default_rng(3).standard_normal(q.shape), q.dtype)
+
+    def loss_via(fn, **kw):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=False, **kw) * g)
+
+    gd = jax.grad(loss_via(A.dense_attention), argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(
+        loss_via(A.flash_attention, block_q=16, block_kv=16), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(gd, gf):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_backward_cross_length_causal():
+    """Backward with Sq < Skv (end-aligned causal, the decode-style shape)."""
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((2, 2, 16, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 2, 48, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 2, 48, 8)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal((2, 2, 16, 8)), jnp.float32)
+
+    gd = jax.grad(
+        lambda q, k, v: jnp.sum(A.dense_attention(q, k, v, causal=True) * g),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gf = jax.grad(
+        lambda q, k, v: jnp.sum(
+            A.flash_attention(q, k, v, causal=True, block_q=8, block_kv=16) * g
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gd, gf):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_forward_lse_matches_dense_logsumexp():
+    """The saved statistic the backward depends on: lse == logsumexp of the
+    (scaled, masked) dense logits."""
+    q, k, v = _qkv(s=32)
+    _, lse = A._flash_forward(
+        q, k, v, causal=True, block_q=16, block_kv=16, scale=None,
+        interpret=True, with_lse=True,
+    )
+    s = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * s
+    mask = jnp.tril(jnp.ones((32, 32), bool))
+    logits = jnp.where(mask, logits, A.NEG_INF)
+    ref = jax.scipy.special.logsumexp(logits, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_backward_mixed_masked_tile():
+    """sq > skv end-aligned causal: a q tile holding BOTH fully-masked rows
+    (lse == NEG_INF) and live rows must produce dense-matching gradients —
+    the masked rows' p must be zeroed explicitly (exp(logits - lse) would be
+    exp(0) = 1 since NEG_INF is finite)."""
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((1, 1, 16, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 1, 8, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 1, 8, 8)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal((1, 1, 16, 8)), jnp.float32)
+    gd = jax.grad(
+        lambda *a: jnp.sum(A.dense_attention(*a, causal=True) * g), argnums=(0, 1, 2)
+    )(q, k, v)
+    gf = jax.grad(
+        lambda *a: jnp.sum(
+            A.flash_attention(*a, causal=True, block_q=16, block_kv=8) * g
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gd, gf):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
