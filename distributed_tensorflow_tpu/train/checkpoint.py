@@ -61,14 +61,26 @@ class CheckpointManager:
 
     def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
         """Save if ``save_interval_secs`` elapsed since the last save (the
-        Supervisor's timed-autosave behavior) or if forced (final save)."""
+        Supervisor's timed-autosave behavior) or if forced (final save —
+        which also WAITS, so the artifact exists before the process exits)."""
         if not self.should_save(force):
             return False
-        self.save(step, state)
+        self.save(step, state, wait=force)
         self.mark_saved()
         return True
 
-    def save(self, step: int, state: Any) -> None:
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        """Async by default: the device→host fetch is synchronous (cheap),
+        the disk write overlaps training — the Supervisor also autosaved
+        from a background thread (demo2/train.py:166-172). The previous
+        in-flight save is drained first; ``wait=True`` (final saves) blocks
+        until the artifact is durable."""
+        # Drain the previous in-flight save BEFORE the duplicate-step guard:
+        # an async save of step N not yet visible in latest_step() would
+        # otherwise slip past the guard and raise StepAlreadyExistsError on
+        # the forced re-save of N (and in multi-process runs, one process
+        # erroring out of the collective save deadlocks the others).
+        self._mngr.wait_until_finished()
         if self._mngr.latest_step() == step:
             # Re-saving an existing step raises StepAlreadyExistsError in
             # Orbax — hit when a finished job restarts (restore to step N,
@@ -76,15 +88,17 @@ class CheckpointManager:
             # gate fires on the very last step before the final save.
             return
         self._mngr.save(step, args=ocp.args.StandardSave(jax.device_get(state)))
-        self._mngr.wait_until_finished()
+        if wait:
+            self._mngr.wait_until_finished()
 
     def latest_step(self) -> int | None:
+        self._mngr.wait_until_finished()  # include any in-flight async save
         return self._mngr.latest_step()
 
     def restore_latest_raw(self):
         """Restore the newest ckpt without a structure template (numpy leaves);
         returns (step, state) or None."""
-        step = self._mngr.latest_step()
+        step = self.latest_step()
         if step is None:
             return None
         return step, self._mngr.restore(step)
@@ -92,7 +106,7 @@ class CheckpointManager:
     def restore_latest(self, template: Any):
         """Returns (step, state) restored from the newest ckpt, or None —
         mirrors Supervisor init-or-restore (``demo2/train.py:176``)."""
-        step = self._mngr.latest_step()
+        step = self.latest_step()
         if step is None:
             return None
         abstract = jax.tree_util.tree_map(np.asarray, jax.device_get(template))
@@ -149,7 +163,12 @@ def coordinated_maybe_save(
 
     want = mngr.should_save(force)
     if bool(multihost_utils.broadcast_one_to_all(np.asarray(want))):
-        mngr.save(step, state)
+        # wait=True: multi-process saves stay SYNCHRONOUS. The async
+        # finalize barrier runs on a background thread over the same
+        # coordination service the main threads use for the broadcast above;
+        # interleaving the two deadlocks the group (observed in the
+        # 2-process demo2 test). Async autosave applies single-process.
+        mngr.save(step, state, wait=True)
         mngr.mark_saved()
 
 

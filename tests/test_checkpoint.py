@@ -79,3 +79,27 @@ def test_inference_bundle_roundtrip(tmp_path, params):
         restored,
     )
     assert ckpt.load_labels(labels_path) == ["cat", "dog"]
+
+
+def test_async_autosave_durable_after_next_access(tmp_path):
+    """Timed autosaves are async (the loop is not stalled by the disk
+    write); any subsequent latest_step/restore/save drains the in-flight
+    write first, and forced (final) saves are synchronous."""
+    from distributed_tensorflow_tpu.train.checkpoint import CheckpointManager
+
+    mngr = CheckpointManager(str(tmp_path / "ck"), save_interval_secs=0.0)
+    state = {"w": np.arange(8.0, dtype=np.float32)}
+    assert mngr.maybe_save(1, state)  # async
+    # Forced re-save of the SAME step while its async write may still be in
+    # flight: the drain-before-guard ordering must make this a no-op, not a
+    # StepAlreadyExistsError (the job-restart / final-save-at-timed-step
+    # race).
+    mngr.save(1, state, wait=True)
+    # Reading through the manager must see the completed step-1 save.
+    assert mngr.latest_step() == 1
+    state2 = {"w": np.arange(8.0, dtype=np.float32) * 2}
+    assert mngr.maybe_save(2, state2, force=True)  # waits
+    step, restored = mngr.restore_latest(state)
+    assert step == 2
+    np.testing.assert_array_equal(restored["w"], state2["w"])
+    mngr.close()
