@@ -291,7 +291,15 @@ def main(argv=None):
     timer = StepTimer()
     key = jax.random.PRNGKey(args.seed)
     m = {"loss": jnp.nan}  # resume-at-completion runs zero steps
-    for i in range(start, args.training_steps):
+    # TensorBoard events alongside the checkpoints (chief only) — the same
+    # observability the MNIST trainer has (utils/summary.py).
+    writer = None
+    if args.train_dir and jax.process_index() == 0:
+        from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+
+        writer = SummaryWriter(args.train_dir)
+    try:
+      for i in range(start, args.training_steps):
         if text_data is not None:
             # Step-keyed windows: resume at step i draws exactly what an
             # uninterrupted run would have drawn at step i.
@@ -314,11 +322,18 @@ def main(argv=None):
                 at_boundary=boundary,
             )
         if boundary:
+            step_now = int(jax.device_get(g))
+            loss_now = float(jax.device_get(m["loss"]))
+            if writer is not None:
+                writer.add_scalars(
+                    {"loss": loss_now, "steps_per_sec": timer.steps_per_sec},
+                    step_now,
+                )
             print(
                 json.dumps(
                     {
-                        "step": int(jax.device_get(g)),
-                        "loss": round(float(jax.device_get(m["loss"])), 4),
+                        "step": step_now,
+                        "loss": round(loss_now, 4),
                         "steps_per_sec": round(timer.steps_per_sec, 2),
                         "parallelism": args.parallelism,
                     }
@@ -326,6 +341,9 @@ def main(argv=None):
                 flush=True,
             )
 
+    finally:
+        if writer is not None:
+            writer.close()  # durable even if a step raised
     if args.output:
         from distributed_tensorflow_tpu.train.checkpoint import export_inference_bundle
 
