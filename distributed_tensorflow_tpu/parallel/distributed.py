@@ -36,6 +36,12 @@ def initialize_from_cluster(cluster: ClusterConfig) -> bool:
         )
         return False
     if cluster.num_processes > 1:
+        if jax.distributed.is_initialized():
+            # Already in a group (repeated main() calls, e.g. a resume in
+            # the same process) — initialize would raise. NOTE: must not
+            # probe via jax.process_count(): that itself initialises the
+            # XLA backend, which forbids a later initialize().
+            return True
         jax.distributed.initialize(
             coordinator_address=cluster.coordinator_address,
             num_processes=cluster.num_processes,

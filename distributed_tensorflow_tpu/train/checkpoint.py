@@ -31,6 +31,27 @@ from distributed_tensorflow_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
+def _cross_process_sharded(x) -> bool:
+    """A leaf that no single process can fetch: sharded (not replicated)
+    across a multi-process mesh. ``device_get`` on such arrays raises;
+    Orbax saves/restores them natively (each process handles its shards)."""
+    return (
+        isinstance(x, jax.Array)
+        and not x.is_fully_addressable
+        and not x.is_fully_replicated
+    )
+
+
+def _savable(state: Any) -> Any:
+    """numpy for fetchable leaves (replicated / single-process — the fast,
+    simple case); cross-process-sharded jax.Arrays pass through for Orbax's
+    distributed array handler."""
+    return jax.tree_util.tree_map(
+        lambda x: x if _cross_process_sharded(x) else np.asarray(jax.device_get(x)),
+        state,
+    )
+
+
 class CheckpointManager:
     """Orbax-backed manager with Supervisor-parity semantics: timed autosave
     (default 600 s, ``demo2/train.py:172``), keep-N, restore-latest-on-start."""
@@ -87,7 +108,7 @@ class CheckpointManager:
             # zero-iteration loop, final forced save of N) or when the timed
             # gate fires on the very last step before the final save.
             return
-        self._mngr.save(step, args=ocp.args.StandardSave(jax.device_get(state)))
+        self._mngr.save(step, args=ocp.args.StandardSave(_savable(state)))
         if wait:
             self._mngr.wait_until_finished()
 
@@ -105,11 +126,18 @@ class CheckpointManager:
 
     def restore_latest(self, template: Any):
         """Returns (step, state) restored from the newest ckpt, or None —
-        mirrors Supervisor init-or-restore (``demo2/train.py:176``)."""
+        mirrors Supervisor init-or-restore (``demo2/train.py:176``).
+        Cross-process-sharded template leaves restore as sharded jax.Arrays
+        (each process reads its own shards); everything else as numpy."""
         step = self.latest_step()
         if step is None:
             return None
-        abstract = jax.tree_util.tree_map(np.asarray, jax.device_get(template))
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if _cross_process_sharded(x)
+            else np.asarray(jax.device_get(x)),
+            template,
+        )
         state = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
         return step, state
 

@@ -89,3 +89,11 @@ def test_retrain2_two_process_end_to_end(tmp_path):
 
     _run_workers("mp_retrain2_worker.py", str(tmp_path), "RETRAIN2_WORKER_{i}_OK")
     assert os.path.exists(str(tmp_path / "graph.msgpack"))
+
+
+def test_train_lm_two_process_end_to_end(tmp_path):
+    """tools/train_lm.py across 2 OS processes: cluster flags -> global mesh
+    -> dp LM training on identical global batches sliced per process ->
+    bitwise cross-process consistency -> chief-only bundle export."""
+    _run_workers("mp_lm_worker.py", str(tmp_path), "LM_WORKER_{i}_OK")
+    assert (tmp_path / "lm.msgpack").exists()

@@ -92,6 +92,11 @@ def main(argv=None):
     )
     parser.add_argument("--save_secs", type=int, default=600)
     parser.add_argument("--seed", type=int, default=0)
+    # Reference-style cluster flags (demo2 parity): worker_hosts[0] is the
+    # jax.distributed coordinator, task_index the process id.
+    parser.add_argument("--worker_hosts", default="localhost:12355")
+    parser.add_argument("--task_index", type=int, default=0)
+    parser.add_argument("--job_name", default="worker")
     args, _ = parser.parse_known_args(argv)
 
     import jax
@@ -99,13 +104,23 @@ def main(argv=None):
     import numpy as np
     from jax.sharding import PartitionSpec as P
 
+    from distributed_tensorflow_tpu.config import ClusterConfig
     from distributed_tensorflow_tpu.models.transformer import (
         TransformerConfig,
         TransformerLM,
     )
-    from distributed_tensorflow_tpu.parallel import data_parallel as dp
+    from distributed_tensorflow_tpu.parallel import data_parallel as dp, distributed
     from distributed_tensorflow_tpu.parallel.mesh import make_mesh
     from distributed_tensorflow_tpu.utils.timer import StepTimer
+
+    cluster = ClusterConfig(
+        worker_hosts=args.worker_hosts,
+        task_index=args.task_index,
+        job_name=args.job_name,
+    )
+    if not distributed.initialize_from_cluster(cluster):
+        return None  # ps role: nothing to do on TPU
+    chief = distributed.is_chief()
 
     if args.text_file:
         from distributed_tensorflow_tpu.data.text import (
@@ -113,11 +128,15 @@ def main(argv=None):
             load_byte_tokens,
         )
 
+        # Same seed on every process: batches are a pure function of
+        # (seed, step), every process generates the IDENTICAL global batch
+        # and shard_global_batch slices out its own block — so a run's data
+        # schedule is independent of the process count.
         text_data = ByteTextDataset(
             load_byte_tokens(args.text_file),
             args.seq_len,
             holdout_fraction=args.holdout_fraction,
-            seed=args.seed + 1000003 * jax.process_index(),
+            seed=args.seed,
         )
         args.vocab_size = 256  # bytes
     else:
@@ -259,7 +278,7 @@ def main(argv=None):
         )
         params = rep(plain)
         opt = rep(jax.device_get(tx.init(plain)))
-        place = lambda t: dp.shard_batch({"x": t}, mesh)["x"]
+        place = lambda t: dp.shard_global_batch({"x": t}, mesh)["x"]
 
     g = g0
     ckpt = None
@@ -277,15 +296,21 @@ def main(argv=None):
         restored = ckpt.restore_latest(template)
         if restored is not None:
             latest, state = restored
+
+            def replace(cur, new):
+                # Cross-process-sharded leaves come back already placed
+                # (Orbax restored each process's shards); host leaves are
+                # re-placed with the mode's own sharding.
+                if isinstance(new, jax.Array):
+                    return new
+                return jax.device_put(np.asarray(new), cur.sharding)
+
             params, opt, g = (
-                jax.tree_util.tree_map(
-                    lambda cur, new: jax.device_put(np.asarray(new), cur.sharding),
-                    template[k],
-                    state[k],
-                )
+                jax.tree_util.tree_map(replace, template[k], state[k])
                 for k in ("params", "opt_state", "global_step")
             )
-            print(f"restored checkpoint at step {latest} from {args.train_dir}")
+            if chief:
+                print(f"restored checkpoint at step {latest} from {args.train_dir}")
 
     start = int(jax.device_get(g))
     timer = StepTimer()
@@ -294,7 +319,7 @@ def main(argv=None):
     # TensorBoard events alongside the checkpoints (chief only) — the same
     # observability the MNIST trainer has (utils/summary.py).
     writer = None
-    if args.train_dir and jax.process_index() == 0:
+    if args.train_dir and chief:
         from distributed_tensorflow_tpu.utils.summary import SummaryWriter
 
         writer = SummaryWriter(args.train_dir)
@@ -317,7 +342,7 @@ def main(argv=None):
                 ckpt,
                 i + 1,
                 {"params": params, "opt_state": opt, "global_step": g},
-                is_chief=jax.process_index() == 0,
+                is_chief=chief,
                 force=(i + 1 == args.training_steps),
                 at_boundary=boundary,
             )
@@ -329,21 +354,39 @@ def main(argv=None):
                     {"loss": loss_now, "steps_per_sec": timer.steps_per_sec},
                     step_now,
                 )
-            print(
-                json.dumps(
-                    {
-                        "step": step_now,
-                        "loss": round(loss_now, 4),
-                        "steps_per_sec": round(timer.steps_per_sec, 2),
-                        "parallelism": args.parallelism,
-                    }
-                ),
-                flush=True,
-            )
+            if chief:
+                print(
+                    json.dumps(
+                        {
+                            "step": step_now,
+                            "loss": round(loss_now, 4),
+                            "steps_per_sec": round(timer.steps_per_sec, 2),
+                            "parallelism": args.parallelism,
+                        }
+                    ),
+                    flush=True,
+                )
 
     finally:
         if writer is not None:
             writer.close()  # durable even if a step raised
+    if jax.process_count() > 1 and args.parallelism in ("dp", "sp"):
+        # Replicated-param modes: verify bitwise identity across processes
+        # (the sharded modes' params are not fully addressable per process).
+        from distributed_tensorflow_tpu.parallel import consistency
+
+        consistency.check_cross_process_consistency(params)
+    if args.output and not chief:
+        args.output = ""  # chief-only export
+    if args.output and jax.process_count() > 1 and args.parallelism not in ("dp", "sp"):
+        print(
+            f"skipping --output: {args.parallelism} params are sharded across "
+            "processes (not addressable from the chief alone) — use "
+            "--train_dir checkpoints, which save/restore cross-process "
+            "shards natively",
+            flush=True,
+        )
+        args.output = ""
     if args.output:
         from distributed_tensorflow_tpu.train.checkpoint import export_inference_bundle
 
