@@ -92,25 +92,25 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
 
 def shard_global_batch(batch: Batch, mesh: Mesh, spec: P | None = None) -> Batch:
     """Shard a batch that every process holds IDENTICALLY (deterministic eval
-    chunks): each process slices out its own devices' contiguous block, so
-    the global array equals the logical batch exactly once. ``spec`` defaults
-    to the 2-axis batch sharding; pass e.g. ``P('data', None)`` on a
-    ('data','pipe','model') mesh."""
+    chunks / step-keyed LM batches): the global array equals the logical
+    batch exactly once, each process contributing its own devices' slices.
+    ``spec`` defaults to the 2-axis batch sharding; pass e.g.
+    ``P('data', 'pipe')`` on a ('data','pipe','model') mesh.
+
+    Multi-process placement goes through ``make_array_from_callback`` (each
+    process serves exactly its addressable shards' index slices of the full
+    global value) — correct for ANY spec, including ones where the leading
+    batch axis does NOT span the processes (a batch-dim slice-by-process
+    would hand devices garbage there)."""
     sharding = NamedSharding(mesh, spec if spec is not None else P(("data", "model")))
     if jax.process_count() == 1:
         return _to_global(batch, sharding)
-    pid, pcount = jax.process_index(), jax.process_count()
 
-    def slice_local(x):
+    def place(x):
         x = np.asarray(x)
-        if x.shape[0] % pcount:
-            raise ValueError(
-                f"global batch dim {x.shape[0]} not divisible by {pcount} processes"
-            )
-        per = x.shape[0] // pcount
-        return x[pid * per : (pid + 1) * per]
+        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
 
-    return _to_global(jax.tree_util.tree_map(slice_local, batch), sharding)
+    return jax.tree_util.tree_map(place, batch)
 
 
 def _shard_index(data_axes: tuple[str, str]):

@@ -51,13 +51,23 @@ def build_lm_train_step(
     data_axis: str = "data",
     seq_axis: str = "model",
     donate: bool = True,
+    model: Any | None = None,
+    param_specs: Any = None,
+    opt_specs: Any = None,
 ) -> Callable:
     """Jitted SPMD step over sharded tokens (B on data axis, S on seq axis).
 
     step(params, opt_state, global_step, tokens, rng)
         -> (params, opt_state, global_step, metrics)
-    """
-    model = make_sp_model(cfg, seq_axis)
+
+    ``model``/``param_specs``/``opt_specs`` generalize the builder beyond
+    the replicated-param TransformerLM: ``three_d.build_sp_tp_lm_train_step``
+    passes a ring-attention ``TpTransformerLM`` with tensor-parallel specs —
+    the cross-shard target/loss/gradient machinery here is identical for
+    both (the 'model'/tp axis needs no grad collective of its own)."""
+    model = model if model is not None else make_sp_model(cfg, seq_axis)
+    param_specs = param_specs if param_specs is not None else P()
+    opt_specs = opt_specs if opt_specs is not None else P()
     both_axes = (data_axis, seq_axis)
 
     def _shard_step(params, opt_state, global_step, tokens, rng):
@@ -77,7 +87,7 @@ def build_lm_train_step(
         def compute_loss(p):
             logits = model.apply(
                 {"params": p}, tokens, positions=positions, train=True,
-                rngs={"dropout": rng},
+                rngs={"dropout": rng} if cfg.dropout_rate else None,
             )
             incoming = lax.ppermute(tokens[:, :1], seq_axis, perm)
             targets = jnp.concatenate([tokens[:, 1:], incoming], axis=1)
@@ -109,8 +119,8 @@ def build_lm_train_step(
     shard_fn = jax.shard_map(
         _shard_step,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(data_axis, seq_axis), P()),
-        out_specs=(P(), P(), P(), P()),
+        in_specs=(param_specs, opt_specs, P(), P(data_axis, seq_axis), P()),
+        out_specs=(param_specs, opt_specs, P(), P()),
         check_vma=False,
     )
     donate_args = (0, 1, 2) if donate else ()

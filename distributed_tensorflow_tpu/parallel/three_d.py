@@ -233,3 +233,57 @@ def build_3d_lm_train_step(
     )
     donate_args = (0, 1, 2) if donate else ()
     return jax.jit(shard_fn, donate_argnums=donate_args)
+
+
+# ---------------------------------------------------------------------------
+# Second composite: DP × SP(ring) × TP — the long-context-at-scale shape.
+# Sequence sharded over 'pipe' with ring attention streaming K/V shards
+# around that axis; attention heads / FFN sharded over 'model' (TpBlocks);
+# batch data-parallel. Composes because TpBlock's attention implementation
+# is injectable — the ring closure runs on the LOCAL head shard, and the two
+# axes' collectives (ppermute over 'pipe', f/g psums over 'model') never
+# touch the same dimension.
+# ---------------------------------------------------------------------------
+
+
+def build_sp_tp_lm_train_step(
+    cfg: TransformerConfig,
+    tx,
+    mesh: Mesh,
+    params_template: Any,
+    donate: bool = True,
+):
+    """step(params, opt_state, global_step, tokens, rng)
+        -> (params, opt_state, global_step, {'loss'})
+
+    ``tokens`` (B, S) with B sharded over 'data' and S over 'pipe'
+    (``P('data','pipe')``); params/opt per ``tensor_parallel.tp_param_specs``
+    (replicated over 'data' and 'pipe', sharded over 'model').
+
+    A thin composition: ``sequence_parallel.build_lm_train_step`` provides
+    ALL the cross-shard target/loss/gradient machinery (ppermute next-token
+    shift, global masked mean over ('data','pipe'), pmean recipe); this
+    wrapper only swaps in a ring-attention ``TpTransformerLM`` and the
+    tensor-parallel param specs (the 'model' axis needs no grad collective
+    of its own — tp's custom-VJP pairs).
+    """
+    from distributed_tensorflow_tpu.parallel import sequence_parallel as sp
+    from distributed_tensorflow_tpu.parallel.ring_attention import ring_attention
+    from distributed_tensorflow_tpu.parallel.tensor_parallel import (
+        TpTransformerLM,
+        tp_param_specs,
+    )
+
+    ring = lambda q, k, v: ring_attention(q, k, v, axis_name="pipe", causal=True)
+    model = TpTransformerLM(TransformerConfig(**{**cfg.__dict__, "attention": ring}))
+    return sp.build_lm_train_step(
+        cfg,
+        tx,
+        mesh,
+        data_axis="data",
+        seq_axis="pipe",
+        donate=donate,
+        model=model,
+        param_specs=tp_param_specs(params_template),
+        opt_specs=tp_param_specs(jax.eval_shape(tx.init, params_template)),
+    )

@@ -256,12 +256,12 @@ def load_lm_bundle(path: str, fallback_shapes: dict | None = None):
     )
 
     state, meta = load_inference_bundle(path)
-    if meta.get("parallelism") in ("tp", "ep", "3d"):
+    if meta.get("parallelism") in ("tp", "ep", "3d", "sp_tp"):
         raise ValueError(
             f"{meta['parallelism']} bundles use a different param "
-            "factorization (separate q/k/v for tp/3d, expert-stacked MoE "
-            "MLPs for ep) that the plain decoder cannot load — retrain with "
-            "dp/fsdp/sp/pp"
+            "factorization (separate q/k/v for tp/3d/sp_tp, expert-stacked "
+            "MoE MLPs for ep) that the plain decoder cannot load — retrain "
+            "with dp/fsdp/sp/pp"
         )
     if "stages" in state:
         from distributed_tensorflow_tpu.parallel.pipeline_parallel import (

@@ -76,6 +76,27 @@ def main() -> None:
     assert np.isfinite(loss1), loss1
     loss2 = train_lm.main(fsdp_args + ["--training_steps", "8"])
     assert np.isfinite(loss2), loss2
+
+    # Phase 3: sp_tp with the 'pipe' (sequence) axis spanning BOTH processes
+    # and a size-1 data axis — the placement regression case (a batch-dim
+    # slice-by-process would feed devices garbage and NaN from step 1).
+    loss3 = train_lm.main(
+        [
+            "--worker_hosts", f"localhost:{port},localhost:0",
+            "--task_index", str(task_index),
+            "--parallelism", "sp_tp",
+            "--pipeline_parallel", "4",
+            "--model_parallel", "1",
+            "--training_steps", "4",
+            "--eval_step_interval", "4",
+            "--seq_len", "32",
+            "--batch_size", "4",
+            "--d_model", "32",
+            "--num_layers", "2",
+            "--d_ff", "64",
+        ]
+    )
+    assert np.isfinite(loss3), loss3
     print(f"LM_WORKER_{task_index}_OK")
 
 

@@ -144,3 +144,46 @@ def test_3d_trains_and_loss_decreases():
     t = jax.device_put(_tokens(8, 32, seed=9), NamedSharding(mesh3, P("data", None)))
     _, losses = _run(step, p, o, mesh3, t, 12, jax.random.PRNGKey(0))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_sp_tp_matches_tp_exactly():
+    """DP×SP(ring over 'pipe')×TP == plain dp4×tp2 on the same global
+    params/tokens: the ring streams K/V shards around 'pipe' while heads are
+    sharded over 'model' — same attention math, different decomposition."""
+    host = tp.init_tp_params(CFG, seed=0)
+    tx = optax.sgd(0.1)
+    tokens = _tokens(8, 32, seed=5)
+    key = jax.random.PRNGKey(0)
+
+    mesh2 = make_mesh(8, model_parallel=2)
+    step2 = tp.build_tp_lm_train_step(CFG, tx, mesh2, host, donate=False)
+    p2 = tp.shard_params(host, mesh2)
+    o2 = tp.shard_params(jax.device_get(tx.init(host)), mesh2)
+    t2 = jax.device_put(tokens, NamedSharding(mesh2, P("data", None)))
+    p2, losses2 = _run(step2, p2, o2, mesh2, t2, 3, key)
+
+    mesh3 = make_mesh3(8, pipeline_parallel=2, model_parallel=2)
+    step3 = td.build_sp_tp_lm_train_step(CFG, tx, mesh3, host, donate=False)
+    p3 = tp.shard_params(host, mesh3)
+    o3 = tp.shard_params(jax.device_get(tx.init(host)), mesh3)
+    t3 = jax.device_put(tokens, NamedSharding(mesh3, P("data", "pipe")))
+    p3, losses3 = _run(step3, p3, o3, mesh3, t3, 3, key)
+
+    np.testing.assert_allclose(losses3, losses2, rtol=1e-6, atol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(p3)),
+        jax.tree_util.tree_leaves(jax.device_get(p2)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_sp_tp_trains_and_loss_decreases():
+    host = tp.init_tp_params(CFG, seed=1)
+    mesh3 = make_mesh3(8, pipeline_parallel=2, model_parallel=2)
+    tx = optax.adam(1e-2)
+    step = td.build_sp_tp_lm_train_step(CFG, tx, mesh3, host, donate=False)
+    p = tp.shard_params(host, mesh3)
+    o = tp.shard_params(jax.device_get(tx.init(host)), mesh3)
+    t = jax.device_put(_tokens(8, 32, seed=9), NamedSharding(mesh3, P("data", "pipe")))
+    _, losses = _run(step, p, o, mesh3, t, 12, jax.random.PRNGKey(0))
+    assert losses[-1] < losses[0] * 0.7, losses

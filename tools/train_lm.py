@@ -14,6 +14,10 @@ framework's parallelism stack. Selectable strategy:
   --parallelism 3d    DP x PP x TP on a ('data','pipe','model') mesh:
                       --pipeline_parallel stages of --model_parallel-way
                       Megatron blocks under the GPipe schedule
+  --parallelism sp_tp DP x SP x TP: sequence sharded over 'pipe' with ring
+                      attention, heads/FFN over 'model' — the
+                      long-context-at-scale shape (--pipeline_parallel
+                      sets the sequence-shard count)
 
 Data: ``--text_file`` trains byte-level (vocab 256) on any file via random
 windows (`data/text.py`; a holdout tail is reserved for tools/eval_lm.py);
@@ -49,7 +53,8 @@ def synthetic_tokens(rng, batch, seq_len, vocab):
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--parallelism", choices=("dp", "sp", "tp", "pp", "ep", "fsdp", "3d"),
+        "--parallelism",
+        choices=("dp", "sp", "tp", "pp", "ep", "fsdp", "3d", "sp_tp"),
         default="dp",
     )
     parser.add_argument("--num_experts", type=int, default=4, help="ep only")
@@ -142,7 +147,7 @@ def main(argv=None):
     else:
         text_data = None
 
-    if args.parallelism == "3d":
+    if args.parallelism in ("3d", "sp_tp"):
         from distributed_tensorflow_tpu.parallel.mesh import make_mesh3
 
         mesh = make_mesh3(
@@ -219,6 +224,17 @@ def main(argv=None):
         params = td.shard_3d_params(host, mesh)
         opt = td.shard_3d_params(jax.device_get(tx.init(host)), mesh)
         place = lambda t: dp.shard_global_batch({"x": t}, mesh, spec=P("data", None))["x"]
+    elif args.parallelism == "sp_tp":
+        from distributed_tensorflow_tpu.parallel import tensor_parallel as tpmod
+        from distributed_tensorflow_tpu.parallel import three_d as td
+
+        host = tpmod.init_tp_params(cfg, seed=args.seed)
+        step = td.build_sp_tp_lm_train_step(cfg, tx, mesh, host, donate=False)
+        params = tpmod.shard_params(host, mesh)
+        opt = tpmod.shard_params(jax.device_get(tx.init(host)), mesh)
+        place = lambda t: dp.shard_global_batch({"x": t}, mesh, spec=P("data", "pipe"))[
+            "x"
+        ]
     elif args.parallelism == "fsdp":
         from distributed_tensorflow_tpu.parallel import fsdp
 
