@@ -102,15 +102,12 @@ def shard_global_batch(batch: Batch, mesh: Mesh, spec: P | None = None) -> Batch
     global value) — correct for ANY spec, including ones where the leading
     batch axis does NOT span the processes (a batch-dim slice-by-process
     would hand devices garbage there)."""
-    sharding = NamedSharding(mesh, spec if spec is not None else P(("data", "model")))
+    resolved = spec if spec is not None else P(("data", "model"))
     if jax.process_count() == 1:
-        return _to_global(batch, sharding)
-
-    def place(x):
-        x = np.asarray(x)
-        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
-
-    return jax.tree_util.tree_map(place, batch)
+        return _to_global(batch, NamedSharding(mesh, resolved))
+    return place_by_specs(
+        batch, mesh, jax.tree_util.tree_map(lambda _: resolved, batch)
+    )
 
 
 def _shard_index(data_axes: tuple[str, str]):

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""End-to-end image-folder classification — train a ViT (or the MNIST-shape
+convnet) DIRECTLY on a directory-of-folders dataset.
+
+The reference's retrain workflow only trains a linear head on frozen
+Inception bottlenecks (``retrain1/retrain.py:262-297``); this CLI is the
+end-to-end counterpart the framework adds: same deterministic SHA-1 dataset
+split (``data/images.py``, parity with ``retrain1/retrain.py:109-121``),
+same distortion pipeline (``data/augment.py``), but the whole model trains —
+attention image classifier on the data-parallel mesh, one jitted step.
+
+Example:
+  python tools/train_image_classifier.py --image_dir ./data \\
+    --training_steps 200 --image_size 64 --output classifier.msgpack
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--image_dir", required=True)
+    parser.add_argument("--image_size", type=int, default=64)
+    parser.add_argument("--patch_size", type=int, default=8)
+    parser.add_argument("--d_model", type=int, default=128)
+    parser.add_argument("--num_heads", type=int, default=4)
+    parser.add_argument("--num_layers", type=int, default=4)
+    parser.add_argument("--d_ff", type=int, default=512)
+    parser.add_argument("--dropout_rate", type=float, default=0.1)
+    parser.add_argument("--training_steps", type=int, default=500)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--learning_rate", type=float, default=3e-4)
+    parser.add_argument("--optimizer", default="adamw",
+                        choices=("adam", "adamw", "sgd", "momentum"))
+    parser.add_argument("--lr_schedule", default="warmup_cosine",
+                        choices=("constant", "cosine", "warmup_cosine", "linear"))
+    parser.add_argument("--warmup_steps", type=int, default=50)
+    parser.add_argument("--eval_step_interval", type=int, default=50)
+    parser.add_argument("--testing_percentage", type=int, default=10)
+    parser.add_argument("--validation_percentage", type=int, default=10)
+    # Reference distortion flags (retrain parity).
+    parser.add_argument("--flip_left_right", action="store_true")
+    parser.add_argument("--random_crop", type=int, default=0)
+    parser.add_argument("--random_scale", type=int, default=0)
+    parser.add_argument("--random_brightness", type=int, default=0)
+    parser.add_argument("--output", default="", help="bundle path (labels embedded)")
+    parser.add_argument("--seed", type=int, default=0)
+    args, _ = parser.parse_known_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_tpu.data import images as I
+    from distributed_tensorflow_tpu.data.augment import (
+        distort_batch,
+        load_image,
+        should_distort_images,
+    )
+    from distributed_tensorflow_tpu.models.vit import ViT, ViTConfig
+    from distributed_tensorflow_tpu.parallel import data_parallel as dp
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+    from distributed_tensorflow_tpu.train.optimizers import make_optimizer
+    from distributed_tensorflow_tpu.utils.timer import StepTimer
+
+    image_lists = I.create_image_lists(
+        args.image_dir, args.testing_percentage, args.validation_percentage
+    )
+    if len(image_lists) < 2:
+        sys.exit(f"need >= 2 class folders under {args.image_dir}")
+    labels = sorted(image_lists)
+    class_count = len(labels)
+
+    def load_split(category):
+        """All images of a split, resized uint8, with int label indices."""
+        xs, ys = [], []
+        for li, label in enumerate(labels):
+            info = image_lists[label]
+            for fname in info[category]:
+                path = os.path.join(args.image_dir, info["dir"], fname)
+                xs.append(load_image(path, args.image_size))
+                ys.append(li)
+        if not xs:
+            return None
+        return np.stack(xs), np.asarray(ys, np.int64)
+
+    train_x, train_y = load_split("training")
+    mesh = make_mesh()
+    cfg = ViTConfig(
+        image_size=args.image_size,
+        patch_size=args.patch_size,
+        channels=3,
+        num_classes=class_count,
+        d_model=args.d_model,
+        num_heads=args.num_heads,
+        num_layers=args.num_layers,
+        d_ff=args.d_ff,
+        dropout_rate=args.dropout_rate,
+        compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
+    )
+    model = ViT(cfg)
+    tx = make_optimizer(
+        args.optimizer,
+        args.learning_rate,
+        total_steps=args.training_steps,
+        schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
+    )
+    sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
+    host = jax.device_get(model.init(jax.random.PRNGKey(args.seed), sample)["params"])
+    params = dp.replicate(host, mesh)
+    opt = dp.replicate(jax.device_get(tx.init(host)), mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    train_step = dp.build_train_step(model.apply, tx, mesh, donate=False)
+    eval_step = dp.build_eval_step(model.apply, mesh)
+
+    do_distort = should_distort_images(
+        args.flip_left_right, args.random_crop, args.random_scale, args.random_brightness
+    )
+    rng = np.random.default_rng(args.seed)
+    distort_key = jax.random.PRNGKey(args.seed + 1)
+    eye = np.eye(class_count, dtype=np.float32)
+    norm = lambda u8: u8.astype(np.float32) / 127.5 - 1.0  # [-1, 1]
+
+    def train_batch(step_key):
+        idx = rng.integers(0, len(train_x), args.batch_size)
+        imgs = train_x[idx].astype(np.float32)  # (B, S, S, 3) in [0, 255]
+        if do_distort:
+            imgs = np.asarray(
+                distort_batch(
+                    step_key,
+                    jnp.asarray(imgs),
+                    args.flip_left_right,
+                    args.random_crop,
+                    args.random_scale,
+                    args.random_brightness,
+                )
+            )
+        return {"image": imgs / 127.5 - 1.0, "label": eye[train_y[idx]]}
+
+    def evaluate(category):
+        split = load_split(category)
+        if split is None:
+            return None
+        xs, ys = split
+        batch = {"image": norm(xs), "label": eye[ys]}
+        padded, n = dp.pad_to_multiple(batch, mesh.devices.size)
+        correct, _ = eval_step(params, dp.shard_global_batch(padded, mesh))
+        return float(correct) / n
+
+    timer = StepTimer()
+    base_key = jax.random.PRNGKey(args.seed + 2)
+    for i in range(args.training_steps):
+        batch = dp.shard_batch(train_batch(jax.random.fold_in(distort_key, i)), mesh)
+        params, opt, g, m = train_step(params, opt, g, batch, base_key)
+        timer.tick()
+        if (i + 1) % args.eval_step_interval == 0 or i + 1 == args.training_steps:
+            val_acc = evaluate("validation")
+            print(
+                json.dumps(
+                    {
+                        "step": int(jax.device_get(g)),
+                        "loss": round(float(jax.device_get(m["loss"])), 4),
+                        "batch_accuracy": round(float(jax.device_get(m["accuracy"])), 4),
+                        "validation_accuracy": None if val_acc is None else round(val_acc, 4),
+                        "steps_per_sec": round(timer.steps_per_sec, 2),
+                    }
+                ),
+                flush=True,
+            )
+
+    test_acc = evaluate("testing")
+    if test_acc is not None:
+        print(json.dumps({"final_test_accuracy": round(test_acc, 4)}), flush=True)
+
+    if args.output:
+        from distributed_tensorflow_tpu.train.checkpoint import export_inference_bundle
+
+        export_inference_bundle(
+            args.output,
+            jax.device_get(params),
+            labels=labels,
+            labels_path=args.output + ".labels.txt",
+            metadata={
+                "model": "ViT",
+                "labels": labels,
+                "config": {
+                    "image_size": cfg.image_size,
+                    "patch_size": cfg.patch_size,
+                    "channels": cfg.channels,
+                    "num_classes": cfg.num_classes,
+                    "d_model": cfg.d_model,
+                    "num_heads": cfg.num_heads,
+                    "num_layers": cfg.num_layers,
+                    "d_ff": cfg.d_ff,
+                },
+            },
+        )
+        print(f"exported {args.output}")
+    return test_acc
+
+
+if __name__ == "__main__":
+    main()

@@ -59,7 +59,11 @@ def main(argv=None):
     )
     parser.add_argument("--num_experts", type=int, default=4, help="ep only")
     parser.add_argument("--model_parallel", type=int, default=1)
-    parser.add_argument("--pipeline_parallel", type=int, default=1, help="3d only")
+    parser.add_argument(
+        "--pipeline_parallel", type=int, default=1,
+        help="size of the 'pipe' mesh axis: pipeline stages (3d) or "
+             "sequence shards (sp_tp)",
+    )
     parser.add_argument("--training_steps", type=int, default=100)
     parser.add_argument("--eval_step_interval", type=int, default=10)
     parser.add_argument("--batch_size", type=int, default=8, help="global batch")
@@ -135,8 +139,8 @@ def main(argv=None):
 
         # Same seed on every process: batches are a pure function of
         # (seed, step), every process generates the IDENTICAL global batch
-        # and shard_global_batch slices out its own block — so a run's data
-        # schedule is independent of the process count.
+        # and shard_global_batch serves each device its own index slice of
+        # it — so a run's data schedule is independent of the process count.
         text_data = ByteTextDataset(
             load_byte_tokens(args.text_file),
             args.seq_len,
