@@ -77,3 +77,29 @@ def test_requires_two_classes(tmp_path):
     Image.fromarray(np.zeros((32, 32, 3), np.uint8)).save(d / "only" / "x.jpg")
     with pytest.raises(SystemExit, match="2 class"):
         tic.main(["--image_dir", str(d), "--training_steps", "1"])
+
+
+def test_classify_folder_cli_round_trip(image_dir, tmp_path):
+    """Train → export → classify_folder: the inference half reads the bundle
+    by its embedded config/labels and gets the generated classes right."""
+    import tools.classify_folder as cf
+
+    bundle = tmp_path / "cls2.msgpack"
+    tic.main(
+        [
+            "--image_dir", str(image_dir),
+            "--training_steps", "40",
+            "--eval_step_interval", "40",
+            "--batch_size", "16",
+            "--image_size", "32",
+            "--patch_size", "8",
+            "--d_model", "32",
+            "--num_heads", "2",
+            "--num_layers", "2",
+            "--d_ff", "64",
+            "--output", str(bundle),
+        ]
+    )
+    results = cf.main(["--model", str(bundle), "--imgs_dir", str(image_dir / "red")])
+    preds = list(results.values())
+    assert preds and preds.count("red") >= len(preds) * 0.8, results

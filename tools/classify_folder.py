@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Classify a folder of images with a ViT bundle from
+``tools/train_image_classifier.py`` — the inference half of the end-to-end
+image workflow (output style mirrors the reference's frozen-graph classifier
+CLI, ``retrain1/test.py:51-58``: ALL class scores sorted descending + a
+final verdict per image, one jitted apply reused across images).
+
+Example:
+  python tools/classify_folder.py --model cls.msgpack --imgs_dir ./imgs
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="cls.msgpack")
+    parser.add_argument("--imgs_dir", default="imgs/")
+    args, _ = parser.parse_known_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import serialization
+
+    from distributed_tensorflow_tpu.data.augment import load_image
+    from distributed_tensorflow_tpu.data.digit import iter_image_files
+    from distributed_tensorflow_tpu.models.vit import ViT, ViTConfig
+    from distributed_tensorflow_tpu.train.checkpoint import load_inference_bundle
+
+    state, meta = load_inference_bundle(args.model)
+    shape_meta = meta.get("config")
+    labels = meta.get("labels")
+    if not shape_meta or not labels:
+        sys.exit(
+            f"{args.model} lacks embedded config/labels — train it with "
+            "tools/train_image_classifier.py"
+        )
+    cfg = ViTConfig(
+        **{k: int(v) for k, v in shape_meta.items()},
+        # Mirror the trainer's dtype choice — the bf16 default would make
+        # CPU/GPU-trained bundles classify in a different precision than
+        # they were evaluated with at training time.
+        compute_dtype=jnp.bfloat16
+        if jax.default_backend() == "tpu"
+        else jnp.float32,
+    )
+    model = ViT(cfg)
+    template = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels), jnp.float32),
+    )["params"]
+    params = serialization.from_state_dict(template, state)
+
+    predict = jax.jit(
+        lambda p, x: jax.nn.softmax(model.apply({"params": p}, x), axis=-1)
+    )
+
+    paths = list(iter_image_files(args.imgs_dir))
+    if not paths:
+        sys.exit(f"no images under {args.imgs_dir}")
+    results = {}
+    for path in paths:
+        x = load_image(path, cfg.image_size).astype(np.float32) / 127.5 - 1.0
+        scores = np.asarray(predict(params, x[None]))[0]
+        order = np.argsort(scores)[::-1]
+        # Reference output style: every class, sorted desc, then the verdict.
+        for idx in order:
+            print(f"{labels[idx]} (score = {scores[idx]:.5f})")
+        print(f"{path}: the predicted class is {labels[order[0]]}")
+        results[path] = labels[order[0]]
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -71,7 +71,7 @@ def main(argv=None):
     image_lists = I.create_image_lists(
         args.image_dir, args.testing_percentage, args.validation_percentage
     )
-    if len(image_lists) < 2:
+    if not image_lists or len(image_lists) < 2:
         sys.exit(f"need >= 2 class folders under {args.image_dir}")
     labels = sorted(image_lists)
     class_count = len(labels)
@@ -89,7 +89,16 @@ def main(argv=None):
             return None
         return np.stack(xs), np.asarray(ys, np.int64)
 
-    train_x, train_y = load_split("training")
+    train_split = load_split("training")
+    if train_split is None:
+        sys.exit(
+            "no training images after the split — lower --testing_percentage/"
+            "--validation_percentage or add images"
+        )
+    train_x, train_y = train_split
+    # Eval splits decoded ONCE (evaluate() runs every interval; re-reading
+    # the folder each time would stall training on redundant I/O).
+    eval_splits = {c: load_split(c) for c in ("validation", "testing")}
     mesh = make_mesh()
     cfg = ViTConfig(
         image_size=args.image_size,
@@ -144,7 +153,7 @@ def main(argv=None):
         return {"image": imgs / 127.5 - 1.0, "label": eye[train_y[idx]]}
 
     def evaluate(category):
-        split = load_split(category)
+        split = eval_splits[category]
         if split is None:
             return None
         xs, ys = split
