@@ -293,6 +293,36 @@ def load_lm_bundle(path: str, fallback_shapes: dict | None = None):
     return cfg, params, meta
 
 
+def load_vit_bundle(path: str):
+    """Restore a ViT classifier bundle from ``tools/train_image_classifier``:
+    (cfg, params, metadata). Shape config, class labels, and the TRAINING
+    compute dtype all come from the embedded metadata (so a CPU-trained f32
+    bundle classifies in f32 even on a TPU host, and vice versa)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.vit import ViT, ViTConfig
+
+    state, meta = load_inference_bundle(path)
+    shape_meta = meta.get("config")
+    if not shape_meta or not meta.get("labels"):
+        raise ValueError(
+            f"{path} lacks embedded config/labels — train it with "
+            "tools/train_image_classifier.py"
+        )
+    dtype_name = meta.get("compute_dtype", "float32")
+    cfg = ViTConfig(
+        **{k: int(v) for k, v in shape_meta.items()},
+        compute_dtype=jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32,
+    )
+    template = ViT(cfg).init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels), jnp.float32),
+    )["params"]
+    params = serialization.from_state_dict(template, state)
+    return cfg, params, meta
+
+
 def load_labels(path: str) -> list[str]:
     with open(path) as fh:
         return [ln.rstrip("\n") for ln in fh if ln.strip()]

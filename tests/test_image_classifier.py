@@ -48,26 +48,17 @@ def test_trains_to_high_accuracy_and_exports(image_dir, tmp_path):
         "green", "red",
     ]
 
-    # The bundle restores into the ViT named by its embedded config.
-    import jax
+    # The bundle restores through the shared loader (embedded config, labels,
+    # and the TRAINING compute dtype — f32 here, on CPU).
     import jax.numpy as jnp
-    from flax import serialization
 
-    from distributed_tensorflow_tpu.models.vit import ViT, ViTConfig
-    from distributed_tensorflow_tpu.train.checkpoint import load_inference_bundle
+    from distributed_tensorflow_tpu.models.vit import ViT
+    from distributed_tensorflow_tpu.train.checkpoint import load_vit_bundle
 
-    state, meta = load_inference_bundle(str(bundle))
+    cfg, params, meta = load_vit_bundle(str(bundle))
     assert meta["labels"] == ["green", "red"]
-    cfg = ViTConfig(
-        **{k: v for k, v in meta["config"].items() if k != "channels"},
-        channels=3,
-    )
-    model = ViT(cfg)
-    template = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3), jnp.float32)
-    )["params"]
-    params = serialization.from_state_dict(template, state)
-    logits = model.apply({"params": params}, jnp.zeros((2, 32, 32, 3), jnp.float32))
+    assert cfg.compute_dtype == jnp.float32
+    logits = ViT(cfg).apply({"params": params}, jnp.zeros((2, 32, 32, 3), jnp.float32))
     assert logits.shape == (2, 2)
 
 

@@ -23,38 +23,19 @@ def main(argv=None):
     args, _ = parser.parse_known_args(argv)
 
     import jax
-    import jax.numpy as jnp
     import numpy as np
-    from flax import serialization
 
     from distributed_tensorflow_tpu.data.augment import load_image
     from distributed_tensorflow_tpu.data.digit import iter_image_files
-    from distributed_tensorflow_tpu.models.vit import ViT, ViTConfig
-    from distributed_tensorflow_tpu.train.checkpoint import load_inference_bundle
+    from distributed_tensorflow_tpu.models.vit import ViT
+    from distributed_tensorflow_tpu.train.checkpoint import load_vit_bundle
 
-    state, meta = load_inference_bundle(args.model)
-    shape_meta = meta.get("config")
-    labels = meta.get("labels")
-    if not shape_meta or not labels:
-        sys.exit(
-            f"{args.model} lacks embedded config/labels — train it with "
-            "tools/train_image_classifier.py"
-        )
-    cfg = ViTConfig(
-        **{k: int(v) for k, v in shape_meta.items()},
-        # Mirror the trainer's dtype choice — the bf16 default would make
-        # CPU/GPU-trained bundles classify in a different precision than
-        # they were evaluated with at training time.
-        compute_dtype=jnp.bfloat16
-        if jax.default_backend() == "tpu"
-        else jnp.float32,
-    )
+    try:
+        cfg, params, meta = load_vit_bundle(args.model)
+    except ValueError as e:
+        sys.exit(str(e))
+    labels = meta["labels"]
     model = ViT(cfg)
-    template = model.init(
-        jax.random.PRNGKey(0),
-        jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels), jnp.float32),
-    )["params"]
-    params = serialization.from_state_dict(template, state)
 
     predict = jax.jit(
         lambda p, x: jax.nn.softmax(model.apply({"params": p}, x), axis=-1)

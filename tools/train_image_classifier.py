@@ -198,6 +198,11 @@ def main(argv=None):
             metadata={
                 "model": "ViT",
                 "labels": labels,
+                # Recorded so inference replays the TRAINING precision
+                # regardless of the classifying host's backend.
+                "compute_dtype": "bfloat16"
+                if cfg.compute_dtype == jnp.bfloat16
+                else "float32",
                 "config": {
                     "image_size": cfg.image_size,
                     "patch_size": cfg.patch_size,

@@ -100,6 +100,12 @@ def main(argv=None):
         help="checkpoint dir: timed autosave + resume (any parallelism mode)",
     )
     parser.add_argument("--save_secs", type=int, default=600)
+    parser.add_argument(
+        "--profile_dir", default="",
+        help="write a jax.profiler (TensorBoard XPlane) trace here",
+    )
+    parser.add_argument("--profile_start_step", type=int, default=5)
+    parser.add_argument("--profile_num_steps", type=int, default=3)
     parser.add_argument("--seed", type=int, default=0)
     # Reference-style cluster flags (demo2 parity): worker_hosts[0] is the
     # jax.distributed coordinator, task_index the process id.
@@ -343,6 +349,14 @@ def main(argv=None):
         from distributed_tensorflow_tpu.utils.summary import SummaryWriter
 
         writer = SummaryWriter(args.train_dir)
+    from distributed_tensorflow_tpu.utils.profiler import Profiler
+
+    prof = Profiler(
+        args.profile_dir if chief else None,
+        start_step=start + args.profile_start_step,
+        num_steps=args.profile_num_steps,
+        sync=lambda: jax.device_get(g),
+    )
     try:
       for i in range(start, args.training_steps):
         if text_data is not None:
@@ -354,7 +368,8 @@ def main(argv=None):
                 rng, args.batch_size, args.seq_len, args.vocab_size
             )
         tokens = place(jnp.asarray(host_tokens))
-        params, opt, g, m = step(params, opt, g, tokens, key)
+        with prof.step(i):
+            params, opt, g, m = step(params, opt, g, tokens, key)
         timer.tick()
         boundary = (i + 1) % args.eval_step_interval == 0 or i + 1 == args.training_steps
         if ckpt is not None:
@@ -388,6 +403,7 @@ def main(argv=None):
                 )
 
     finally:
+        prof.close()
         if writer is not None:
             writer.close()  # durable even if a step raised
     if jax.process_count() > 1 and args.parallelism in ("dp", "sp"):
