@@ -29,6 +29,11 @@ def main(argv=None):
     parser.add_argument("--imgs_dir", default="imgs/")
     parser.add_argument("--show", action="store_true", help="display each image")
     args, _ = parser.parse_known_args(argv)
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
 
     if args.model.endswith(".stablehlo"):
         # Frozen-program path: no model code, weights baked in (the analog of

@@ -59,6 +59,11 @@ def main(argv=None):
     parser.add_argument("--imgs_dir", default="imgs/")
     parser.add_argument("--show", action="store_true")
     args, _ = parser.parse_known_args(argv)
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
 
     if args.model and args.model.endswith(".stablehlo"):
         # Frozen-program path (no model code, weights baked in).

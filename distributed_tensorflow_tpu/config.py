@@ -49,7 +49,13 @@ def from_args(cls: Type[T], args: argparse.Namespace) -> T:
 def parse_flags(*classes: Type[Any], argv=None):
     """Parse known args into one instance per dataclass (mirrors the
     reference's ``parser.parse_known_args()`` tolerance of unknown flags,
-    ``demo2/train.py:222``)."""
+    ``demo2/train.py:222``). Also the shared CLI bootstrap: enables the
+    persistent XLA compilation cache (``utils/compile_cache.py``)."""
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
     parser = argparse.ArgumentParser()
     for cls in classes:
         add_dataclass_flags(parser, cls)

@@ -230,7 +230,11 @@ def create_model(num_classes: int = NUM_CLASSES_2015, compute_dtype=jnp.bfloat16
 
 
 def init_params(model: InceptionV3, seed: int = 0, image_size: int = INPUT_SIZE):
-    variables = model.init(
+    # Jitted: eager flax init dispatches each of the trunk's ~500 primitives
+    # individually — minutes through a high-latency device tunnel. One
+    # compiled program runs in milliseconds (and hits the persistent
+    # compilation cache across processes).
+    variables = jax.jit(model.init)(
         jax.random.PRNGKey(seed),
         jnp.zeros((1, image_size, image_size, INPUT_DEPTH), jnp.float32),
     )
@@ -246,7 +250,16 @@ def load_pretrained(path: str, model: InceptionV3, image_size: int = INPUT_SIZE)
     from distributed_tensorflow_tpu.train.checkpoint import load_inference_bundle
     from flax import serialization
 
-    template = init_params(model, image_size=image_size)
+    # eval_shape: only the tree STRUCTURE is needed (every value is about to
+    # be overwritten) — no compile, no device compute.
+    shapes = jax.eval_shape(
+        model.init,
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, image_size, image_size, INPUT_DEPTH), jnp.float32),
+    )
+    template = jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, s.dtype), shapes
+    )
     if path.endswith(".npz"):
         flat = dict(np.load(path))
         state = serialization.to_state_dict(template)

@@ -1,0 +1,42 @@
+"""Persistent XLA compilation cache for the CLIs.
+
+Measured on this runtime: compiling Inception-v3 through the TPU tunnel
+costs ~4-5 minutes, re-paid on EVERY retrain invocation — JAX's persistent
+compilation cache is opt-in and nothing enabled it. Every CLI calls
+:func:`enable_compilation_cache` right after parsing flags, so repeat runs
+(the reference's own workflow: train, then the test CLI, then retrain again)
+reuse compiled programs across processes.
+
+Env overrides:
+  DTF_COMPILATION_CACHE=<dir>   cache location
+  DTF_COMPILATION_CACHE=0       disable
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT = os.path.join(
+    os.path.expanduser("~"), ".cache", "distributed_tensorflow_tpu", "xla"
+)
+
+
+def enable_compilation_cache(directory: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``directory`` (default
+    ``~/.cache/distributed_tensorflow_tpu/xla``; env override above).
+    Returns the directory, or None when disabled. Safe to call repeatedly
+    and before/after backend init (config keys only gate compile time)."""
+    env = os.environ.get("DTF_COMPILATION_CACHE")
+    if env == "0":
+        return None
+    directory = env or directory or _DEFAULT
+    import jax
+
+    try:
+        os.makedirs(directory, exist_ok=True)
+    except OSError:
+        # Purely an optimization — an unwritable HOME (CI containers) must
+        # not turn it into a startup crash.
+        return None
+    jax.config.update("jax_compilation_cache_dir", directory)
+    return directory

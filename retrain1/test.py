@@ -34,6 +34,11 @@ def main(argv=None):
     parser.add_argument("--model_dir", default="./inception_model")
     parser.add_argument("--show", action="store_true")
     args, _ = parser.parse_known_args(argv)
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
 
     labels = load_labels(args.labels)  # id → name map, retrain1/test.py:10-16
     if args.graph.endswith(".stablehlo"):

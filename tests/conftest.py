@@ -12,6 +12,10 @@ read lazily at CPU-client creation, so setting it here is early enough.
 
 import os
 
+# Tests invoke CLI mains, which enable the persistent compilation cache —
+# keep test runs from writing state into the real user home.
+os.environ.setdefault("DTF_COMPILATION_CACHE", "0")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

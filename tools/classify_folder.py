@@ -21,6 +21,11 @@ def main(argv=None):
     parser.add_argument("--model", default="cls.msgpack")
     parser.add_argument("--imgs_dir", default="imgs/")
     args, _ = parser.parse_known_args(argv)
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
 
     import jax
     import numpy as np

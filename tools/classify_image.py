@@ -28,6 +28,11 @@ def main(argv=None):
     )
     parser.add_argument("--num_top_predictions", type=int, default=5)
     args, _ = parser.parse_known_args(argv)
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
 
     import jax
 

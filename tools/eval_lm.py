@@ -37,6 +37,11 @@ def main(argv=None):
     parser.add_argument("--num_layers", type=int, default=4)
     parser.add_argument("--d_ff", type=int, default=512)
     args, _ = parser.parse_known_args(argv)
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
 
     import jax
     import jax.numpy as jnp

@@ -41,6 +41,11 @@ def main(argv=None):
     parser.add_argument("--d_ff", type=int, default=512)
     parser.add_argument("--seed", type=int, default=0)
     args, _ = parser.parse_known_args(argv)
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
 
     import jax
     import jax.numpy as jnp

@@ -113,6 +113,11 @@ def main(argv=None):
     parser.add_argument("--task_index", type=int, default=0)
     parser.add_argument("--job_name", default="worker")
     args, _ = parser.parse_known_args(argv)
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
 
     import jax
     import jax.numpy as jnp
