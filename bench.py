@@ -54,6 +54,12 @@ def main() -> None:
     import jax.numpy as jnp
     import optax
 
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+
     from distributed_tensorflow_tpu.data.mnist import read_data_sets
     from distributed_tensorflow_tpu.data.prefetch import (
         bounded_device_batches,

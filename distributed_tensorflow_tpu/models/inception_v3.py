@@ -263,6 +263,7 @@ def load_pretrained(path: str, model: InceptionV3, image_size: int = INPUT_SIZE)
     if path.endswith(".npz"):
         flat = dict(np.load(path))
         state = serialization.to_state_dict(template)
+        missing: list[str] = []
 
         def fill(prefix, node):
             for k, v in node.items():
@@ -271,7 +272,26 @@ def load_pretrained(path: str, model: InceptionV3, image_size: int = INPUT_SIZE)
                     fill(key, v)
                 elif key in flat:
                     node[k] = flat[key]
+                else:
+                    missing.append(key)
+
         fill("", state)
+        if missing:
+            # Partial archive: the zero template would silently kill the
+            # network (a missing BatchNorm scale zeroes its whole layer).
+            # Refill over REAL init values (BN scale/var = 1, random
+            # kernels) so absent leaves degrade gracefully, and say so.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s is missing %d tensors (e.g. %s); filling them with "
+                "fresh init values",
+                path, len(missing), missing[0],
+            )
+            template = init_params(model, image_size=image_size)
+            state = serialization.to_state_dict(template)
+            missing.clear()
+            fill("", state)
         return serialization.from_state_dict(template, state)
     restored, _ = load_inference_bundle(path, template=template)
     return restored

@@ -58,3 +58,51 @@ def test_deterministic_inference(model_and_vars):
     a = np.asarray(model.apply(variables, x, return_bottleneck=True))
     b = np.asarray(model.apply(variables, x, return_bottleneck=True))
     np.testing.assert_array_equal(a, b)
+
+
+def test_load_pretrained_partial_npz_falls_back_to_init(tmp_path, model_and_vars):
+    """A .npz missing tensors must fill the gaps with REAL init values
+    (BN scale/var = 1), not the zero template — a zeroed BatchNorm scale
+    silently kills its whole layer."""
+    from flax import serialization
+
+    model, variables = model_and_vars
+    flat = {}
+    state = serialization.to_state_dict(jax.device_get(variables))
+
+    def collect(prefix, node):
+        for k, v in node.items():
+            key = f"{prefix}/{k}" if prefix else k
+            if isinstance(v, dict):
+                collect(key, v)
+            else:
+                flat[key] = np.asarray(v)
+
+    collect("", state)
+    # Drop ~half the tensors, including batch-norm scales.
+    kept = {k: v for i, (k, v) in enumerate(sorted(flat.items())) if i % 2 == 0}
+    npz = tmp_path / "partial.npz"
+    np.savez(npz, **kept)
+
+    restored = iv3.load_pretrained(str(npz), model, image_size=SMALL)
+    # Kept tensors match the archive; missing ones are NOT all zeros.
+    rstate = serialization.to_state_dict(jax.device_get(restored))
+    rflat = {}
+
+    def collect2(prefix, node):
+        for k, v in node.items():
+            key = f"{prefix}/{k}" if prefix else k
+            if isinstance(v, dict):
+                collect2(key, v)
+            else:
+                rflat[key] = np.asarray(v)
+
+    collect2("", rstate)
+    for k, v in kept.items():
+        np.testing.assert_array_equal(rflat[k], v)
+    missing_scales = [
+        k for k in flat if k not in kept and k.endswith("/scale")
+    ]
+    assert missing_scales, "test setup should drop some BN scales"
+    for k in missing_scales:
+        assert np.any(rflat[k] != 0), f"{k} zeroed instead of init-filled"
