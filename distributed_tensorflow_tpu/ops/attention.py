@@ -258,13 +258,31 @@ except ImportError:  # pragma: no cover
     HAVE_PALLAS = False
 
 
-def _fit_block(requested: int, seq: int) -> int:
+# Ceiling on the whole-sequence fallback block below: past this, the kernel
+# would try to hold the entire K/V sequence in VMEM and fail deep inside
+# Mosaic (or OOM) far from the call site. 4096 rows × D=128 × 3 tensors ×
+# f32 ≈ 6 MB — comfortably inside a v5e core's ~16 MB VMEM.
+_FALLBACK_BLOCK_LIMIT = 4096
+
+
+def _fit_block(requested: int, seq: int, interpret: bool = False) -> int:
     """Largest block ≤ requested that divides seq AND satisfies Mosaic's
     sublane rule (multiple of 8, or the whole sequence). Falls back to the
-    full sequence when no such divisor exists (odd/prime lengths)."""
+    full sequence when no such divisor exists (odd/prime lengths) — but on
+    real TPU (not interpret mode, which has no VMEM) refuses the fallback
+    past ``_FALLBACK_BLOCK_LIMIT`` rows, where it would silently blow VMEM:
+    fail here, at the call site, with a fix."""
     for b in range(min(requested, seq), 7, -1):
         if seq % b == 0 and b % 8 == 0:
             return b
+    if seq > _FALLBACK_BLOCK_LIMIT and not interpret:
+        raise ValueError(
+            f"flash_attention: no block size ≤ {requested} that is a multiple "
+            f"of 8 divides sequence length {seq}, and the whole-sequence "
+            f"fallback ({seq} rows) exceeds the VMEM-safe limit "
+            f"({_FALLBACK_BLOCK_LIMIT}). Pad the sequence to a multiple of 8 "
+            "or use blockwise_attention for this shape."
+        )
     return seq
 
 
@@ -293,13 +311,13 @@ def _flash_forward(
     b, h, sq, d = q.shape
     skv = k.shape[2]
     s = _scale(q, scale)
-    block_q = _fit_block(block_q, sq)
-    block_kv = _fit_block(block_kv, skv)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = _fit_block(block_q, sq, interpret)
+    block_kv = _fit_block(block_kv, skv, interpret)
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, skv, d)
     vf = v.reshape(b * h, skv, d)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     num_kv = skv // block_kv
     kernel = functools.partial(
         _flash_kernel,
@@ -476,12 +494,12 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
     b, h, sq, d = q.shape
     skv = k.shape[2]
     s = _scale(q, scale)
-    block_q = _fit_block(block_q, sq)
-    block_kv = _fit_block(block_kv, skv)
-    num_q, num_kv = sq // block_q, skv // block_kv
-    q_pos_offset = skv - sq
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    block_q = _fit_block(block_q, sq, interpret)
+    block_kv = _fit_block(block_kv, skv, interpret)
+    num_q, num_kv = sq // block_q, skv // block_kv
+    q_pos_offset = skv - sq
 
     # delta = rowsum(dO ∘ O): one fused XLA elementwise-reduce, f32.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)

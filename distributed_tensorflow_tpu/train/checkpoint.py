@@ -102,6 +102,14 @@ class CheckpointManager:
         # the forced re-save of N (and in multi-process runs, one process
         # erroring out of the collective save deadlocks the others).
         self._mngr.wait_until_finished()
+        if not wait and any(
+            _cross_process_sharded(leaf)
+            for leaf in jax.tree_util.tree_leaves(state)
+        ):
+            # Cross-process-sharded leaves pass to Orbax as live jax.Arrays
+            # (no host copy in _savable) — an async write would race the
+            # training loop's next in-place update of those buffers.
+            wait = True
         if self._mngr.latest_step() == step:
             # Re-saving an existing step raises StepAlreadyExistsError in
             # Orbax — hit when a finished job restarts (restore to step N,

@@ -75,6 +75,13 @@ def test_flash_autofits_non_divisible_blocks():
     assert A._fit_block(512, 768) == 384
     assert A._fit_block(32, 48) == 24
     assert A._fit_block(512, 509) == 509  # prime -> whole sequence
+    # A long prime sequence must NOT silently fall back to one whole-sequence
+    # VMEM block on real TPU (it would die deep in Mosaic, or OOM); it fails
+    # at the call site with a pad-or-blockwise fix instead. Interpret mode
+    # has no VMEM, so the same shape stays usable for CPU debugging.
+    with pytest.raises(ValueError, match="blockwise_attention"):
+        A._fit_block(512, 8191)
+    assert A._fit_block(512, 8191, interpret=True) == 8191
     q, k, v = _qkv(s=48)
     ref = A.dense_attention(q, k, v, causal=True)
     out = A.flash_attention(q, k, v, causal=True, block_q=32, block_kv=32)

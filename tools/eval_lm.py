@@ -36,6 +36,7 @@ def main(argv=None):
     parser.add_argument("--num_heads", type=int, default=4)
     parser.add_argument("--num_layers", type=int, default=4)
     parser.add_argument("--d_ff", type=int, default=512)
+    parser.add_argument("--vocab_size", type=int, default=256)
     args, _ = parser.parse_known_args(argv)
     from distributed_tensorflow_tpu.utils.compile_cache import (
         enable_compilation_cache,
@@ -60,6 +61,7 @@ def main(argv=None):
                 "num_layers": args.num_layers,
                 "d_ff": args.d_ff,
                 "max_seq_len": args.seq_len,
+                "vocab_size": args.vocab_size,
             },
         )
     except ValueError as e:

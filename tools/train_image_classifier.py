@@ -41,6 +41,11 @@ def main(argv=None):
                         choices=("constant", "cosine", "warmup_cosine", "linear"))
     parser.add_argument("--warmup_steps", type=int, default=50)
     parser.add_argument("--eval_step_interval", type=int, default=50)
+    parser.add_argument(
+        "--eval_batch_size", type=int, default=256,
+        help="device batch per eval dispatch; splits larger than this are "
+             "chunked so a big image folder never materializes as one array",
+    )
     parser.add_argument("--testing_percentage", type=int, default=10)
     parser.add_argument("--validation_percentage", type=int, default=10)
     # Reference distortion flags (retrain parity).
@@ -157,15 +162,30 @@ def main(argv=None):
             )
         return {"image": imgs / 127.5 - 1.0, "label": eye[train_y[idx]]}
 
+    # Eval chunk: fixed size (a multiple of the mesh) so every dispatch,
+    # including the padded last one, compiles to a single program shape;
+    # correct-counts are exact-summed across chunks (build_eval_step's
+    # weight-masked psum aggregation is designed for this loop).
+    eval_chunk = max(
+        mesh.devices.size,
+        args.eval_batch_size - args.eval_batch_size % mesh.devices.size,
+    )
+
     def evaluate(category):
         split = eval_splits[category]
         if split is None:
             return None
         xs, ys = split
-        batch = {"image": norm(xs), "label": eye[ys]}
-        padded, n = dp.pad_to_multiple(batch, mesh.devices.size)
-        correct, _ = eval_step(params, dp.shard_global_batch(padded, mesh))
-        return float(correct) / n
+        total_correct = 0.0
+        for start in range(0, len(xs), eval_chunk):
+            batch = {
+                "image": norm(xs[start : start + eval_chunk]),
+                "label": eye[ys[start : start + eval_chunk]],
+            }
+            padded, _ = dp.pad_to_multiple(batch, eval_chunk)
+            correct, _ = eval_step(params, dp.shard_global_batch(padded, mesh))
+            total_correct += float(correct)
+        return total_correct / len(xs)
 
     timer = StepTimer()
     base_key = jax.random.PRNGKey(args.seed + 2)
