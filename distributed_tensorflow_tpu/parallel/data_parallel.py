@@ -375,6 +375,47 @@ def stack_shard_batches(batches: list[Batch], mesh: Mesh) -> Batch:
     return _to_global(stacked, NamedSharding(mesh, P(None, ("data", "model"))))
 
 
+def build_lm_train_step(cfg, tx, mesh: Mesh, donate: bool = False):
+    """Data-parallel LM train step: tokens ``(B, S)`` sharded over the mesh,
+    replicated params, ``lax.pmean`` gradient sync — the LM counterpart of
+    :func:`build_train_step`, shared by ``tools/train_lm.py`` (``dp`` mode)
+    and the bench harness.
+
+    step(params, opt_state, global_step, tokens, rng)
+        -> (params, opt_state, global_step, {"loss"})
+    """
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerLM,
+        next_token_loss,
+    )
+
+    model = TransformerLM(cfg)
+
+    def _shard_step(p, o, g, tokens, key):
+        del key  # no dropout in the LM pretraining path
+
+        def compute(pp_):
+            logits = model.apply({"params": pp_}, tokens)
+            return next_token_loss(logits, tokens)
+
+        loss, grads = jax.value_and_grad(compute)(p)
+        grads = lax.pmean(grads, ("data", "model"))
+        loss = lax.pmean(loss, ("data", "model"))
+        updates, o = tx.update(grads, o, p)
+        p = jax.tree_util.tree_map(lambda a, u: a + u, p, updates)
+        return p, o, g + 1, {"loss": loss}
+
+    shard_fn = jax.shard_map(
+        _shard_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(("data", "model"), None), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    donate_args = (0, 1) if donate else ()
+    return jax.jit(shard_fn, donate_argnums=donate_args)
+
+
 def build_eval_step(apply_fn: Callable, mesh: Mesh):
     """Jitted SPMD eval step: returns summed correct-count and summed
     per-example cross-entropy over the global (sharded) batch so the host can

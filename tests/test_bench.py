@@ -1,0 +1,57 @@
+"""bench.py contract test: the driver runs `python bench.py` and parses its
+stdout — exactly ONE JSON line, headline metric first, extra metrics list.
+
+Runs in a subprocess in smoke mode (tiny shapes, CPU-runnable): XLA:CPU
+compiles of the real bench shapes take minutes, and the accuracy suites are
+covered by their own tests — this asserts the harness shape, not the perf.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_one_json_line_with_extra_metrics():
+    env = dict(os.environ)
+    env.update(
+        # Pin the subprocess to CPU: clearing PALLAS_AXON_POOL_IPS disables
+        # the axon registration that would otherwise override JAX_PLATFORMS,
+        # so the real chip is never commandeered by this smoke test.
+        JAX_PLATFORMS="cpu",
+        BENCH_SMOKE="1",
+        BENCH_WARMUP_STEPS="1",
+        BENCH_TIMED_STEPS="4",
+        BENCH_STEPS_PER_CALL="2",
+        BENCH_ACC_STEPS="60",
+        DTF_COMPILATION_CACHE="0",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=_REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got {len(lines)}: {lines[:3]}"
+    rec = json.loads(lines[0])
+    # Smoke mode shrinks the batch to 16 and the metric name says so (the
+    # real driver run on TPU reports ..._batch100).
+    assert rec["metric"] == "mnist_train_steps_per_sec_per_chip_batch16"
+    assert rec["unit"] == "steps/s/chip"
+    assert rec["value"] > 0
+    assert rec["vs_baseline_estimated"] is True
+    extra = {m["metric"]: m for m in rec["extra_metrics"]}
+    # Every extra bench ran without an `_error` record.
+    assert not [k for k in extra if k.endswith("_error")], extra
+    assert extra["lm_train_tokens_per_sec_per_chip"]["value"] > 0
+    assert extra["mnist_synthetic_test_accuracy"]["value"] >= 0.5
+    assert extra["vit_e2e_test_accuracy"]["value"] >= 0.5
+    # CPU backend: no MFU (unknown peak) and no Mosaic kernel timings.

@@ -275,38 +275,12 @@ def main(argv=None):
         opt = rep(jax.device_get(tx.init(plain)))
         place = lambda t: sp.shard_lm_batch(t, mesh)
     else:  # dp
-        from distributed_tensorflow_tpu.models.transformer import next_token_loss
-
         plain = jax.device_get(
             TransformerLM(cfg).init(
                 jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32)
             )["params"]
         )
-        model = TransformerLM(cfg)
-
-        from jax import lax
-
-        def _shard_step(p, o, g, tokens, key):
-            def compute(pp_):
-                logits = model.apply({"params": pp_}, tokens)
-                return next_token_loss(logits, tokens)
-
-            loss, grads = jax.value_and_grad(compute)(p)
-            grads = lax.pmean(grads, ("data", "model"))
-            loss = lax.pmean(loss, ("data", "model"))
-            updates, o = tx.update(grads, o, p)
-            p = jax.tree_util.tree_map(lambda a, u: a + u, p, updates)
-            return p, o, g + 1, {"loss": loss}
-
-        step = jax.jit(
-            jax.shard_map(
-                _shard_step,
-                mesh=mesh,
-                in_specs=(P(), P(), P(), P(("data", "model"), None), P()),
-                out_specs=(P(), P(), P(), P()),
-                check_vma=False,
-            )
-        )
+        step = dp.build_lm_train_step(cfg, tx, mesh, donate=False)
         params = rep(plain)
         opt = rep(jax.device_get(tx.init(plain)))
         place = lambda t: dp.shard_global_batch({"x": t}, mesh)["x"]
