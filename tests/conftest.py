@@ -23,3 +23,19 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def make_string_const_node(name: bytes, payload: bytes) -> bytes:
+    """Serialized GraphDef NodeDef: a DT_STRING Const (the real 2015 pb's
+    ``DecodeJpeg/contents`` feed node) — shared by the graphdef-import and
+    golden-fixture tests so the wire encoding lives in one place."""
+    from distributed_tensorflow_tpu.models import graphdef_import as gd
+
+    tensor = gd._field(1, 0, 7) + gd._field(8, 2, gd._field(1, 2, payload))
+    attr = gd._field(1, 2, b"value") + gd._field(2, 2, gd._field(8, 2, tensor))
+    node = (
+        gd._field(1, 2, name)
+        + gd._field(2, 2, b"Const")
+        + gd._field(5, 2, attr)
+    )
+    return gd._field(1, 2, node)

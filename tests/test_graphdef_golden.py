@@ -1,0 +1,230 @@
+"""Golden-fixture test of the 2015 Inception GraphDef import (VERDICT r1 #4).
+
+The round-1 import tests generated their fixture FROM ``inception_2015_name_map``
+and the flax template — circular: a wrong name map would produce a matching
+wrong fixture. This file instead hand-codes the **documented structure of the
+real ``classify_image_graph_def.pb``** (the 2015-12-05 release the reference
+downloads, ``/root/reference/retrain1/retrain.py:27,40-62`` and imports at
+``retrain1/retrain.py:66-74``), independent of both the map and the model:
+
+  * all 94 conv scopes with their exact documented kernel shapes — the stem
+    ``conv..conv_4``, the 11 ``mixed*`` blocks with ``tower``/``tower_1``/
+    ``tower_2`` branch scopes, the factorized 1x7/7x1 and parallel 1x3/3x1
+    kernels (Szegedy et al. 2015, as emitted by the 2015 graph);
+  * per conv: ``conv2d_params`` + ``batchnorm/{beta,moving_mean,
+    moving_variance}`` and **no gamma** (the 2015 graph used
+    ``scale_after_normalization=False``);
+  * the ``softmax/weights`` (2048, 1008) / ``softmax/biases`` head;
+  * the non-weight Consts the real file carries: the DT_STRING
+    ``DecodeJpeg/contents`` feed node and the decode-path scalars
+    (``Sub/y`` 128, ``Mul/y`` 1/128, ``ResizeBilinear/size`` [299, 299]).
+
+A pb in this exact naming is serialized and imported end to end; every scope
+family must load (nothing defaulted but the 94 gammas), and the model must
+run with the imported weights, with the head wiring hand-checked as
+``logits == bottleneck @ softmax/weights + softmax/biases``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import graphdef_import as gd
+from distributed_tensorflow_tpu.models import inception_v3 as iv3
+
+# ---------------------------------------------------------------------------
+# Documented 2015 graph structure: scope -> conv kernel (H, W, Cin, Cout).
+# Channel/shape table per the Inception-v3 paper + the 2015 release; NOT
+# derived from the repo's name map or flax template.
+# ---------------------------------------------------------------------------
+
+GOLDEN_CONVS: dict[str, tuple[int, int, int, int]] = {
+    # Stem: 299x299x3 -> 35x35x192.
+    "conv": (3, 3, 3, 32),
+    "conv_1": (3, 3, 32, 32),
+    "conv_2": (3, 3, 32, 64),
+    "conv_3": (1, 1, 64, 80),
+    "conv_4": (3, 3, 80, 192),
+}
+
+def _block_a(prefix: str, cin: int, pool: int) -> None:
+    GOLDEN_CONVS.update(
+        {
+            f"{prefix}/conv": (1, 1, cin, 64),
+            f"{prefix}/tower/conv": (1, 1, cin, 48),
+            f"{prefix}/tower/conv_1": (5, 5, 48, 64),
+            f"{prefix}/tower_1/conv": (1, 1, cin, 64),
+            f"{prefix}/tower_1/conv_1": (3, 3, 64, 96),
+            f"{prefix}/tower_1/conv_2": (3, 3, 96, 96),
+            f"{prefix}/tower_2/conv": (1, 1, cin, pool),
+        }
+    )
+
+def _block_b(prefix: str, c: int) -> None:  # 17x17 blocks, factorized 7x7
+    GOLDEN_CONVS.update(
+        {
+            f"{prefix}/conv": (1, 1, 768, 192),
+            f"{prefix}/tower/conv": (1, 1, 768, c),
+            f"{prefix}/tower/conv_1": (1, 7, c, c),
+            f"{prefix}/tower/conv_2": (7, 1, c, 192),
+            f"{prefix}/tower_1/conv": (1, 1, 768, c),
+            f"{prefix}/tower_1/conv_1": (7, 1, c, c),
+            f"{prefix}/tower_1/conv_2": (1, 7, c, c),
+            f"{prefix}/tower_1/conv_3": (7, 1, c, c),
+            f"{prefix}/tower_1/conv_4": (1, 7, c, 192),
+            f"{prefix}/tower_2/conv": (1, 1, 768, 192),
+        }
+    )
+
+def _block_c(prefix: str, cin: int) -> None:  # 8x8 blocks, parallel 1x3/3x1
+    GOLDEN_CONVS.update(
+        {
+            f"{prefix}/conv": (1, 1, cin, 320),
+            f"{prefix}/tower/conv": (1, 1, cin, 384),
+            f"{prefix}/tower/mixed/conv": (1, 3, 384, 384),
+            f"{prefix}/tower/mixed/conv_1": (3, 1, 384, 384),
+            f"{prefix}/tower_1/conv": (1, 1, cin, 448),
+            f"{prefix}/tower_1/conv_1": (3, 3, 448, 384),
+            f"{prefix}/tower_1/mixed/conv": (1, 3, 384, 384),
+            f"{prefix}/tower_1/mixed/conv_1": (3, 1, 384, 384),
+            f"{prefix}/tower_2/conv": (1, 1, cin, 192),
+        }
+    )
+
+_block_a("mixed", 192, 32)     # 35x35: 192 -> 256
+_block_a("mixed_1", 256, 64)   # 256 -> 288
+_block_a("mixed_2", 288, 64)   # 288 -> 288
+GOLDEN_CONVS.update(           # mixed_3: 35x35 -> 17x17 reduction
+    {
+        "mixed_3/conv": (3, 3, 288, 384),
+        "mixed_3/tower/conv": (1, 1, 288, 64),
+        "mixed_3/tower/conv_1": (3, 3, 64, 96),
+        "mixed_3/tower/conv_2": (3, 3, 96, 96),
+    }
+)
+_block_b("mixed_4", 128)
+_block_b("mixed_5", 160)
+_block_b("mixed_6", 160)
+_block_b("mixed_7", 192)
+GOLDEN_CONVS.update(           # mixed_8: 17x17 -> 8x8 reduction
+    {
+        "mixed_8/tower/conv": (1, 1, 768, 192),
+        "mixed_8/tower/conv_1": (3, 3, 192, 320),
+        "mixed_8/tower_1/conv": (1, 1, 768, 192),
+        "mixed_8/tower_1/conv_1": (1, 7, 192, 192),
+        "mixed_8/tower_1/conv_2": (7, 1, 192, 192),
+        "mixed_8/tower_1/conv_3": (3, 3, 192, 192),
+    }
+)
+_block_c("mixed_9", 1280)
+_block_c("mixed_10", 2048)
+
+HEAD_SHAPE = (2048, 1008)  # softmax/weights in the 2015 pb
+
+
+def golden_consts(rng: np.random.Generator) -> dict[str, np.ndarray]:
+    """Every weight Const of the real pb, in its naming, gamma ABSENT."""
+    consts: dict[str, np.ndarray] = {}
+    for scope, (kh, kw, cin, cout) in GOLDEN_CONVS.items():
+        consts[f"{scope}/conv2d_params"] = (
+            rng.standard_normal((kh, kw, cin, cout)).astype(np.float32) * 0.05
+        )
+        consts[f"{scope}/batchnorm/beta"] = np.zeros(cout, np.float32)
+        consts[f"{scope}/batchnorm/moving_mean"] = (
+            rng.standard_normal(cout).astype(np.float32) * 0.01
+        )
+        consts[f"{scope}/batchnorm/moving_variance"] = np.ones(cout, np.float32)
+    consts["softmax/weights"] = (
+        rng.standard_normal(HEAD_SHAPE).astype(np.float32) * 0.01
+    )
+    consts["softmax/biases"] = np.zeros(HEAD_SHAPE[1], np.float32)
+    return consts
+
+
+def _decode_path_extras() -> bytes:
+    """The real pb's non-weight Consts: numeric decode-path scalars (parse
+    as consts, must surface as ``unused``) and the DT_STRING jpeg feed node
+    (must be skipped without error)."""
+    from tests.conftest import make_string_const_node
+
+    numeric = gd.serialize_graphdef_consts(
+        {
+            "Sub/y": np.float32(128.0),
+            "Mul/y": np.float32(1.0 / 128.0),
+            "ResizeBilinear/size": np.asarray([299, 299], np.int32),
+        }
+    )
+    return numeric + make_string_const_node(
+        b"DecodeJpeg/contents", b"\xff\xd8fixture-jpeg-bytes"
+    )
+
+
+@pytest.fixture(scope="module")
+def imported():
+    rng = np.random.default_rng(2015)
+    consts = golden_consts(rng)
+    blob = gd.serialize_graphdef_consts(consts) + _decode_path_extras()
+    model = iv3.create_model(compute_dtype=jnp.float32)
+    variables, report = gd.import_inception_graphdef(blob, model=model, image_size=96)
+    return consts, model, variables, report
+
+
+def test_scope_count_is_the_real_graphs():
+    assert len(GOLDEN_CONVS) == 94  # the 2015 graph's conv layer count
+
+
+def test_name_map_covers_exactly_the_golden_scopes():
+    assert set(gd.inception_2015_name_map()) == set(GOLDEN_CONVS)
+
+
+def test_every_golden_const_loads_and_only_gammas_default(imported):
+    consts, _, _, report = imported
+    assert set(report["loaded"]) == set(consts)
+    assert set(report["defaulted"]) == {
+        f"{scope}/batchnorm/gamma" for scope in GOLDEN_CONVS
+    }
+    # Decode-path numerics surface as unused; the DT_STRING node is skipped
+    # at parse (unimportable dtype) so it appears nowhere.
+    assert set(report["unused"]) == {"Sub/y", "Mul/y", "ResizeBilinear/size"}
+
+
+def test_model_shapes_match_the_documented_2015_shapes(imported):
+    # Strict import already validated every kernel/stat shape against the
+    # model template; spot-check the factorized/parallel kernels landed in
+    # the right flax modules with orientation preserved.
+    consts, _, variables, _ = imported
+    p = variables["params"]
+    np.testing.assert_array_equal(
+        p["Mixed_6c"]["branch7x7_2"]["conv"]["kernel"],
+        consts["mixed_5/tower/conv_1/conv2d_params"],  # (1, 7, 160, 160)
+    )
+    np.testing.assert_array_equal(
+        p["Mixed_7b"]["branch3x3_2b"]["conv"]["kernel"],
+        consts["mixed_9/tower/mixed/conv_1/conv2d_params"],  # (3, 1, 384, 384)
+    )
+    np.testing.assert_array_equal(
+        p["Mixed_7a"]["branch7x7x3_4"]["conv"]["kernel"],
+        consts["mixed_8/tower_1/conv_3/conv2d_params"],  # (3, 3, 192, 192)
+    )
+    assert p["logits"]["kernel"].shape == HEAD_SHAPE
+
+
+def test_end_to_end_apply_and_head_wiring(imported):
+    consts, model, variables, _ = imported
+    x = iv3.preprocess(
+        np.random.default_rng(3).integers(0, 255, (1, 96, 96, 3)).astype(np.uint8)
+    )
+    bottleneck = np.asarray(model.apply(variables, x, return_bottleneck=True))
+    logits = np.asarray(model.apply(variables, x))
+    assert bottleneck.shape == (1, iv3.BOTTLENECK_SIZE)
+    assert logits.shape == (1, iv3.NUM_CLASSES_2015)
+    assert np.all(np.isfinite(bottleneck)) and np.all(np.isfinite(logits))
+    # Hand-check the head: the model's logits must be exactly the imported
+    # softmax layer applied to the bottleneck (retrain1/retrain.py:262-297
+    # trains a replacement for precisely this layer).
+    np.testing.assert_allclose(
+        logits,
+        bottleneck @ consts["softmax/weights"] + consts["softmax/biases"],
+        rtol=1e-4,
+        atol=1e-4,
+    )

@@ -186,19 +186,11 @@ def test_custom_head_skips_softmax(model, template):
 def test_unsupported_dtype_const_skipped():
     """The real 2015 pb carries a DT_STRING Const (DecodeJpeg/contents) —
     non-weight Consts of unimportable dtypes are skipped, never fatal."""
-    tensor = (
-        gd._field(1, 0, 7)  # DT_STRING
-        + gd._field(8, 2, gd._field(1, 2, b"\xff\xd8jpegbytes"))  # string_val
-    )
-    attr = gd._field(1, 2, b"value") + gd._field(2, 2, gd._field(8, 2, tensor))
-    node = (
-        gd._field(1, 2, b"DecodeJpeg/contents")
-        + gd._field(2, 2, b"Const")
-        + gd._field(5, 2, attr)
-    )
-    blob = gd._field(1, 2, node) + gd.serialize_graphdef_consts(
-        {"w": np.ones(2, np.float32)}
-    )
+    from tests.conftest import make_string_const_node
+
+    blob = make_string_const_node(
+        b"DecodeJpeg/contents", b"\xff\xd8jpegbytes"
+    ) + gd.serialize_graphdef_consts({"w": np.ones(2, np.float32)})
     parsed = gd.parse_graphdef_consts(blob)
     assert set(parsed) == {"w"}
 
