@@ -113,6 +113,13 @@ class MnistTrainConfig:
     synthetic_data: bool = field(
         default=False, metadata={"help": "generate deterministic synthetic MNIST if idx files absent"}
     )
+    download_data: bool = field(
+        default=False,
+        metadata={
+            "help": "fetch missing MNIST idx files first (the reference's "
+            "auto-download; needs network egress)"
+        },
+    )
     profile_dir: str = field(
         default="",
         metadata={"help": "if set, write a jax.profiler (TensorBoard XPlane) trace here"},

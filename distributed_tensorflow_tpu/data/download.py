@@ -8,10 +8,13 @@ environment uses).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import sys
 import tarfile
+import tempfile
 import urllib.request
+from typing import Callable
 
 from distributed_tensorflow_tpu.utils.logging import get_logger
 
@@ -27,6 +30,65 @@ def ensure_dir_exists(dir_name: str) -> None:
     os.makedirs(dir_name, exist_ok=True)
 
 
+def download_file(
+    url: str,
+    dest_path: str,
+    progress: bool = True,
+    sha256: str | None = None,
+    validate: Callable[[str], None] | None = None,
+    timeout: float = 60.0,
+) -> bool:
+    """Stream ``url`` into ``dest_path`` atomically; the one download helper
+    shared by the Inception tgz fetch and the MNIST idx fetch.
+
+    Writes to a UNIQUE temp file beside the destination (``tempfile.mkstemp``
+    — a fixed suffix would let two concurrent processes write through each
+    other's fd after the winner's rename), verifies BEFORE the atomic
+    ``os.replace`` (``sha256`` hex digest and/or a ``validate(tmp_path)``
+    callback that raises on bad content), and never leaves a partial or
+    failed file behind to poison later runs' exists-check.
+
+    Returns True when a download happened, False when ``dest_path`` already
+    existed."""
+    if os.path.exists(dest_path):
+        return False
+    dest_dir = os.path.dirname(dest_path) or "."
+    ensure_dir_exists(dest_dir)
+    name = os.path.basename(dest_path)
+    fd, tmp = tempfile.mkstemp(dir=dest_dir, prefix=name + ".", suffix=".part")
+    digest = hashlib.sha256()
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r, os.fdopen(fd, "wb") as f:
+            total = int(r.headers.get("Content-Length") or 0)
+            done = 0
+            while True:
+                chunk = r.read(1 << 16)
+                if not chunk:
+                    break
+                f.write(chunk)
+                digest.update(chunk)
+                done += len(chunk)
+                if progress and total > 0:
+                    pct = min(100.0, done / total * 100.0)
+                    sys.stdout.write(f"\r>> Downloading {name} {pct:.1f}%")
+                    sys.stdout.flush()
+        if progress:
+            sys.stdout.write("\n")
+        if sha256 is not None and digest.hexdigest() != sha256.lower():
+            raise ValueError(
+                f"{name}: sha256 {digest.hexdigest()} != expected {sha256}"
+            )
+        if validate is not None:
+            validate(tmp)
+        os.replace(tmp, dest_path)
+    except Exception:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+    log.info("Successfully downloaded %s %d bytes.", name, os.stat(dest_path).st_size)
+    return True
+
+
 def maybe_download_and_extract(
     dest_directory: str,
     url: str = INCEPTION_2015_URL,
@@ -37,28 +99,7 @@ def maybe_download_and_extract(
     ensure_dir_exists(dest_directory)
     filename = url.split("/")[-1]
     filepath = os.path.join(dest_directory, filename)
-    if not os.path.exists(filepath):
-
-        def _progress(count, block_size, total_size):
-            if not progress or total_size <= 0:
-                return
-            pct = min(100.0, float(count * block_size) / float(total_size) * 100.0)
-            sys.stdout.write(f"\r>> Downloading {filename} {pct:.1f}%")
-            sys.stdout.flush()
-
-        try:
-            filepath, _ = urllib.request.urlretrieve(url, filepath, _progress)
-        except Exception:
-            # Leave no partial archive behind — a corrupt .tgz would poison
-            # every later run's cache-hit check.
-            if os.path.exists(filepath):
-                os.remove(filepath)
-            raise
-        if progress:
-            sys.stdout.write("\n")
-        log.info(
-            "Successfully downloaded %s %d bytes.", filename, os.stat(filepath).st_size
-        )
+    download_file(url, filepath, progress=progress)
     try:
         with tarfile.open(filepath, "r:gz") as tar:
             # Refuse path traversal and link members (a symlink pointing

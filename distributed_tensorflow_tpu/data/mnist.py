@@ -27,6 +27,11 @@ TRAIN_IMAGES = "train-images-idx3-ubyte.gz"
 TRAIN_LABELS = "train-labels-idx1-ubyte.gz"
 TEST_IMAGES = "t10k-images-idx3-ubyte.gz"
 TEST_LABELS = "t10k-labels-idx1-ubyte.gz"
+ALL_FILES = (TRAIN_IMAGES, TRAIN_LABELS, TEST_IMAGES, TEST_LABELS)
+
+# Where the TF tutorial loader the reference imports fetched from
+# (``input_data.read_data_sets`` auto-download, demo1/train.py:6).
+MNIST_BASE_URL = "https://storage.googleapis.com/cvdf-datasets/mnist/"
 
 _IDX_IMAGE_MAGIC = 2051
 _IDX_LABEL_MAGIC = 2049
@@ -68,6 +73,64 @@ def write_idx_labels(path: str, labels_u8: np.ndarray) -> None:
     with gzip.open(path, "wb") as fh:
         fh.write(struct.pack(">II", _IDX_LABEL_MAGIC, labels_u8.shape[0]))
         fh.write(labels_u8.astype(np.uint8).tobytes())
+
+
+def _validate_idx_gz(path: str) -> None:
+    """Structural integrity check of a downloaded idx ``.gz``: gzip framing,
+    idx magic, and exact payload length for the declared dims. This is the
+    offline-verifiable stand-in for a pinned checksum (the canonical hashes
+    cannot be confirmed from this egress-less environment; callers that have
+    them can pass ``checksums=`` to :func:`maybe_download_mnist`)."""
+    with gzip.open(path, "rb") as fh:
+        (magic,) = struct.unpack(">I", fh.read(4))
+        if magic == _IDX_IMAGE_MAGIC:
+            n, rows, cols = struct.unpack(">III", fh.read(12))
+            expect = n * rows * cols
+        elif magic == _IDX_LABEL_MAGIC:
+            (expect,) = struct.unpack(">I", fh.read(4))
+        else:
+            raise ValueError(f"{path}: bad idx magic {magic}")
+        got = 0
+        while True:
+            chunk = fh.read(1 << 20)
+            if not chunk:
+                break
+            got += len(chunk)
+        if got != expect:
+            raise ValueError(f"{path}: idx payload {got} bytes, header says {expect}")
+
+
+def maybe_download_mnist(
+    data_dir: str,
+    base_url: str = MNIST_BASE_URL,
+    progress: bool = True,
+    checksums: dict[str, str] | None = None,
+    timeout: float = 60.0,
+) -> list[str]:
+    """Fetch any missing MNIST idx ``.gz`` into ``data_dir`` — the
+    reference's download-if-absent behavior (``input_data.read_data_sets``,
+    ``demo1/train.py:6``) on the shared hardened fetcher
+    (:func:`data.download.download_file`: unique temp file, verification
+    BEFORE the atomic rename, no partial/corrupt leftovers). Verification =
+    structural idx check (:func:`_validate_idx_gz`) plus ``checksums[name]``
+    = hex sha256 when provided.
+
+    Returns the file names actually fetched (empty when all were present).
+    """
+    from distributed_tensorflow_tpu.data.download import download_file
+
+    fetched: list[str] = []
+    for name in ALL_FILES:
+        if download_file(
+            base_url.rstrip("/") + "/" + name,
+            os.path.join(data_dir, name),
+            progress=progress,
+            sha256=(checksums or {}).get(name),
+            validate=_validate_idx_gz,
+            timeout=timeout,
+        ):
+            fetched.append(name)
+    return fetched
 
 
 def one_hot(labels: np.ndarray, num_classes: int = 10) -> np.ndarray:
@@ -150,13 +213,28 @@ def read_data_sets(
     synthetic: bool = False,
     num_synthetic_train: int = 5000,
     num_synthetic_test: int = 1000,
+    download: bool = False,
+    base_url: str = MNIST_BASE_URL,
 ) -> Datasets:
-    """Load MNIST from idx files in ``data_dir``; if files are absent and
-    ``synthetic`` is set, fall back to the deterministic synthetic dataset
-    (this environment has no egress, so the reference's download path —
-    ``input_data.read_data_sets`` auto-fetch — cannot be replicated)."""
-    paths = {k: os.path.join(data_dir, k) for k in (TRAIN_IMAGES, TRAIN_LABELS, TEST_IMAGES, TEST_LABELS)}
+    """Load MNIST from idx files in ``data_dir``. When files are absent:
+    ``download=True`` first tries :func:`maybe_download_mnist` (the
+    reference's auto-fetch, ``demo1/train.py:6``); then ``synthetic=True``
+    falls back to the deterministic synthetic dataset (the working mode in
+    this egress-less environment). Both unset → a clear error."""
+    paths = {k: os.path.join(data_dir, k) for k in ALL_FILES}
     have_all = all(os.path.exists(p) for p in paths.values())
+    if not have_all and download:
+        try:
+            maybe_download_mnist(data_dir, base_url=base_url)
+            have_all = True
+        except Exception as e:
+            if not synthetic:
+                raise
+            from distributed_tensorflow_tpu.utils.logging import get_logger
+
+            get_logger(__name__).warning(
+                "MNIST download failed (%s); using the synthetic fallback.", e
+            )
     if have_all:
         train_x = read_idx_images(paths[TRAIN_IMAGES])
         train_y = read_idx_labels(paths[TRAIN_LABELS])

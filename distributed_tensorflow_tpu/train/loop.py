@@ -66,7 +66,11 @@ class MnistTrainer:
         self.mesh = mesh if mesh is not None else make_mesh(num_devices=1)
         self.model = model if model is not None else build_model(cfg)
         self.datasets = datasets or read_data_sets(
-            cfg.data_dir, one_hot=True, seed=cfg.seed, synthetic=cfg.synthetic_data
+            cfg.data_dir,
+            one_hot=True,
+            seed=cfg.seed,
+            synthetic=cfg.synthetic_data,
+            download=getattr(cfg, "download_data", False),
         )
         self.is_chief = is_chief
         self.eval_chunk = eval_chunk

