@@ -29,6 +29,9 @@ def main(argv=None):
     parser.add_argument("--imgs_dir", default="imgs/")
     parser.add_argument("--show", action="store_true", help="display each image")
     args, _ = parser.parse_known_args(argv)
+    from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
+
+    args.imgs_dir = resolve_bundled_dir(args.imgs_dir, __file__, "imgs", default="imgs/")
     from distributed_tensorflow_tpu.utils.compile_cache import (
         enable_compilation_cache,
     )

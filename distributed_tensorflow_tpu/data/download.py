@@ -58,20 +58,22 @@ def download_file(
     fd, tmp = tempfile.mkstemp(dir=dest_dir, prefix=name + ".", suffix=".part")
     digest = hashlib.sha256()
     try:
-        with urllib.request.urlopen(url, timeout=timeout) as r, os.fdopen(fd, "wb") as f:
-            total = int(r.headers.get("Content-Length") or 0)
-            done = 0
-            while True:
-                chunk = r.read(1 << 16)
-                if not chunk:
-                    break
-                f.write(chunk)
-                digest.update(chunk)
-                done += len(chunk)
-                if progress and total > 0:
-                    pct = min(100.0, done / total * 100.0)
-                    sys.stdout.write(f"\r>> Downloading {name} {pct:.1f}%")
-                    sys.stdout.flush()
+        # Wrap the fd FIRST: urlopen raising before os.fdopen would leak it.
+        with os.fdopen(fd, "wb") as f:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                total = int(r.headers.get("Content-Length") or 0)
+                done = 0
+                while True:
+                    chunk = r.read(1 << 16)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    digest.update(chunk)
+                    done += len(chunk)
+                    if progress and total > 0:
+                        pct = min(100.0, done / total * 100.0)
+                        sys.stdout.write(f"\r>> Downloading {name} {pct:.1f}%")
+                        sys.stdout.flush()
         if progress:
             sys.stdout.write("\n")
         if sha256 is not None and digest.hexdigest() != sha256.lower():
@@ -80,6 +82,11 @@ def download_file(
             )
         if validate is not None:
             validate(tmp)
+        # mkstemp creates mode 0600; give the dataset umask-default perms
+        # like the old urlretrieve path did (shared data_dir readability).
+        umask = os.umask(0)
+        os.umask(umask)
+        os.chmod(tmp, 0o666 & ~umask)
         os.replace(tmp, dest_path)
     except Exception:
         if os.path.exists(tmp):

@@ -70,7 +70,7 @@ class MnistTrainer:
             one_hot=True,
             seed=cfg.seed,
             synthetic=cfg.synthetic_data,
-            download=getattr(cfg, "download_data", False),
+            download=cfg.download_data,
         )
         self.is_chief = is_chief
         self.eval_chunk = eval_chunk

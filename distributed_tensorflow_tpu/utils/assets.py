@@ -1,0 +1,37 @@
+"""Bundled-asset path resolution for the demo/retrain CLIs (C19 parity).
+
+The reference's test CLIs hardcode relative ``imgs/`` and assume they are
+run from the script's own directory (``demo1/test.py:187``); its sample
+images ship in-repo so the CLIs run bare. Ours ship generated equivalents
+(``tools/make_sample_assets.py``) — this helper lets a zero-arg run find
+them from ANY working directory, while an explicit or existing path always
+wins.
+"""
+
+from __future__ import annotations
+
+import os
+
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def resolve_bundled_dir(
+    path: str, script_file: str, bundled_name: str, default: str | None = None
+) -> str:
+    """Return ``path`` if it exists. The bundled fallback fires ONLY for the
+    CLI's untouched default (``path == default``, or no default given): an
+    explicitly passed path that is missing must surface as the caller's
+    missing-dir error, never be silently redirected to sample data."""
+    if os.path.isdir(path):
+        return path
+    if default is not None and path != default:
+        return path
+    bundled = os.path.join(
+        os.path.dirname(os.path.abspath(script_file)), bundled_name
+    )
+    if os.path.isdir(bundled):
+        log.info("%s not found; using bundled sample assets %s", path, bundled)
+        return bundled
+    return path

@@ -28,6 +28,9 @@ def main(argv=None):
     log = get_logger("retrain1")
     clock = WallClock()
     cfg = parse_flags(RetrainConfig, argv=argv)
+    from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
+
+    cfg.image_dir = resolve_bundled_dir(cfg.image_dir, __file__, "sample_images", default="./data")
     trainer = RetrainTrainer(cfg, mesh=make_mesh(num_devices=1))
     stats = trainer.train()
     log.info("Total time: %.2fs", clock.elapsed)

@@ -34,6 +34,9 @@ def main(argv=None):
     parser.add_argument("--model_dir", default="./inception_model")
     parser.add_argument("--show", action="store_true")
     args, _ = parser.parse_known_args(argv)
+    from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
+
+    args.imgs_dir = resolve_bundled_dir(args.imgs_dir, __file__, "imgs", default="imgs/")
     from distributed_tensorflow_tpu.utils.compile_cache import (
         enable_compilation_cache,
     )

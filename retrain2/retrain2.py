@@ -31,6 +31,9 @@ def main(argv=None):
     log = get_logger("retrain2")
     clock = WallClock()
     cfg, cluster = parse_flags(DistributedRetrainConfig, ClusterConfig, argv=argv)
+    from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
+
+    cfg.image_dir = resolve_bundled_dir(cfg.image_dir, __file__, "sample_images", default="./data")
     if not distributed.initialize_from_cluster(cluster):
         return None  # ps role: nothing to do on TPU
     mesh = make_mesh()

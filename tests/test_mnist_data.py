@@ -124,7 +124,7 @@ def test_download_validates_and_leaves_no_partial(idx_dir, tmp_path):
     with pytest.raises(ValueError, match="bad idx magic"):
         M.maybe_download_mnist(str(dest), base_url=src.as_uri(), progress=False)
     assert not (dest / M.TRAIN_IMAGES).exists()
-    assert not (dest / (M.TRAIN_IMAGES + ".part")).exists()
+    assert not list(dest.glob("*.part"))  # no mkstemp leftovers either
 
 
 def test_download_checksum_mismatch_rejected(idx_dir, tmp_path):

@@ -1,0 +1,60 @@
+"""Bundled sample assets (C19): committed files exist, decode, and the
+CLIs' script-relative fallback finds them from any working directory."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("demo", ["demo1", "demo2"])
+def test_digit_samples_bundled(demo):
+    d = os.path.join(_REPO, demo, "imgs")
+    names = sorted(os.listdir(d))
+    assert names == [f"test{i}.jpg" for i in range(1, 7)]  # reference file set
+    for n in names:
+        a = np.asarray(Image.open(os.path.join(d, n)).convert("L"))
+        assert a.shape[0] >= 28 and a.shape[1] >= 28
+        dark = (a < 100).mean()
+        # A digit on a white canvas: some dark ink, mostly background.
+        assert 0.02 < dark < 0.5, (n, dark)
+
+
+@pytest.mark.parametrize("retrain", ["retrain1", "retrain2"])
+def test_retrain_samples_bundled(retrain):
+    imgs = os.path.join(_REPO, retrain, "imgs")
+    assert sorted(os.listdir(imgs)) == ["01.jpg", "02.jpg", "03.jpg", "04.jpg"]
+    sample = os.path.join(_REPO, retrain, "sample_images")
+    for cls in ("red", "green"):
+        files = os.listdir(os.path.join(sample, cls))
+        # Above the reference's <20-images-per-class warning threshold
+        # (retrain1/retrain.py:101-102).
+        assert len(files) >= 20
+        a = np.asarray(Image.open(os.path.join(sample, cls, sorted(files)[0])))
+        ch = {"red": 0, "green": 1}[cls]
+        assert a[..., ch].mean() > 100  # the class channel dominates
+
+
+def test_resolve_bundled_dir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # fresh cwd: no imgs/ here
+    script = os.path.join(_REPO, "demo1", "test.py")
+    assert resolve_bundled_dir("imgs/", script, "imgs", default="imgs/") == os.path.join(
+        _REPO, "demo1", "imgs"
+    )
+    # An existing path always wins.
+    (tmp_path / "imgs").mkdir()
+    assert resolve_bundled_dir("imgs", script, "imgs", default="imgs") == "imgs"
+    # An EXPLICIT missing path (!= default) must NOT be redirected to sample
+    # data — the caller's missing-dir error has to fire (a typo'd
+    # --image_dir silently training on bundled toys would be a trap).
+    assert (
+        resolve_bundled_dir("/data/flowerz", script, "imgs", default="imgs/")
+        == "/data/flowerz"
+    )
+    # Nothing bundled under that name -> path returned unchanged.
+    assert resolve_bundled_dir("nope", script, "no_such_assets", default="nope") == "nope"

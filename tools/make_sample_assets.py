@@ -1,0 +1,111 @@
+"""Generate the bundled sample images (C19 parity).
+
+The reference ships hand-made sample inputs so its manual test CLIs run
+bare: digit photos ``demo*/imgs/test1-6.jpg`` (``demo1/test.py:187-197``)
+and eval JPEGs ``retrain*/imgs/0*.jpg`` (``retrain1/test.py:44-58``). This
+environment has no egress and no photos, so the committed equivalents are
+generated deterministically by this script:
+
+  * ``demo1/imgs`` & ``demo2/imgs`` — ``test1.jpg..test6.jpg``: dark
+    seven-segment-style digits 1-6 on a white canvas with light noise, the
+    input style ``imageprepare`` expects (grayscale, invert-normalize).
+  * ``retrain1/imgs`` & ``retrain2/imgs`` — ``01.jpg..04.jpg``: red/green
+    sample images matching the bundled ``sample_images`` classes.
+  * ``retrain1/sample_images`` & ``retrain2/sample_images`` — a tiny
+    ``red``/``green`` two-class training folder (25 images each, above the
+    <20-per-class warning threshold, ``retrain1/retrain.py:101-102``) so the
+    retrain CLIs can run end to end with zero user data.
+
+Rerun ``python tools/make_sample_assets.py`` to regenerate everything
+byte-identically (fixed seed, quality-95 JPEG).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+from PIL import Image
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Seven-segment layout: segments a-g as (x0, y0, x1, y1) in a 60x100 cell.
+_SEGS = {
+    "a": (10, 5, 50, 15),
+    "b": (45, 10, 55, 50),
+    "c": (45, 50, 55, 90),
+    "d": (10, 85, 50, 95),
+    "e": (5, 50, 15, 90),
+    "f": (5, 10, 15, 50),
+    "g": (10, 45, 50, 55),
+}
+_DIGIT_SEGS = {
+    0: "abcdef",
+    1: "bc",
+    2: "abged",
+    3: "abgcd",
+    4: "fgbc",
+    5: "afgcd",
+    6: "afgedc",
+    7: "abc",
+    8: "abcdefg",
+    9: "abcfgd",
+}
+
+
+def digit_image(digit: int, rng: np.random.Generator, size: int = 100) -> Image.Image:
+    """A dark digit on a white canvas (what the PIL ``imageprepare``
+    pipeline inverts, ``demo1/test.py:12-42``)."""
+    canvas = np.full((100, 60), 255, np.uint8)
+    for seg in _DIGIT_SEGS[digit]:
+        x0, y0, x1, y1 = _SEGS[seg]
+        canvas[y0:y1, x0:x1] = rng.integers(0, 60)
+    img = Image.fromarray(canvas, "L").convert("RGB")
+    img = img.rotate(float(rng.uniform(-8, 8)), expand=True, fillcolor=(255, 255, 255))
+    out = Image.new("RGB", (size, size), (255, 255, 255))
+    img.thumbnail((size - 20, size - 20))
+    out.paste(img, ((size - img.width) // 2, (size - img.height) // 2))
+    arr = np.asarray(out).astype(np.int16)
+    arr += rng.integers(-8, 8, arr.shape, dtype=np.int16)
+    return Image.fromarray(np.clip(arr, 0, 255).astype(np.uint8))
+
+
+def class_image(cls: str, rng: np.random.Generator, size: int = 80) -> Image.Image:
+    a = rng.integers(0, 50, (size, size, 3)).astype(np.uint8)
+    ch = {"red": 0, "green": 1}[cls]
+    a[..., ch] = rng.integers(140, 255, (size, size)).astype(np.uint8)
+    return Image.fromarray(a)
+
+
+def main() -> None:
+    rng = np.random.default_rng(19)
+
+    for demo in ("demo1", "demo2"):
+        d = os.path.join(_REPO, demo, "imgs")
+        os.makedirs(d, exist_ok=True)
+        for digit in range(1, 7):  # the reference's test1.jpg..test6.jpg
+            digit_image(digit, rng).save(
+                os.path.join(d, f"test{digit}.jpg"), quality=95
+            )
+        print(f"{d}: test1.jpg..test6.jpg")
+
+    for retrain in ("retrain1", "retrain2"):
+        d = os.path.join(_REPO, retrain, "imgs")
+        os.makedirs(d, exist_ok=True)
+        for i, cls in enumerate(("red", "green", "red", "green"), start=1):
+            class_image(cls, rng).save(os.path.join(d, f"0{i}.jpg"), quality=95)
+        print(f"{d}: 01.jpg..04.jpg")
+
+        for cls in ("red", "green"):
+            cd = os.path.join(_REPO, retrain, "sample_images", cls)
+            os.makedirs(cd, exist_ok=True)
+            for i in range(25):
+                class_image(cls, rng).save(
+                    os.path.join(cd, f"{cls}{i:02d}.jpg"), quality=95
+                )
+        print(f"{os.path.join(_REPO, retrain, 'sample_images')}: red/ green/ x25")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
