@@ -31,7 +31,9 @@ def main(argv=None):
     args, _ = parser.parse_known_args(argv)
     from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
 
-    args.imgs_dir = resolve_bundled_dir(args.imgs_dir, __file__, "imgs", default="imgs/")
+    args.imgs_dir = resolve_bundled_dir(
+        args.imgs_dir, __file__, "imgs", default=parser.get_default("imgs_dir")
+    )
     from distributed_tensorflow_tpu.utils.compile_cache import (
         enable_compilation_cache,
     )

@@ -48,15 +48,13 @@ def classify_digit_images(predict_fn, imgs_dir: str, show: bool = False) -> dict
     byte-identically across ``demo1/test.py`` and ``demo2/test.py``).
     Non-image files are skipped instead of crashing the walk."""
     results: dict[str, int] = {}
-    found_any = False
     for path in iter_image_files(imgs_dir):
-        found_any = True
         digit = int(predict_fn(imageprepare(path)[None, :]))
         results[path] = digit
         print(f"{path}: the predicted digit is {digit}")
         if show:
             show_image(path, f"predicted: {digit}")
-    if not found_any:
+    if not results:
         print(f"no images found under {imgs_dir}")
     return results
 

@@ -82,11 +82,10 @@ def download_file(
             )
         if validate is not None:
             validate(tmp)
-        # mkstemp creates mode 0600; give the dataset umask-default perms
-        # like the old urlretrieve path did (shared data_dir readability).
-        umask = os.umask(0)
-        os.umask(umask)
-        os.chmod(tmp, 0o666 & ~umask)
+        # mkstemp creates mode 0600; fix to plain 0644 for shared data_dir
+        # readability (probing the umask would mutate process-global state
+        # and race other threads' file creation).
+        os.chmod(tmp, 0o644)
         os.replace(tmp, dest_path)
     except Exception:
         if os.path.exists(tmp):

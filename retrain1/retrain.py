@@ -30,7 +30,14 @@ def main(argv=None):
     cfg = parse_flags(RetrainConfig, argv=argv)
     from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
 
-    cfg.image_dir = resolve_bundled_dir(cfg.image_dir, __file__, "sample_images", default="./data")
+    from dataclasses import fields as _fields
+
+    _image_dir_default = next(
+        f.default for f in _fields(type(cfg)) if f.name == "image_dir"
+    )
+    cfg.image_dir = resolve_bundled_dir(
+        cfg.image_dir, __file__, "sample_images", default=_image_dir_default
+    )
     trainer = RetrainTrainer(cfg, mesh=make_mesh(num_devices=1))
     stats = trainer.train()
     log.info("Total time: %.2fs", clock.elapsed)

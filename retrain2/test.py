@@ -15,7 +15,21 @@ _spec = importlib.util.spec_from_file_location(
 )
 _mod = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(_mod)
-main = _mod.main
+
+
+def main(argv=None):
+    """Delegate to retrain1's CLI, but anchor the zero-arg bundled-imgs
+    fallback on THIS directory (the delegate's own fallback would resolve
+    against retrain1/imgs, since ``__file__`` there is retrain1/test.py)."""
+    from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
+
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if not any(a == "--imgs_dir" or a.startswith("--imgs_dir=") for a in argv):
+        resolved = resolve_bundled_dir("imgs/", __file__, "imgs", default="imgs/")
+        if resolved != "imgs/":
+            argv += ["--imgs_dir", resolved]
+    return _mod.main(argv)
+
 
 if __name__ == "__main__":
     main()
