@@ -30,6 +30,12 @@ def ensure_dir_exists(dir_name: str) -> None:
     os.makedirs(dir_name, exist_ok=True)
 
 
+# Probed ONCE at import (single-threaded): per-call probing would mutate
+# process-global state and race other threads' file creation.
+_UMASK = os.umask(0)
+os.umask(_UMASK)
+
+
 def download_file(
     url: str,
     dest_path: str,
@@ -82,10 +88,10 @@ def download_file(
             )
         if validate is not None:
             validate(tmp)
-        # mkstemp creates mode 0600; fix to plain 0644 for shared data_dir
-        # readability (probing the umask would mutate process-global state
-        # and race other threads' file creation).
-        os.chmod(tmp, 0o644)
+        # mkstemp creates mode 0600; restore umask-default permissions (what
+        # the pre-mkstemp urlretrieve path produced) so a restrictive umask
+        # is honored and a permissive one still shares the data_dir.
+        os.chmod(tmp, 0o666 & ~_UMASK)
         os.replace(tmp, dest_path)
     except Exception:
         if os.path.exists(tmp):

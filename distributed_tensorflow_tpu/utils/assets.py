@@ -17,6 +17,20 @@ from distributed_tensorflow_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
+def dataclass_default(cls, name: str):
+    """The declared default of dataclass field ``name`` — used by CLIs to
+    tell 'flag left at its default' from 'explicitly passed' without
+    duplicating the literal. Raises on default_factory fields (their
+    ``f.default`` is the MISSING sentinel, which must not leak out as a
+    comparison value)."""
+    import dataclasses
+
+    f = next(f for f in dataclasses.fields(cls) if f.name == name)
+    if f.default is dataclasses.MISSING:
+        raise ValueError(f"{cls.__name__}.{name} has no plain default")
+    return f.default
+
+
 def resolve_bundled_dir(
     path: str, script_file: str, bundled_name: str, default: str | None = None
 ) -> str:

@@ -28,15 +28,16 @@ def main(argv=None):
     log = get_logger("retrain1")
     clock = WallClock()
     cfg = parse_flags(RetrainConfig, argv=argv)
-    from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
-
-    from dataclasses import fields as _fields
-
-    _image_dir_default = next(
-        f.default for f in _fields(type(cfg)) if f.name == "image_dir"
+    from distributed_tensorflow_tpu.utils.assets import (
+        dataclass_default,
+        resolve_bundled_dir,
     )
+
     cfg.image_dir = resolve_bundled_dir(
-        cfg.image_dir, __file__, "sample_images", default=_image_dir_default
+        cfg.image_dir,
+        __file__,
+        "sample_images",
+        default=dataclass_default(type(cfg), "image_dir"),
     )
     trainer = RetrainTrainer(cfg, mesh=make_mesh(num_devices=1))
     stats = trainer.train()

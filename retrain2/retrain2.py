@@ -31,15 +31,16 @@ def main(argv=None):
     log = get_logger("retrain2")
     clock = WallClock()
     cfg, cluster = parse_flags(DistributedRetrainConfig, ClusterConfig, argv=argv)
-    from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
-
-    from dataclasses import fields as _fields
-
-    _image_dir_default = next(
-        f.default for f in _fields(type(cfg)) if f.name == "image_dir"
+    from distributed_tensorflow_tpu.utils.assets import (
+        dataclass_default,
+        resolve_bundled_dir,
     )
+
     cfg.image_dir = resolve_bundled_dir(
-        cfg.image_dir, __file__, "sample_images", default=_image_dir_default
+        cfg.image_dir,
+        __file__,
+        "sample_images",
+        default=dataclass_default(type(cfg), "image_dir"),
     )
     if not distributed.initialize_from_cluster(cluster):
         return None  # ps role: nothing to do on TPU

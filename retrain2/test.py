@@ -24,10 +24,11 @@ def main(argv=None):
     from distributed_tensorflow_tpu.utils.assets import resolve_bundled_dir
 
     argv = list(sys.argv[1:]) if argv is None else list(argv)
-    if not any(a == "--imgs_dir" or a.startswith("--imgs_dir=") for a in argv):
-        resolved = resolve_bundled_dir("imgs/", __file__, "imgs", default="imgs/")
-        if resolved != "imgs/":
-            argv += ["--imgs_dir", resolved]
+    resolved = resolve_bundled_dir("imgs/", __file__, "imgs", default="imgs/")
+    if resolved != "imgs/":
+        # PREPEND so any user-passed --imgs_dir (including argparse prefix
+        # abbreviations like --imgs) comes later and wins last-occurrence.
+        argv = ["--imgs_dir", resolved] + argv
     return _mod.main(argv)
 
 

@@ -45,10 +45,14 @@ def collective_counts(compiled) -> dict[str, int]:
     """Instruction-definition counts per collective op in optimized HLO
     (tuple-typed results mean the type can contain spaces, so match the
     op name right before its operand parenthesis; operand mentions like
-    ``get-tuple-element(%all-reduce)`` don't match)."""
+    ``get-tuple-element(%all-reduce)`` don't match). ``ROOT``-form
+    definitions count too — async-wrapped collectives sit as the ROOT of
+    their wrapped computation."""
     txt = compiled.as_text()
     return {
-        op: len(re.findall(rf"^\s*%\S+ = .*? {op}(?:-start)?\(", txt, re.M))
+        op: len(
+            re.findall(rf"^\s*(?:ROOT )?%?\S+ = .*? {op}(?:-start)?\(", txt, re.M)
+        )
         for op in _COLLECTIVES
     }
 

@@ -1,0 +1,201 @@
+"""Regenerate BASELINE.md's measured tables (VERDICT r1 #9).
+
+Round 1 measured these by hand and recorded them as prose; this tool
+re-measures them on the attached chip and emits each row as a JSON line
+plus a ready-to-paste markdown table, so every table in BASELINE.md
+"Measured" sections is reproducible with one command per round:
+
+    python tools/bench_tables.py --table dispatch_modes
+    python tools/bench_tables.py --table long_context
+    python tools/bench_tables.py --table retrain
+
+(The flash-kernel and LM-MFU tables are re-measured by ``bench.py`` itself
+every round — this tool covers the remaining three.)
+
+All timings use the device_get completion barrier (block_until_ready is
+not trusted through the axon tunnel — bench.py module docstring).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _emit(rows: list[dict], columns: list[str]) -> None:
+    for r in rows:
+        print(json.dumps(r))
+    print()
+    print("| " + " | ".join(columns) + " |")
+    print("|" + "---|" * len(columns))
+    for r in rows:
+        print("| " + " | ".join(str(r[c]) for c in columns) + " |")
+
+
+def table_dispatch_modes(args) -> None:
+    """MNIST convnet steps/s/chip per input/dispatch mode (the BASELINE.md
+    'Input/dispatch mode' table): host-batch unfused, host-batch fused,
+    device pool fused x100 and x1000. Each mode runs bench.py headline in a
+    subprocess so the chip is owned by exactly one JAX client at a time."""
+    import subprocess
+
+    rows = []
+    for mode, k, steps in (
+        ("host", 1, 200),
+        ("host", 100, 2000),
+        ("pool", 100, 2000),
+        ("pool", 1000, 3000),
+    ):
+        env = dict(
+            BENCH_SUITE="headline",
+            BENCH_MODE=mode,
+            BENCH_STEPS_PER_CALL=str(k),
+            BENCH_TIMED_STEPS=str(steps),
+            BENCH_WARMUP_STEPS=str(min(k, 100)),
+        )
+        proc = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(__file__), "..", "bench.py")],
+            env={**os.environ, **env},
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr[-1500:])
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        rows.append(
+            {
+                "mode": f"{mode} x{k}/dispatch",
+                "steps_per_sec_per_chip": rec["value"],
+            }
+        )
+    _emit(rows, ["mode", "steps_per_sec_per_chip"])
+
+
+def table_long_context(args) -> None:
+    """TransformerLM long-context envelope (BASELINE.md: d_model 256, 8
+    heads, 4 layers, d_ff 1024, batch 1, flash+remat) at 16k/32k/64k."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from distributed_tensorflow_tpu.parallel import data_parallel as dp
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+
+    enable_compilation_cache()
+    mesh = make_mesh()
+    rows = []
+    for seq in (16384, 32768, 65536):
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=256, num_heads=8, num_layers=4, d_ff=1024,
+            max_seq_len=seq, attention="flash", remat=True,
+            compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32,
+        )
+        tx = optax.adam(1e-4)
+        host = jax.device_get(
+            TransformerLM(cfg).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+        )
+        p = dp.replicate(host, mesh)
+        o = dp.replicate(jax.device_get(tx.init(host)), mesh)
+        g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+        step = dp.build_lm_train_step(cfg, tx, mesh, donate=False)
+        toks = dp.shard_global_batch(
+            {"x": np.random.default_rng(0).integers(0, 256, (1, seq)).astype(np.int32)},
+            mesh,
+        )["x"]
+        key = jax.random.PRNGKey(0)
+        p, o, g, _m = step(p, o, g, toks, key)  # compile + warm
+        base = int(jax.device_get(g))
+        t0 = time.perf_counter()
+        while True:  # ~args.seconds of timed steps, 3 dispatches per drain
+            for _ in range(3):
+                p, o, g, _m = step(p, o, g, toks, key)
+            done = int(jax.device_get(g)) - base
+            if time.perf_counter() - t0 >= args.seconds:
+                break
+        dt = (time.perf_counter() - t0) / done
+        rows.append(
+            {
+                "context": seq,
+                "steps_per_sec": round(1.0 / dt, 2),
+                "tokens_per_sec": round(seq / dt, 0),
+            }
+        )
+    _emit(rows, ["context", "steps_per_sec", "tokens_per_sec"])
+
+
+def table_retrain(args) -> None:
+    """retrain1 end-to-end wall-clock on the bundled sample_images, 100 head
+    steps (the BASELINE.md retrain table). Two runs in one temp dir: the
+    first pays bottleneck caching (cold), the second reuses it (warm); the
+    XLA compile cache is whatever this machine already has, as in r1."""
+    import subprocess
+    import tempfile
+
+    repo = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    rows = []
+    with tempfile.TemporaryDirectory() as tmp:
+        for run in ("cold-bottlenecks", "warm-bottlenecks"):
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(repo, "retrain1", "retrain.py"),
+                    "--training_steps", "100",
+                    "--bottleneck_dir", os.path.join(tmp, "bn"),
+                    "--summaries_dir", os.path.join(tmp, "sum"),
+                    "--output_graph", os.path.join(tmp, "g.msgpack"),
+                    "--output_labels", os.path.join(tmp, "l.txt"),
+                ],
+                capture_output=True,
+                text=True,
+                timeout=900,
+                cwd=tmp,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(proc.stderr[-1500:])
+            rows.append(
+                {
+                    "configuration": run,
+                    "total_wall_clock_s": round(time.perf_counter() - t0, 1),
+                }
+            )
+    _emit(rows, ["configuration", "total_wall_clock_s"])
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--table",
+        required=True,
+        choices=("dispatch_modes", "long_context", "retrain"),
+    )
+    parser.add_argument(
+        "--seconds", type=float, default=10.0,
+        help="approximate timing budget per long-context row",
+    )
+    args = parser.parse_args(argv)
+    {
+        "dispatch_modes": table_dispatch_modes,
+        "long_context": table_long_context,
+        "retrain": table_retrain,
+    }[args.table](args)
+
+
+if __name__ == "__main__":
+    main()
