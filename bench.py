@@ -22,6 +22,9 @@ dispatch amortization):
   * ``mnist_synthetic_test_accuracy`` — full-test-set accuracy after 2k
     steps on the synthetic MNIST task (the real idx files need egress;
     data/mnist.py). North star (BASELINE.md): >= 99% on real MNIST.
+  * ``retrain_e2e_test_accuracy`` — the full retrain pipeline (SHA-1
+    split, bottleneck cache, linear head) on the grating task via fixed
+    random-conv features; >= 0.9 north-star evidence.
   * ``vit_e2e_test_accuracy`` — tools/train_image_classifier.py end to end
     on a generated orientation task (horizontal vs vertical gratings —
     NOT linearly separable in pixel space, unlike round 1's color blobs).
@@ -403,30 +406,61 @@ def bench_mnist_accuracy() -> list[dict]:
     ]
 
 
-def _grating_dataset(root: str, per_class: int = 40, size: int = 64) -> None:
-    """Horizontal- vs vertical-grating image folders: random frequency,
-    phase, colors, and noise — same first-order pixel statistics both
-    classes, so unlike round 1's color blobs this is NOT separable by a
-    linear model on raw pixels (a mean-pixel classifier is at chance)."""
-    import numpy as np
-    from PIL import Image
+def bench_retrain_accuracy() -> list[dict]:
+    """The retrain pipeline end to end (SHA-1 split, bottleneck cache,
+    linear head) on the grating task via the generic random-conv features
+    (``data/gratings.py``) — the >= 0.9 north-star evidence the r1 bench
+    lacked."""
+    import logging
+    import tempfile
 
-    rng = np.random.default_rng(0)
-    for cls, axis in (("horizontal", 0), ("vertical", 1)):
-        d = os.path.join(root, cls)
-        os.makedirs(d, exist_ok=True)
-        for i in range(per_class):
-            freq = rng.uniform(2, 6)
-            phase = rng.uniform(0, 2 * np.pi)
-            t = np.linspace(0, 2 * np.pi * freq, size)
-            wave = 0.5 + 0.5 * np.sin(t + phase)  # (S,) in [0,1]
-            img = wave[:, None] if axis == 0 else wave[None, :]
-            img = np.broadcast_to(img, (size, size))[..., None]
-            lo, hi = rng.uniform(0, 80, 3), rng.uniform(150, 255, 3)
-            a = lo + img * (hi - lo) + rng.normal(0, 12, (size, size, 3))
-            Image.fromarray(np.clip(a, 0, 255).astype(np.uint8)).save(
-                os.path.join(d, f"{cls}{i}.jpg")
-            )
+    from distributed_tensorflow_tpu.config import RetrainConfig
+    from distributed_tensorflow_tpu.data.gratings import (
+        RandomConvExtractor,
+        grating_dataset,
+    )
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+    from distributed_tensorflow_tpu.train.retrain_loop import RetrainTrainer
+
+    steps = 100 if SMOKE else 300
+    with tempfile.TemporaryDirectory() as tmp:
+        data = os.path.join(tmp, "gratings")
+        grating_dataset(data, per_class=40, size=64)
+        cfg = RetrainConfig(
+            image_dir=data,
+            bottleneck_dir=os.path.join(tmp, "bn"),
+            summaries_dir=os.path.join(tmp, "sum"),
+            output_graph=os.path.join(tmp, "g.msgpack"),
+            output_labels=os.path.join(tmp, "l.txt"),
+            training_steps=steps,
+            learning_rate=0.1,
+            train_batch_size=32,
+            validation_batch_size=16,
+            eval_step_interval=steps,
+            testing_percentage=20,
+            validation_percentage=15,
+            seed=0,
+        )
+        trainer = RetrainTrainer(
+            cfg, mesh=make_mesh(num_devices=1), extractor=RandomConvExtractor()
+        )
+        # The repo's loggers write to stdout and this process's contract
+        # is ONE stdout line (the driver parses it) — silence ALL levels.
+        logging.disable(logging.CRITICAL)
+        try:
+            stats = trainer.train()
+        finally:
+            logging.disable(logging.NOTSET)
+    return [
+        {
+            "metric": "retrain_e2e_test_accuracy",
+            "value": round(float(stats["test_accuracy"]), 4),
+            "unit": "accuracy",
+            "detail": f"linear head on generic random-conv features, grating "
+            f"task (not separable in pixel stats), {steps} steps; >= 0.9 "
+            "north star (BASELINE.md)",
+        }
+    ]
 
 
 def bench_vit_accuracy() -> list[dict]:
@@ -440,7 +474,9 @@ def bench_vit_accuracy() -> list[dict]:
     steps = 60 if SMOKE else 300
     with tempfile.TemporaryDirectory() as tmp:
         data = os.path.join(tmp, "data")
-        _grating_dataset(data)
+        from distributed_tensorflow_tpu.data.gratings import grating_dataset
+
+        grating_dataset(data, size=64)
         # The CLI prints its own JSON progress lines; swallow them so this
         # process emits exactly ONE line (the driver's contract).
         with contextlib.redirect_stdout(io.StringIO()):
@@ -479,7 +515,13 @@ def main() -> None:
     headline = bench_mnist_throughput()[0]
     extra: list[dict] = []
     if SUITE == "full":
-        for fn in (bench_lm_mfu, bench_flash_kernel, bench_mnist_accuracy, bench_vit_accuracy):
+        for fn in (
+            bench_lm_mfu,
+            bench_flash_kernel,
+            bench_mnist_accuracy,
+            bench_retrain_accuracy,
+            bench_vit_accuracy,
+        ):
             try:
                 extra.extend(fn())
             except Exception as e:  # one broken bench must not hide the rest

@@ -1,0 +1,72 @@
+"""Grating-orientation fixture dataset + generic random-conv features.
+
+The offline accuracy-evidence pair used by the bench harness and the
+retrain tests (this environment cannot fetch real MNIST/Inception):
+
+  * :func:`grating_dataset` — horizontal- vs vertical-grating image
+    folders with matched per-class pixel statistics (random frequency,
+    phase, colors, noise), so unlike a color-blob task a linear model on
+    raw pixels is at chance — orientation is carried by spatial structure.
+  * :class:`RandomConvExtractor` — a fixed-seed random 5x5 conv bank whose
+    bottleneck (per-filter response-energy stats tiled to 2048) makes the
+    grating classes linearly separable: the stand-in for transfer from
+    generic pretrained features (the real 2015 Inception weights need
+    egress; a random-init DEEP Inception's globally-pooled features are
+    measured uninformative here — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def grating_dataset(root: str, per_class: int = 40, size: int = 64) -> None:
+    """Write ``root/horizontal`` and ``root/vertical`` JPEG folders."""
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for cls, axis in (("horizontal", 0), ("vertical", 1)):
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            freq = rng.uniform(2, 6)
+            phase = rng.uniform(0, 2 * np.pi)
+            t = np.linspace(0, 2 * np.pi * freq, size)
+            wave = 0.5 + 0.5 * np.sin(t + phase)  # (S,) in [0, 1]
+            img = wave[:, None] if axis == 0 else wave[None, :]
+            img = np.broadcast_to(img, (size, size))[..., None]
+            lo, hi = rng.uniform(0, 80, 3), rng.uniform(150, 255, 3)
+            a = lo + img * (hi - lo) + rng.normal(0, 12, (size, size, 3))
+            Image.fromarray(np.clip(a, 0, 255).astype(np.uint8)).save(
+                os.path.join(d, f"{cls}{i}.jpg")
+            )
+
+
+class RandomConvExtractor:
+    """Bottleneck extractor drop-in for the retrain pipeline (same duck
+    interface as the Inception extractor: ``image_size``, ``bottlenecks``,
+    ``bottleneck_for_path``)."""
+
+    image_size = 32
+
+    def __init__(self):
+        rng = np.random.default_rng(7)
+        self.k = (rng.standard_normal((32, 5, 5)) * 0.3).astype(np.float32)
+
+    def bottlenecks(self, imgs):
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.asarray(np.asarray(imgs, np.float32).mean(-1) / 255.0)[:, None]
+        k = jnp.asarray(self.k)[:, None]  # (32, 1, 5, 5) OIHW
+        r = jax.lax.conv_general_dilated(x, k, (1, 1), "VALID")  # (B, 32, h, w)
+        feats = jnp.concatenate([jnp.abs(r).mean((2, 3)), r.std((2, 3))], -1)
+        reps = 2048 // feats.shape[1] + 1
+        return np.asarray(jnp.tile(feats, (1, reps))[:, :2048], np.float32)
+
+    def bottleneck_for_path(self, path):
+        from distributed_tensorflow_tpu.data.augment import load_image
+
+        return self.bottlenecks(load_image(path, self.image_size)[None])[0]
