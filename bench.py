@@ -441,13 +441,14 @@ def bench_retrain_accuracy() -> list[dict]:
             validation_percentage=15,
             seed=0,
         )
-        trainer = RetrainTrainer(
-            cfg, mesh=make_mesh(num_devices=1), extractor=RandomConvExtractor()
-        )
         # The repo's loggers write to stdout and this process's contract
-        # is ONE stdout line (the driver parses it) — silence ALL levels.
+        # is ONE stdout line (the driver parses it) — silence ALL levels,
+        # including the dataset-split warnings logged during construction.
         logging.disable(logging.CRITICAL)
         try:
+            trainer = RetrainTrainer(
+                cfg, mesh=make_mesh(num_devices=1), extractor=RandomConvExtractor()
+            )
             stats = trainer.train()
         finally:
             logging.disable(logging.NOTSET)

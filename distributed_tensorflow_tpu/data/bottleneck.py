@@ -35,7 +35,16 @@ from distributed_tensorflow_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
-class FeatureExtractor:
+class PathBottleneckMixin:
+    """The one path→bottleneck contract shared by every extractor (the
+    Inception runner here, the random-conv fixture in ``data/gratings.py``,
+    test fakes): load at ``self.image_size``, run ``self.bottlenecks``."""
+
+    def bottleneck_for_path(self, path: str) -> np.ndarray:
+        return self.bottlenecks(load_image(path, self.image_size)[None])[0]
+
+
+class FeatureExtractor(PathBottleneckMixin):
     """Jitted batched Inception-v3 bottleneck runner."""
 
     def __init__(self, model: iv3.InceptionV3, variables, image_size: int = iv3.INPUT_SIZE):
@@ -49,10 +58,6 @@ class FeatureExtractor:
     def bottlenecks(self, images_u8: np.ndarray) -> np.ndarray:
         """(B, H, W, 3) uint8/float [0,255] → (B, 2048) float32."""
         return np.asarray(self._apply(self.variables, jnp.asarray(images_u8)))
-
-    def bottleneck_for_path(self, path: str) -> np.ndarray:
-        return self.bottlenecks(load_image(path, self.image_size)[None])[0]
-
 
 # ---------------------------------------------------------------------------
 # Cache codec (text, comma-separated — reference parity).

@@ -21,6 +21,8 @@ import os
 
 import numpy as np
 
+from distributed_tensorflow_tpu.data.bottleneck import PathBottleneckMixin
+
 
 def grating_dataset(root: str, per_class: int = 40, size: int = 64) -> None:
     """Write ``root/horizontal`` and ``root/vertical`` JPEG folders."""
@@ -44,10 +46,10 @@ def grating_dataset(root: str, per_class: int = 40, size: int = 64) -> None:
             )
 
 
-class RandomConvExtractor:
+class RandomConvExtractor(PathBottleneckMixin):
     """Bottleneck extractor drop-in for the retrain pipeline (same duck
     interface as the Inception extractor: ``image_size``, ``bottlenecks``,
-    ``bottleneck_for_path``)."""
+    ``bottleneck_for_path`` from the shared mixin)."""
 
     image_size = 32
 
@@ -66,7 +68,3 @@ class RandomConvExtractor:
         reps = 2048 // feats.shape[1] + 1
         return np.asarray(jnp.tile(feats, (1, reps))[:, :2048], np.float32)
 
-    def bottleneck_for_path(self, path):
-        from distributed_tensorflow_tpu.data.augment import load_image
-
-        return self.bottlenecks(load_image(path, self.image_size)[None])[0]

@@ -6,11 +6,12 @@ import pytest
 from PIL import Image
 
 from distributed_tensorflow_tpu.config import RetrainConfig
+from distributed_tensorflow_tpu.data.bottleneck import PathBottleneckMixin
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh
 from distributed_tensorflow_tpu.train.retrain_loop import RetrainTrainer
 
 
-class ColorExtractor:
+class ColorExtractor(PathBottleneckMixin):
     """Bottleneck = mean RGB tiled to 2048 — linearly separable by color."""
 
     image_size = 16
@@ -21,10 +22,6 @@ class ColorExtractor:
         reps = 2048 // 3 + 1
         return np.tile(rgb, (1, reps))[:, :2048].astype(np.float32)
 
-    def bottleneck_for_path(self, path):
-        from distributed_tensorflow_tpu.data.augment import load_image
-
-        return self.bottlenecks(load_image(path, self.image_size)[None])[0]
 
 
 def _make_color_dataset(root, n=30):
@@ -43,8 +40,9 @@ def _make_color_dataset(root, n=30):
 
 
 def _cfg(tmp_path, **kw):
+    if "image_dir" not in kw:  # lazy: the grating test supplies its own
+        kw["image_dir"] = _make_color_dataset(tmp_path / "data")
     defaults = dict(
-        image_dir=_make_color_dataset(tmp_path / "data"),
         bottleneck_dir=str(tmp_path / "bn"),
         summaries_dir=str(tmp_path / "sum"),
         output_graph=str(tmp_path / "graph.msgpack"),

@@ -318,7 +318,15 @@ def main(argv=None):
                 print(f"restored checkpoint at step {latest} from {args.train_dir}")
 
     start = int(jax.device_get(g))
-    timer = StepTimer()
+    # Boundary-drained timing: ticks happen ONLY after the boundary's
+    # device_get (which forces completion of every queued dispatch) —
+    # per-dispatch ticks through the axon tunnel measure issue time, not
+    # compute, and inflate steps/s wildly (bench.py module docstring).
+    # warmup=2: the first timed window (contains the jit compile) is
+    # excluded along with the pre-loop mark.
+    timer = StepTimer(warmup_steps=2)
+    timer.tick(0)  # mark t0; contributes no steps
+    last_timed_step = start
     key = jax.random.PRNGKey(args.seed)
     m = {"loss": jnp.nan}  # resume-at-completion runs zero steps
     # TensorBoard events alongside the checkpoints (chief only) — the same
@@ -336,20 +344,24 @@ def main(argv=None):
         num_steps=args.profile_num_steps,
         sync=lambda: jax.device_get(g),
     )
-    try:
-      for i in range(start, args.training_steps):
+    def batch_for(i):
         if text_data is not None:
             # Step-keyed windows: resume at step i draws exactly what an
             # uninterrupted run would have drawn at step i.
-            host_tokens = text_data.train_batch(args.batch_size, step=i)
-        else:
-            host_tokens = synthetic_tokens(
-                rng, args.batch_size, args.seq_len, args.vocab_size
-            )
-        tokens = place(jnp.asarray(host_tokens))
+            return text_data.train_batch(args.batch_size, step=i)
+        return synthetic_tokens(rng, args.batch_size, args.seq_len, args.vocab_size)
+
+    try:
+      # Software-pipelined input: batch i+1 is built and uploaded WHILE the
+      # (asynchronously dispatched) step i computes — through the axon
+      # tunnel the per-step device_put otherwise serializes ~40 ms of
+      # upload latency with the compute (the LM analog of data/prefetch.py).
+      tokens = place(jnp.asarray(batch_for(start))) if start < args.training_steps else None
+      for i in range(start, args.training_steps):
         with prof.step(i):
             params, opt, g, m = step(params, opt, g, tokens, key)
-        timer.tick()
+        if i + 1 < args.training_steps:
+            tokens = place(jnp.asarray(batch_for(i + 1)))
         boundary = (i + 1) % args.eval_step_interval == 0 or i + 1 == args.training_steps
         if ckpt is not None:
             coordinated_maybe_save(
@@ -361,25 +373,46 @@ def main(argv=None):
                 at_boundary=boundary,
             )
         if boundary:
-            step_now = int(jax.device_get(g))
+            step_now = int(jax.device_get(g))  # completion barrier
             loss_now = float(jax.device_get(m["loss"]))
+            timer.tick(step_now - last_timed_step)
+            last_timed_step = step_now
+            tokens_per_sec = timer.steps_per_sec * args.batch_size * args.seq_len
+            # Compute-efficiency observability (same accounting as bench.py):
+            # model FLOPs / elapsed / cluster bf16 peak. None off-TPU or for
+            # MoE (its FLOPs depend on routing, not cfg alone).
+            mfu = None
+            if args.parallelism != "ep":
+                from distributed_tensorflow_tpu.utils.flops import (
+                    chip_peak_flops,
+                    transformer_train_flops,
+                )
+
+                peak = chip_peak_flops()
+                if peak is not None:
+                    flops = transformer_train_flops(cfg, args.batch_size)
+                    mfu = round(
+                        flops * timer.steps_per_sec / (peak * len(jax.devices())), 4
+                    )
+            scalars = {"loss": loss_now}
+            if timer.steps_per_sec > 0:  # first drained window = compile
+                scalars["steps_per_sec"] = timer.steps_per_sec
+                if mfu is not None:
+                    scalars["mfu"] = mfu
             if writer is not None:
-                writer.add_scalars(
-                    {"loss": loss_now, "steps_per_sec": timer.steps_per_sec},
-                    step_now,
-                )
+                writer.add_scalars(scalars, step_now)
             if chief:
-                print(
-                    json.dumps(
-                        {
-                            "step": step_now,
-                            "loss": round(loss_now, 4),
-                            "steps_per_sec": round(timer.steps_per_sec, 2),
-                            "parallelism": args.parallelism,
-                        }
-                    ),
-                    flush=True,
-                )
+                record = {
+                    "step": step_now,
+                    "loss": round(loss_now, 4),
+                    "parallelism": args.parallelism,
+                }
+                if timer.steps_per_sec > 0:  # first drained window = compile
+                    record["steps_per_sec"] = round(timer.steps_per_sec, 2)
+                    record["tokens_per_sec"] = round(tokens_per_sec, 0)
+                    if mfu is not None:
+                        record["mfu"] = mfu
+                print(json.dumps(record), flush=True)
 
     finally:
         prof.close()
