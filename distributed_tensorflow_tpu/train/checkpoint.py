@@ -182,7 +182,7 @@ def coordinated_maybe_save(
     is_chief: bool,
     force: bool = False,
     at_boundary: bool = True,
-) -> None:
+) -> bool:
     """Timed autosave, multi-process safe — the one save gate both trainers
     use. Orbax saves are COLLECTIVE when ``jax.process_count() > 1``: a
     chief-only save desynchronizes the process group (observed gloo
@@ -190,11 +190,9 @@ def coordinated_maybe_save(
     eval boundaries and every process enters the save together. Single
     process keeps exact Supervisor semantics (chief-only, per-call gate)."""
     if jax.process_count() == 1:
-        if is_chief:
-            mngr.maybe_save(step, state, force=force)
-        return
+        return mngr.maybe_save(step, state, force=force) if is_chief else False
     if not (at_boundary or force):
-        return
+        return False
     from jax.experimental import multihost_utils
 
     want = mngr.should_save(force)
@@ -206,6 +204,8 @@ def coordinated_maybe_save(
         # 2-process demo2 test). Async autosave applies single-process.
         mngr.save(step, state, wait=True)
         mngr.mark_saved()
+        return True
+    return False
 
 
 # ---------------------------------------------------------------------------

@@ -187,8 +187,15 @@ class MnistTrainer:
         cfg = self.cfg
         num_steps = num_steps if num_steps is not None else cfg.training_steps
         clock = WallClock()
-        timer = StepTimer()
+        # Boundary-drained timing: the timer ticks ONLY in _post_step at
+        # eval boundaries, right after the metrics device_get forces every
+        # queued dispatch to complete — per-dispatch ticks through the axon
+        # tunnel measure issue time, not compute (bench.py docstring), and
+        # warmup=2 drops the first measured window (it contains the jit
+        # compile).
+        timer = StepTimer(warmup_steps=2)
         step = int(jax.device_get(self.global_step))
+        timer.start(step)
         if step < num_steps:
             if cfg.device_data:
                 self._train_loop(None, num_steps, step, timer)
@@ -223,7 +230,12 @@ class MnistTrainer:
         if self.is_chief and self.writer:
             self.writer.flush()
         train_time = clock.elapsed
-        log.info("Training time: %.2fs (%.1f steps/s)", train_time, timer.steps_per_sec)
+        log.info(
+            "Training time: %.2fs (%.1f steps/s in drained training windows; "
+            "wall-clock includes eval/compile)",
+            train_time,
+            timer.steps_per_sec,
+        )
         return {
             "steps": step,
             "seconds": train_time,
@@ -289,7 +301,6 @@ class MnistTrainer:
                     self.params, self.opt_state, self.global_step, metrics = self.train_step(
                         self.params, self.opt_state, self.global_step, batch, self.rng
                     )
-            timer.tick(k)
             step += k
             self._post_step(step, num_steps, metrics, timer)
 
@@ -313,19 +324,22 @@ class MnistTrainer:
             # Lazy on-device slice — no host sync in the hot loop; _post_step
             # device_gets at eval cadence only.
             metrics = {name: v[-1] for name, v in metrics.items()}
-            timer.tick(k)
             step += k
             self._post_step(step, num_steps, metrics, timer)
 
     def _post_step(self, step: int, num_steps: int, metrics, timer: StepTimer) -> None:
         cfg = self.cfg
-        if step % cfg.eval_step_interval == 0 or step == num_steps:
+        at_boundary = step % cfg.eval_step_interval == 0 or step == num_steps
+        if at_boundary:
+            m = jax.device_get(metrics)  # completion barrier for the window
+            timer.tick_to(step)
             test_acc, test_loss = self.evaluate(self.datasets.test)
             train_acc, _ = self.evaluate(self.datasets.train, max_examples=10000)
-            m = jax.device_get(metrics)
+            rate = timer.steps_per_sec  # 0.0 until the compile window passes
             log.info(
-                "step %d: batch loss %.4f, test acc %.4f, train acc %.4f (%.1f steps/s)",
-                step, float(m["loss"]), test_acc, train_acc, timer.steps_per_sec,
+                "step %d: batch loss %.4f, test acc %.4f, train acc %.4f (%s)",
+                step, float(m["loss"]), test_acc, train_acc,
+                f"{rate:.1f} steps/s" if rate > 0 else "steps/s pending",
             )
             if self.writer:
                 self.writer.add_scalars(
@@ -335,7 +349,7 @@ class MnistTrainer:
                         "test_accuracy": test_acc,
                         "test_loss": test_loss,
                         "train_accuracy": train_acc,
-                        "steps_per_sec": timer.steps_per_sec,
+                        **({"steps_per_sec": rate} if rate > 0 else {}),
                     },
                     step,
                 )
@@ -349,14 +363,18 @@ class MnistTrainer:
                         self.writer, f"{head_name}/weights",
                         p[head_name]["kernel"], step,
                     )
-        self._maybe_save(step, at_eval_boundary=(
-            step % cfg.eval_step_interval == 0 or step == num_steps
-        ))
+        saved = self._maybe_save(step, at_eval_boundary=at_boundary)
+        if at_boundary or saved:
+            # Exclude the eval/summary/save work above from the next
+            # training window (the boundary tick_to already closed this
+            # window at the completion barrier; mid-window timed saves
+            # would otherwise pollute the window they interrupt).
+            timer.mark()
 
-    def _maybe_save(self, step: int, force: bool = False, at_eval_boundary: bool = True) -> None:
+    def _maybe_save(self, step: int, force: bool = False, at_eval_boundary: bool = True) -> bool:
         from distributed_tensorflow_tpu.train.checkpoint import coordinated_maybe_save
 
-        coordinated_maybe_save(
+        return coordinated_maybe_save(
             self.ckpt, step, self._state_dict(), self.is_chief,
             force=force, at_boundary=at_eval_boundary,
         )

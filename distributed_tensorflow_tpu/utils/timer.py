@@ -47,6 +47,28 @@ class StepTimer:
         self._last = now
         self._count += 1
 
+    def mark(self) -> None:
+        """Restart the current window at 'now' WITHOUT counting anything —
+        call after boundary work (eval, summaries, checkpoint) so its time
+        is excluded from the next training window's steps/sec."""
+        self._last = time.time()
+
+    # -- drained-window convenience API (the loop.py / CLI idiom) ----------
+    # Through the axon tunnel, per-dispatch ticks measure issue time, not
+    # compute (bench.py docstring): tick ONLY at completion barriers.
+    # ``start(step)`` marks t0 (and consumes one warmup slot, so with the
+    # default warmup_steps=2 the first measured window — which contains the
+    # jit compile — is dropped); ``tick_to(step)`` closes the window at a
+    # barrier, attributing the steps since the last start/tick_to.
+
+    def start(self, step: int) -> None:
+        self.tick(0)
+        self._last_step = step
+
+    def tick_to(self, step: int) -> None:
+        self.tick(step - self._last_step)
+        self._last_step = step
+
     @property
     def steps_per_sec(self) -> float:
         if self._timed_seconds <= 0:

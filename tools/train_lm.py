@@ -325,8 +325,7 @@ def main(argv=None):
     # warmup=2: the first timed window (contains the jit compile) is
     # excluded along with the pre-loop mark.
     timer = StepTimer(warmup_steps=2)
-    timer.tick(0)  # mark t0; contributes no steps
-    last_timed_step = start
+    timer.start(start)
     key = jax.random.PRNGKey(args.seed)
     m = {"loss": jnp.nan}  # resume-at-completion runs zero steps
     # TensorBoard events alongside the checkpoints (chief only) — the same
@@ -363,20 +362,10 @@ def main(argv=None):
         if i + 1 < args.training_steps:
             tokens = place(jnp.asarray(batch_for(i + 1)))
         boundary = (i + 1) % args.eval_step_interval == 0 or i + 1 == args.training_steps
-        if ckpt is not None:
-            coordinated_maybe_save(
-                ckpt,
-                i + 1,
-                {"params": params, "opt_state": opt, "global_step": g},
-                is_chief=chief,
-                force=(i + 1 == args.training_steps),
-                at_boundary=boundary,
-            )
         if boundary:
             step_now = int(jax.device_get(g))  # completion barrier
             loss_now = float(jax.device_get(m["loss"]))
-            timer.tick(step_now - last_timed_step)
-            last_timed_step = step_now
+            timer.tick_to(step_now)
             tokens_per_sec = timer.steps_per_sec * args.batch_size * args.seq_len
             # Compute-efficiency observability (same accounting as bench.py):
             # model FLOPs / elapsed / cluster bf16 peak. None off-TPU or for
@@ -413,6 +402,20 @@ def main(argv=None):
                     if mfu is not None:
                         record["mfu"] = mfu
                 print(json.dumps(record), flush=True)
+        saved = (
+            coordinated_maybe_save(
+                ckpt,
+                i + 1,
+                {"params": params, "opt_state": opt, "global_step": g},
+                is_chief=chief,
+                force=(i + 1 == args.training_steps),
+                at_boundary=boundary,
+            )
+            if ckpt is not None
+            else False
+        )
+        if boundary or saved:
+            timer.mark()  # exclude boundary/save work from the next window
 
     finally:
         prof.close()
