@@ -237,14 +237,17 @@ def bench_lm_mfu() -> list[dict]:
         compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
     )
     tx = optax.adam(1e-4)
-    host = jax.device_get(
-        TransformerLM(cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
-            "params"
-        ]
-    )
-    n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(host))
-    p = dp.replicate(host, mesh)
-    o = dp.replicate(jax.device_get(tx.init(host)), mesh)
+    # Init ON DEVICE, mesh-replicated: a host round trip of this model's
+    # params + Adam moments is ~4.8 GB — minutes through the axon tunnel,
+    # pure setup waste the driver's bench run doesn't need to pay.
+    rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    model = TransformerLM(cfg)
+    p = jax.jit(
+        lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"],
+        out_shardings=rep,
+    )(jax.random.PRNGKey(0))
+    o = jax.jit(tx.init, out_shardings=rep)(p)
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))
     g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
     step = dp.build_lm_train_step(cfg, tx, mesh, donate=False)
     toks = dp.shard_global_batch(
@@ -472,12 +475,15 @@ def bench_vit_accuracy() -> list[dict]:
 
     from tools.train_image_classifier import main as classifier_main
 
-    steps = 60 if SMOKE else 300
+    steps = 60 if SMOKE else 500
     with tempfile.TemporaryDirectory() as tmp:
         data = os.path.join(tmp, "data")
         from distributed_tensorflow_tpu.data.gratings import grating_dataset
 
-        grating_dataset(data, size=64)
+        # 50/class: the SHA-1 split hashes full paths (tmpdir changes per
+        # run), so small test splits vary run to run — more data + steps
+        # keeps the recorded accuracy stable.
+        grating_dataset(data, per_class=50, size=64)
         # The CLI prints its own JSON progress lines; swallow them so this
         # process emits exactly ONE line (the driver's contract).
         with contextlib.redirect_stdout(io.StringIO()):

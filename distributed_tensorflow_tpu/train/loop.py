@@ -194,7 +194,7 @@ class MnistTrainer:
         # warmup=2 drops the first measured window (it contains the jit
         # compile).
         timer = StepTimer(warmup_steps=2)
-        step = int(jax.device_get(self.global_step))
+        step = start_step = int(jax.device_get(self.global_step))
         timer.start(step)
         if step < num_steps:
             if cfg.device_data:
@@ -230,16 +230,20 @@ class MnistTrainer:
         if self.is_chief and self.writer:
             self.writer.flush()
         train_time = clock.elapsed
-        log.info(
-            "Training time: %.2fs (%.1f steps/s in drained training windows; "
-            "wall-clock includes eval/compile)",
-            train_time,
-            timer.steps_per_sec,
-        )
+        rate = timer.steps_per_sec
+        if rate <= 0 and train_time > 0:
+            # Run too short for a post-compile drained window (single eval
+            # boundary): fall back to whole-run wall-clock — an honest
+            # LOWER bound since it includes compile and evals.
+            rate = (step - start_step) / train_time
+            basis = "whole run incl. compile/eval — run longer for a clean rate"
+        else:
+            basis = "drained training windows; wall-clock includes eval/compile"
+        log.info("Training time: %.2fs (%.1f steps/s, %s)", train_time, rate, basis)
         return {
             "steps": step,
             "seconds": train_time,
-            "steps_per_sec": timer.steps_per_sec,
+            "steps_per_sec": rate,
         }
 
     def _train_loop(self, prefetch, num_steps: int, step: int, timer: StepTimer) -> None:
@@ -367,9 +371,10 @@ class MnistTrainer:
         if at_boundary or saved:
             # Exclude the eval/summary/save work above from the next
             # training window (the boundary tick_to already closed this
-            # window at the completion barrier; mid-window timed saves
-            # would otherwise pollute the window they interrupt).
-            timer.mark()
+            # window at the completion barrier; a mid-window timed save
+            # drops the partial window — steps AND time — so the next
+            # boundary doesn't attribute full-window steps to partial time).
+            timer.mark(step)
 
     def _maybe_save(self, step: int, force: bool = False, at_eval_boundary: bool = True) -> bool:
         from distributed_tensorflow_tpu.train.checkpoint import coordinated_maybe_save

@@ -47,11 +47,16 @@ class StepTimer:
         self._last = now
         self._count += 1
 
-    def mark(self) -> None:
+    def mark(self, step: int | None = None) -> None:
         """Restart the current window at 'now' WITHOUT counting anything —
         call after boundary work (eval, summaries, checkpoint) so its time
-        is excluded from the next training window's steps/sec."""
+        is excluded from the next training window's steps/sec. Pass the
+        current ``step`` when using the tick_to API: a MID-window mark
+        (e.g. a timed autosave) must also drop the partial window's steps,
+        or the next tick_to would attribute them to post-mark time only."""
         self._last = time.time()
+        if step is not None:
+            self._last_step = step
 
     # -- drained-window convenience API (the loop.py / CLI idiom) ----------
     # Through the axon tunnel, per-dispatch ticks measure issue time, not

@@ -217,7 +217,7 @@ def main(argv=None):
                 ),
                 flush=True,
             )
-            timer.mark()  # exclude eval work from the next window
+            timer.mark(step_now)  # exclude eval work from the next window
 
     test_acc = evaluate("testing")
     if test_acc is not None:

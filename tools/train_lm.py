@@ -415,7 +415,9 @@ def main(argv=None):
             else False
         )
         if boundary or saved:
-            timer.mark()  # exclude boundary/save work from the next window
+            # Exclude boundary/save work from the next window; a mid-window
+            # timed save drops the partial window (steps AND time).
+            timer.mark(i + 1)
 
     finally:
         prof.close()
