@@ -416,6 +416,50 @@ def build_lm_train_step(cfg, tx, mesh: Mesh, donate: bool = False):
     return jax.jit(shard_fn, donate_argnums=donate_args)
 
 
+def build_lm_multi_step(cfg, tx, mesh: Mesh, donate: bool = False):
+    """k fused LM train steps per dispatch: ``lax.scan`` over stacked tokens
+    ``(k, B, S)`` (steps dim replicated, batch dim sharded) — the LM
+    counterpart of :func:`build_multi_step`, used by ``tools/train_lm.py
+    --steps_per_call``. Semantics identical to k calls of
+    :func:`build_lm_train_step`; returns stacked ``(k,)`` losses."""
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerLM,
+        next_token_loss,
+    )
+
+    model = TransformerLM(cfg)
+
+    def _shard_multi(p, o, g, tokens_k, key):
+        del key  # no dropout in the LM pretraining path
+
+        def body(carry, tokens):
+            p_, o_, g_ = carry
+
+            def compute(pp_):
+                logits = model.apply({"params": pp_}, tokens)
+                return next_token_loss(logits, tokens)
+
+            loss, grads = jax.value_and_grad(compute)(p_)
+            grads = lax.pmean(grads, ("data", "model"))
+            loss = lax.pmean(loss, ("data", "model"))
+            updates, o_ = tx.update(grads, o_, p_)
+            p_ = jax.tree_util.tree_map(lambda a, u: a + u, p_, updates)
+            return (p_, o_, g_ + 1), loss
+
+        (p, o, g), losses = lax.scan(body, (p, o, g), tokens_k)
+        return p, o, g, {"loss": losses}
+
+    shard_fn = jax.shard_map(
+        _shard_multi,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(None, ("data", "model"), None), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    donate_args = (0, 1) if donate else ()
+    return jax.jit(shard_fn, donate_argnums=donate_args)
+
+
 def build_eval_step(apply_fn: Callable, mesh: Mesh):
     """Jitted SPMD eval step: returns summed correct-count and summed
     per-example cross-entropy over the global (sharded) batch so the host can

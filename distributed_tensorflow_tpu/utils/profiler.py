@@ -72,13 +72,18 @@ class Profiler:
     def enabled(self) -> bool:
         return self.log_dir is not None
 
-    def step(self, step: int):
-        """Context manager wrapping one training step; manages the trace window."""
+    def step(self, step: int, span: int = 1):
+        """Context manager wrapping one training dispatch covering optimizer
+        steps ``[step, step + span)`` (span > 1 = fused multi-step chunks);
+        manages the trace window. The window triggers when it INTERSECTS the
+        dispatch's range — with fused chunks a strict membership test could
+        skip past the window entirely and never record a trace."""
         if not self.enabled or self._done:
             return contextlib.nullcontext()
-        if not self._active and self.start_step <= step < self.start_step + self.num_steps:
+        window_end = self.start_step + self.num_steps
+        if not self._active and step < window_end and step + span > self.start_step:
             self._start()
-        if self._active and step >= self.start_step + self.num_steps:
+        if self._active and step >= window_end:
             self._stop()
             return contextlib.nullcontext()
         if self._active:

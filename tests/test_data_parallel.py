@@ -10,6 +10,7 @@ import pytest
 from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
 from distributed_tensorflow_tpu.parallel import data_parallel as dp
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+from jax.sharding import PartitionSpec as P
 
 
 @pytest.fixture(scope="module")
@@ -329,3 +330,69 @@ def test_accum_step_distinct_dropout_per_microbatch():
     _, _, _, m1 = accum1(p, o, g, dp.stack_shard_batches(micros[:1], mesh), key)
 
     assert float(jax.device_get(m2["loss"])) != float(jax.device_get(m1["loss"]))
+
+
+def test_lm_multi_step_matches_single_steps():
+    """k fused LM steps (one lax.scan dispatch) == k single steps, bitwise
+    (same contract build_multi_step has for the classifier path)."""
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    mesh = make_mesh()
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, num_heads=2, num_layers=2, d_ff=32,
+        max_seq_len=8, compute_dtype=jnp.float32,
+    )
+    # SGD, not Adam: the fused scan and the standalone step compile to
+    # different XLA programs, and Adam's 1/sqrt(v) at v~=0 amplifies
+    # float-epsilon grad differences into visible param noise on the first
+    # steps — SGD keeps the contract testable at float tolerance.
+    tx = optax.sgd(0.1)
+    host = jax.device_get(
+        TransformerLM(cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+            "params"
+        ]
+    )
+    k, batch = 3, 2 * mesh.devices.size
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (k, batch, 8)).astype(np.int32)
+    key = jax.random.PRNGKey(1)
+
+    single = dp.build_lm_train_step(cfg, tx, mesh, donate=False)
+    p1 = dp.replicate(host, mesh)
+    o1 = dp.replicate(jax.device_get(tx.init(host)), mesh)
+    g1 = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    losses1 = []
+    for j in range(k):
+        t = dp.shard_global_batch({"x": jnp.asarray(toks[j])}, mesh)["x"]
+        p1, o1, g1, m1 = single(p1, o1, g1, t, key)
+        losses1.append(float(jax.device_get(m1["loss"])))
+
+    multi = dp.build_lm_multi_step(cfg, tx, mesh, donate=False)
+    pk = dp.replicate(host, mesh)
+    ok = dp.replicate(jax.device_get(tx.init(host)), mesh)
+    gk = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    stacked = dp.shard_global_batch(
+        {"x": jnp.asarray(toks)}, mesh, spec=P(None, ("data", "model"), None)
+    )["x"]
+    pk, ok, gk, mk = multi(pk, ok, gk, stacked, key)
+
+    assert int(jax.device_get(gk)) == k
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(mk["loss"])), np.asarray(losses1), rtol=1e-6
+    )
+    # Same math, but the scanned body and the standalone step compile to
+    # different XLA programs (fusion/reduction order), so equality is to
+    # float tolerance rather than bitwise.
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)),
+            np.asarray(jax.device_get(b)),
+            rtol=1e-6,
+            atol=1e-7,
+        ),
+        p1,
+        pk,
+    )

@@ -64,3 +64,30 @@ def test_train_lm_resume(tmp_path, mode, mp):
     out = buf.getvalue()
     assert "restored checkpoint at step 5" in out
     assert '"step": 10' in out
+
+
+def test_steps_per_call_fused_run(tmp_path, capsys):
+    """--steps_per_call fuses k steps per dispatch (dp only) with unchanged
+    reporting cadence; non-dp modes reject the flag."""
+    import json
+    import math
+
+    main = _main()
+    main(
+        [
+            "--parallelism", "dp", "--training_steps", "12",
+            "--eval_step_interval", "6", "--steps_per_call", "4",
+            "--seq_len", "16", "--batch_size", "8", "--d_model", "16",
+            "--num_heads", "2", "--num_layers", "1", "--d_ff", "32",
+        ]
+    )
+    records = [
+        json.loads(line)
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("{")
+    ]
+    assert [r["step"] for r in records] == [6, 12]  # cadence unchanged
+    assert all(math.isfinite(r["loss"]) for r in records)
+
+    with pytest.raises(SystemExit):
+        main(["--parallelism", "tp", "--steps_per_call", "4"])
