@@ -191,9 +191,12 @@ def bench_mnist_throughput() -> list[dict]:
 # 128-wide contraction; D=64 half-fills it and more than doubles step time —
 # measured v5e-1, see BASELINE.md), flash blocks 1024 (best of the measured
 # sweep). Sized to the HBM edge without remat (MFU counts only useful FLOPs,
-# so remat would depress it): batch 16 at these dims OOMs a 16 GB chip.
-# Measured v5e-1 2026-07-30: 61.0% MFU, 45.8k tok/s, 357 ms/step.
-LM_SHAPE = dict(d_model=2048, num_heads=16, num_layers=8, d_ff=8192, seq=2048, batch=8)
+# so remat would depress it), with donated param/opt buffers — donation
+# frees the old copies during the step, which both speeds the step AND
+# fits batch 12 (without it batch 16 OOMs and 8 was the edge).
+# Measured v5e-1 2026-07-31: 66.0% MFU, 49.6k tok/s, 495 ms/step at B=12
+# (donate, B=8: 63.8%; without donation ~61% at B=8 — BASELINE.md table).
+LM_SHAPE = dict(d_model=2048, num_heads=16, num_layers=8, d_ff=8192, seq=2048, batch=12)
 LM_SMOKE_SHAPE = dict(d_model=64, num_heads=2, num_layers=2, d_ff=128, seq=128, batch=4)
 
 
@@ -249,7 +252,9 @@ def bench_lm_mfu() -> list[dict]:
     o = jax.jit(tx.init, out_shardings=rep)(p)
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))
     g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
-    step = dp.build_lm_train_step(cfg, tx, mesh, donate=False)
+    # Donated param/opt buffers: the loop rebinds them every call, and the
+    # freed copies are what lets batch 12 fit (see LM_SHAPE note).
+    step = dp.build_lm_train_step(cfg, tx, mesh, donate=True)
     toks = dp.shard_global_batch(
         {
             "x": np.random.default_rng(0)
