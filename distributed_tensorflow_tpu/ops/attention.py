@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import functools
 import math
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -267,12 +268,23 @@ _FALLBACK_BLOCK_LIMIT = 4096
 
 def _fit_block(requested: int, seq: int, interpret: bool = False) -> int:
     """Largest block ≤ requested that divides seq AND satisfies Mosaic's
-    sublane rule (multiple of 8, or the whole sequence). Falls back to the
-    full sequence when no such divisor exists (odd/prime lengths) — but on
-    real TPU (not interpret mode, which has no VMEM) refuses the fallback
-    past ``_FALLBACK_BLOCK_LIMIT`` rows, where it would silently blow VMEM:
-    fail here, at the call site, with a fix."""
-    for b in range(min(requested, seq), 7, -1):
+    sublane rule (multiple of 8, or the whole sequence). On real TPU
+    (not interpret mode, which has no VMEM) the search is also capped at
+    ``_FALLBACK_BLOCK_LIMIT`` rows — an explicitly requested block past the
+    limit (e.g. block_q=8192 on seq 8192) would reach Mosaic and blow VMEM
+    far from the call site, so it is clamped down with a warning instead.
+    Falls back to the full sequence when no valid divisor exists
+    (odd/prime lengths), refusing past the same limit: fail here, at the
+    call site, with a fix."""
+    cap = min(requested, seq)
+    if not interpret and cap > _FALLBACK_BLOCK_LIMIT:
+        warnings.warn(
+            f"flash_attention: requested block {requested} exceeds the "
+            f"VMEM-safe limit ({_FALLBACK_BLOCK_LIMIT} rows); clamping.",
+            stacklevel=3,
+        )
+        cap = _FALLBACK_BLOCK_LIMIT
+    for b in range(cap, 7, -1):
         if seq % b == 0 and b % 8 == 0:
             return b
     if seq > _FALLBACK_BLOCK_LIMIT and not interpret:

@@ -257,7 +257,10 @@ class MnistTrainer:
             cfg.profile_dir if self.is_chief else None,
             start_step=step + cfg.profile_start_step,
             num_steps=cfg.profile_num_steps,
-            sync=lambda: jax.block_until_ready(self.global_step),
+            # device_get, NOT block_until_ready: the latter returns without
+            # waiting once dispatches queue on the axon tunnel, truncating
+            # the trace (same honest barrier as tools/train_lm.py).
+            sync=lambda: jax.device_get(self.global_step),
         )
         try:
             self._train_steps(prefetch, num_steps, step, timer, prof)
@@ -283,12 +286,18 @@ class MnistTrainer:
             return
         while step < num_steps:
             batch = next(prefetch)
+            # Fused dispatches advance `span` steps per call; the profiler
+            # window intersects [step, step+span), not just [step, step+1).
+            k = (
+                next(iter(batch.values())).shape[0]
+                if self.multi_step is not None
+                else 1  # accum: k microbatches but ONE optimizer step
+            )
             # Base key only: the step fold happens on-device inside the jitted
             # program (keyed on global_step), so the hot loop does zero
             # per-step host dispatches besides the train step itself.
-            with prof.step(step):
+            with prof.step(step, span=k):
                 if self.multi_step is not None:
-                    k = next(iter(batch.values())).shape[0]
                     self.params, self.opt_state, self.global_step, metrics = self.multi_step(
                         self.params, self.opt_state, self.global_step, batch, self.rng
                     )
@@ -296,12 +305,10 @@ class MnistTrainer:
                     # matching what a per-step loop would log at this point.
                     metrics = {name: v[-1] for name, v in metrics.items()}
                 elif self.accum_step is not None:
-                    k = 1  # k microbatches, ONE optimizer step
                     self.params, self.opt_state, self.global_step, metrics = self.accum_step(
                         self.params, self.opt_state, self.global_step, batch, self.rng
                     )
                 else:
-                    k = 1
                     self.params, self.opt_state, self.global_step, metrics = self.train_step(
                         self.params, self.opt_state, self.global_step, batch, self.rng
                     )
@@ -321,7 +328,7 @@ class MnistTrainer:
                 self.model.apply, self.tx, self.mesh, batch_per_shard, k
             )
         for k in self._chunk_sizes(step, num_steps):
-            with prof.step(step):
+            with prof.step(step, span=k):
                 self.params, self.opt_state, self.global_step, metrics = fns[k](
                     self.params, self.opt_state, self.global_step, pool, self.rng
                 )

@@ -67,6 +67,9 @@ class Profiler:
         self.sync = sync
         self._active = False
         self._done = False
+        self._seen_spans: set[int] = set()
+        self._deferred = False
+        self._traced = 0
 
     @property
     def enabled(self) -> bool:
@@ -77,16 +80,39 @@ class Profiler:
         steps ``[step, step + span)`` (span > 1 = fused multi-step chunks);
         manages the trace window. The window triggers when it INTERSECTS the
         dispatch's range — with fused chunks a strict membership test could
-        skip past the window entirely and never record a trace."""
+        skip past the window entirely and never record a trace.
+
+        One exception: if the window would open on a dispatch whose fused
+        chunk length (``span``) has never been dispatched before, while
+        ``start_step`` asks to skip past the run's beginning, the open is
+        deferred to the next dispatch with an already-seen span. A
+        never-seen span means a fresh jit compile (the cache is keyed on
+        the chunk length): ``start_step`` exists precisely to skip
+        compilation, and with fused chunks the bare intersection test
+        would otherwise start the trace around the compile and swamp the
+        XPlane with host time. Set ``start_step=0`` (or <= the resume
+        step) to opt into tracing the first dispatch anyway. Once open,
+        the trace covers at least ``num_steps`` optimizer steps' worth of
+        dispatches."""
         if not self.enabled or self._done:
             return contextlib.nullcontext()
-        window_end = self.start_step + self.num_steps
-        if not self._active and step < window_end and step + span > self.start_step:
-            self._start()
-        if self._active and step >= window_end:
+        if self._active and self._traced >= self.num_steps:
             self._stop()
+            self._seen_spans.add(span)
             return contextlib.nullcontext()
+        window_end = self.start_step + self.num_steps
+        if not self._active:
+            intersects = step < window_end and step + span > self.start_step
+            if intersects or self._deferred:
+                if (
+                    self.start_step > step or self._deferred
+                ) and span not in self._seen_spans:
+                    self._deferred = True
+                else:
+                    self._start()
+        self._seen_spans.add(span)
         if self._active:
+            self._traced += span
             import jax
 
             return jax.profiler.StepTraceAnnotation("train", step_num=step)
@@ -117,10 +143,17 @@ class Profiler:
         if self._active:
             self._stop()
         elif self.enabled and not self._done:
+            hint = (
+                " (window deferred past the run's only dispatch — the first "
+                "dispatch compiles; set start_step=0 to trace it anyway, or "
+                "lower steps_per_call)"
+                if self._deferred
+                else ""
+            )
             log.warning(
                 "profiler: run ended before the trace window opened "
-                "(start_step=%d, num_steps=%d) — no profile written to %s",
-                self.start_step, self.num_steps, self.log_dir,
+                "(start_step=%d, num_steps=%d) — no profile written to %s%s",
+                self.start_step, self.num_steps, self.log_dir, hint,
             )
 
 

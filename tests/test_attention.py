@@ -82,6 +82,12 @@ def test_flash_autofits_non_divisible_blocks():
     with pytest.raises(ValueError, match="blockwise_attention"):
         A._fit_block(512, 8191)
     assert A._fit_block(512, 8191, interpret=True) == 8191
+    # An explicitly requested block past the VMEM limit that DOES divide the
+    # sequence (divisor-loop path, not the fallback) is clamped with a
+    # warning on real TPU — it must not reach Mosaic as a >4096-row block.
+    with pytest.warns(UserWarning, match="VMEM-safe limit"):
+        assert A._fit_block(8192, 8192) == A._FALLBACK_BLOCK_LIMIT
+    assert A._fit_block(8192, 8192, interpret=True) == 8192
     q, k, v = _qkv(s=48)
     ref = A.dense_attention(q, k, v, causal=True)
     out = A.flash_attention(q, k, v, causal=True, block_q=32, block_kv=32)

@@ -58,6 +58,51 @@ def test_profiler_close_mid_window(tmp_path):
     assert _trace_files(log_dir)
 
 
+def test_profiler_defers_window_past_first_fused_dispatch(tmp_path):
+    """With fused chunks, a window inside the FIRST dispatch (the one that
+    compiles) is deferred to the second dispatch instead of capturing the
+    compile (ADVICE r2 / review r3)."""
+    log_dir = str(tmp_path / "prof")
+    prof = profiler.Profiler(log_dir, start_step=2, num_steps=3)
+    f = jax.jit(lambda x: x @ x)
+    x = jnp.ones((16, 16))
+    with prof.step(0, span=4):  # covers [0,4) ∋ 2 — but it's the compile call
+        jax.block_until_ready(f(x))
+    assert not prof._active and prof._deferred
+    with prof.step(4, span=4):  # deferred window opens here
+        jax.block_until_ready(f(x))
+    assert prof._active
+    with prof.step(8, span=4):  # traced >= num_steps -> closed
+        jax.block_until_ready(f(x))
+    assert prof._done
+    prof.close()
+    assert _trace_files(log_dir)
+
+
+def test_profiler_start_step_zero_traces_first_dispatch(tmp_path):
+    """start_step <= first step is the explicit opt-in to trace the first
+    (compiling) dispatch."""
+    log_dir = str(tmp_path / "prof")
+    prof = profiler.Profiler(log_dir, start_step=0, num_steps=2)
+    with prof.step(0, span=4):
+        jax.block_until_ready(jnp.ones(4) + 1)
+    assert prof._active
+    prof.close()
+    assert _trace_files(log_dir)
+
+
+def test_profiler_single_fused_dispatch_never_opens(tmp_path):
+    """A run that is ONE fused dispatch with start_step inside it writes no
+    trace (the only dispatch is the compile) and warns on close."""
+    log_dir = str(tmp_path / "prof")
+    prof = profiler.Profiler(log_dir, start_step=10, num_steps=5)
+    with prof.step(0, span=1000):
+        jax.block_until_ready(jnp.ones(4) + 1)
+    prof.close()
+    assert not prof._done
+    assert not _trace_files(log_dir)
+
+
 def test_profiler_disabled_is_noop():
     prof = profiler.Profiler(None)
     for step in range(5):
