@@ -113,6 +113,15 @@ class MnistTrainConfig:
     synthetic_data: bool = field(
         default=False, metadata={"help": "generate deterministic synthetic MNIST if idx files absent"}
     )
+    t10k_split: int = field(
+        default=0,
+        metadata={
+            "help": "REAL-data mode for checkouts missing the 60k train-images "
+            "blob: train on 10000-k of the genuine t10k digits, hold out k for "
+            "eval (fixed split, independent of --seed); bundled copies in "
+            "demo1/MNIST_data are used when --data_dir is left at its default"
+        },
+    )
     download_data: bool = field(
         default=False,
         metadata={

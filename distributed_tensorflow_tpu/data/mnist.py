@@ -9,10 +9,18 @@ Parses idx ``.gz`` files directly with numpy (the reference delegated this to
     mnist.test.images, mnist.test.labels        # demo1/train.py:159
 
 ``next_batch`` keeps the tutorial semantics: shuffle once per epoch, then
-serve sequential slices. Because this environment has no network egress the
-reference's download-if-absent behavior is replaced by an optional
-deterministic synthetic generator (``synthetic=True``) producing a learnable
-class-structured dataset with identical shapes/dtypes.
+serve sequential slices. Data sources, in order of realism:
+
+* **Real digits, bundled**: the repo ships the genuine public MNIST t10k
+  idx files (10,000 digits; ``demo1/MNIST_data/``, mirrored from the
+  reference checkout, whose 60k train-images file is absent —
+  ``.MISSING_LARGE_BLOBS``). ``t10k_split=k`` trains on ``10000-k`` of
+  them and holds out ``k`` for eval (:func:`read_data_sets`), so real-data
+  accuracy is measurable offline; the ceiling is 10k examples, not 60k.
+* **Download-if-absent** (``download=True``): the reference's auto-fetch
+  behavior — needs network egress.
+* **Synthetic** (``synthetic=True``): deterministic learnable stand-in
+  with identical shapes/dtypes, for tests and egress-less throughput work.
 """
 
 from __future__ import annotations
@@ -35,6 +43,27 @@ MNIST_BASE_URL = "https://storage.googleapis.com/cvdf-datasets/mnist/"
 
 _IDX_IMAGE_MAGIC = 2051
 _IDX_LABEL_MAGIC = 2049
+
+# The t10k train/holdout split must not move with the training seed: a fixed
+# split seed keeps the holdout identical across runs, so accuracies stay
+# comparable (and a --seed sweep can't leak holdout digits into training).
+_T10K_SPLIT_SEED = 2026
+
+
+def bundled_mnist_dir() -> str | None:
+    """Directory of the repo-bundled REAL MNIST t10k idx files (public
+    dataset, mirrored from the reference checkout at
+    ``/root/reference/demo1/MNIST_data``), or None when absent (e.g. an
+    installed package without the repo tree). The bundle also mirrors the
+    genuine 60k ``train-labels`` file: unused by ``t10k_split`` itself, but
+    with it in place a single ``--download_data`` fetch of the one absent
+    file (``train-images``) completes the full dataset."""
+    d = os.path.normpath(
+        os.path.join(os.path.dirname(__file__), os.pardir, os.pardir, "demo1", "MNIST_data")
+    )
+    if all(os.path.exists(os.path.join(d, n)) for n in (TEST_IMAGES, TEST_LABELS)):
+        return d
+    return None
 
 
 def _open_maybe_gz(path: str):
@@ -106,6 +135,7 @@ def maybe_download_mnist(
     progress: bool = True,
     checksums: dict[str, str] | None = None,
     timeout: float = 60.0,
+    files: tuple[str, ...] = ALL_FILES,
 ) -> list[str]:
     """Fetch any missing MNIST idx ``.gz`` into ``data_dir`` — the
     reference's download-if-absent behavior (``input_data.read_data_sets``,
@@ -120,7 +150,7 @@ def maybe_download_mnist(
     from distributed_tensorflow_tpu.data.download import download_file
 
     fetched: list[str] = []
-    for name in ALL_FILES:
+    for name in files:
         if download_file(
             base_url.rstrip("/") + "/" + name,
             os.path.join(data_dir, name),
@@ -215,12 +245,50 @@ def read_data_sets(
     num_synthetic_test: int = 1000,
     download: bool = False,
     base_url: str = MNIST_BASE_URL,
+    t10k_split: int = 0,
 ) -> Datasets:
     """Load MNIST from idx files in ``data_dir``. When files are absent:
     ``download=True`` first tries :func:`maybe_download_mnist` (the
     reference's auto-fetch, ``demo1/train.py:6``); then ``synthetic=True``
-    falls back to the deterministic synthetic dataset (the working mode in
-    this egress-less environment). Both unset → a clear error."""
+    falls back to the deterministic synthetic dataset. Both unset → a clear
+    error.
+
+    ``t10k_split=k`` (with k > 0) is the REAL-data mode for checkouts where
+    only the t10k files exist (the reference checkout is missing the 60k
+    train-images blob): it loads the 10,000 genuine test digits and splits
+    them into ``10000-k`` training examples and a ``k``-digit holdout. The
+    split is a fixed permutation (``_T10K_SPLIT_SEED``), independent of
+    ``seed``, so the holdout never moves between runs. Mutually exclusive
+    with ``synthetic``."""
+    if t10k_split:
+        if synthetic:
+            raise ValueError("t10k_split and synthetic are mutually exclusive")
+        ip = os.path.join(data_dir, TEST_IMAGES)
+        lp = os.path.join(data_dir, TEST_LABELS)
+        missing = [p for p in (ip, lp) if not os.path.exists(p)]
+        if missing and download:
+            maybe_download_mnist(
+                data_dir, base_url=base_url, files=(TEST_IMAGES, TEST_LABELS)
+            )
+            missing = [p for p in (ip, lp) if not os.path.exists(p)]
+        if missing:
+            hint = bundled_mnist_dir()
+            raise FileNotFoundError(
+                f"t10k_split needs the real t10k idx files; missing: {missing}."
+                + (f" Bundled copies exist at {hint}." if hint else "")
+            )
+        x, y = read_idx_images(ip), read_idx_labels(lp)
+        n = x.shape[0]
+        if not 0 < t10k_split < n:
+            raise ValueError(f"t10k_split must be in (0, {n}), got {t10k_split}")
+        perm = np.random.default_rng(_T10K_SPLIT_SEED).permutation(n)
+        tr, ho = perm[: n - t10k_split], perm[n - t10k_split :]
+        train_yy = _one_hot(y[tr]) if one_hot else y[tr]
+        test_yy = _one_hot(y[ho]) if one_hot else y[ho]
+        return Datasets(
+            train=DataSet(x[tr], train_yy, seed=seed),
+            test=DataSet(x[ho], test_yy, seed=seed + 1),
+        )
     paths = {k: os.path.join(data_dir, k) for k in ALL_FILES}
     have_all = all(os.path.exists(p) for p in paths.values())
     if not have_all and download:

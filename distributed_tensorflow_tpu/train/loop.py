@@ -52,6 +52,33 @@ def build_model(cfg: MnistTrainConfig):
 
 
 class MnistTrainer:
+    @staticmethod
+    def _resolve_data_dir(cfg: MnistTrainConfig) -> str:
+        """Real-data convenience (C19 spirit): with ``--t10k_split`` and
+        ``--data_dir`` left at its parser default, fall back to the repo's
+        bundled genuine t10k files so the demo runs bare from any cwd. An
+        explicitly passed data_dir is never redirected."""
+        if cfg.t10k_split:
+            import os
+
+            from distributed_tensorflow_tpu.data.mnist import (
+                TEST_IMAGES,
+                bundled_mnist_dir,
+            )
+            from distributed_tensorflow_tpu.utils.assets import dataclass_default
+
+            if (
+                not os.path.exists(os.path.join(cfg.data_dir, TEST_IMAGES))
+                and cfg.data_dir == dataclass_default(MnistTrainConfig, "data_dir")
+                and bundled_mnist_dir()
+            ):
+                log.info(
+                    "%s has no t10k files; using bundled real MNIST %s",
+                    cfg.data_dir, bundled_mnist_dir(),
+                )
+                return bundled_mnist_dir()
+        return cfg.data_dir
+
     def __init__(
         self,
         cfg: MnistTrainConfig,
@@ -66,11 +93,12 @@ class MnistTrainer:
         self.mesh = mesh if mesh is not None else make_mesh(num_devices=1)
         self.model = model if model is not None else build_model(cfg)
         self.datasets = datasets or read_data_sets(
-            cfg.data_dir,
+            self._resolve_data_dir(cfg),
             one_hot=True,
             seed=cfg.seed,
             synthetic=cfg.synthetic_data,
             download=cfg.download_data,
+            t10k_split=cfg.t10k_split,
         )
         self.is_chief = is_chief
         self.eval_chunk = eval_chunk

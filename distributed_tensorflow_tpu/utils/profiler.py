@@ -70,6 +70,7 @@ class Profiler:
         self._seen_spans: set[int] = set()
         self._deferred = False
         self._traced = 0
+        self._first_step: int | None = None
 
     @property
     def enabled(self) -> bool:
@@ -96,6 +97,8 @@ class Profiler:
         dispatches."""
         if not self.enabled or self._done:
             return contextlib.nullcontext()
+        if self._first_step is None:
+            self._first_step = step
         if self._active and self._traced >= self.num_steps:
             self._stop()
             self._seen_spans.add(span)
@@ -103,10 +106,14 @@ class Profiler:
         window_end = self.start_step + self.num_steps
         if not self._active:
             intersects = step < window_end and step + span > self.start_step
+            # Opt-in: a start_step at/before the run's first step means the
+            # caller wants the first (compiling) dispatch traced. Otherwise
+            # never open around a chunk length's first-ever dispatch — that
+            # is where its jit compile happens (including tail chunks whose
+            # first appearance is mid-run, not just the run's first call).
+            opt_in = self.start_step <= self._first_step
             if intersects or self._deferred:
-                if (
-                    self.start_step > step or self._deferred
-                ) and span not in self._seen_spans:
+                if not opt_in and span not in self._seen_spans:
                     self._deferred = True
                 else:
                     self._start()

@@ -173,3 +173,70 @@ def test_read_data_sets_download_failure_without_fallback_raises(tmp_path):
             download=True,
             base_url=(tmp_path / "empty").as_uri(),
         )
+
+
+# ---------------------------------------------------------------------------
+# t10k_split: real-data mode for checkouts missing the 60k train-images blob.
+# ---------------------------------------------------------------------------
+
+
+def test_t10k_split_partitions_without_overlap(idx_dir):
+    d, _, _, te_img, te_lbl = idx_dir
+    ds = M.read_data_sets(str(d), one_hot=False, t10k_split=5)
+    assert ds.train.images.shape == (15, 784)
+    assert ds.test.images.shape == (5, 784)
+    # train + holdout together are exactly the t10k set, no duplication.
+    both = np.concatenate([ds.train.images, ds.test.images])
+    ref = te_img.reshape(20, 784).astype(np.float32) / 255.0
+    assert both.shape == ref.shape
+    np.testing.assert_allclose(np.sort(both, axis=0), np.sort(ref, axis=0), rtol=1e-6)
+
+
+def test_t10k_split_is_fixed_across_training_seeds(idx_dir):
+    d, *_ = idx_dir
+    a = M.read_data_sets(str(d), one_hot=False, t10k_split=5, seed=0)
+    b = M.read_data_sets(str(d), one_hot=False, t10k_split=5, seed=123)
+    # Different training seeds must NOT move the holdout (else accuracies
+    # aren't comparable and a seed sweep could leak holdout digits).
+    np.testing.assert_array_equal(a.test.images, b.test.images)
+    np.testing.assert_array_equal(a.test.labels, b.test.labels)
+
+
+def test_t10k_split_rejects_synthetic_and_bad_sizes(idx_dir):
+    d, *_ = idx_dir
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        M.read_data_sets(str(d), t10k_split=5, synthetic=True)
+    with pytest.raises(ValueError, match="t10k_split"):
+        M.read_data_sets(str(d), t10k_split=20)  # holdout == whole set
+    with pytest.raises(FileNotFoundError, match="t10k_split"):
+        M.read_data_sets(str(d / "nope"), t10k_split=5)
+
+
+def test_bundled_real_mnist_is_genuine():
+    """The repo-bundled files are the REAL public t10k set: 10,000 digits
+    with the canonical class histogram (not a synthetic stand-in)."""
+    d = M.bundled_mnist_dir()
+    assert d is not None, "bundled real MNIST missing from checkout"
+    ds = M.read_data_sets(d, one_hot=False, t10k_split=1000)
+    assert ds.train.images.shape == (9000, 784)
+    assert ds.test.images.shape == (1000, 784)
+    counts = np.bincount(
+        np.concatenate([ds.train.labels, ds.test.labels]), minlength=10
+    )
+    np.testing.assert_array_equal(
+        counts, [980, 1135, 1032, 1010, 982, 892, 958, 1028, 974, 1009]
+    )
+
+
+def test_t10k_split_download_fetches_only_t10k_pair(idx_dir, tmp_path):
+    """download=True in t10k mode fetches the two t10k files (not all four)
+    from the mirror into a fresh dir, then splits as usual."""
+    src, *_ = idx_dir
+    dest = tmp_path / "fresh"
+    ds = M.read_data_sets(
+        str(dest), one_hot=False, t10k_split=5, download=True,
+        base_url=src.as_uri(),
+    )
+    assert ds.train.images.shape == (15, 784)
+    import os
+    assert sorted(os.listdir(dest)) == sorted([M.TEST_IMAGES, M.TEST_LABELS])

@@ -137,3 +137,23 @@ def test_trainer_profile_flag(tmp_path):
     trainer = MnistTrainer(cfg)
     trainer.train()
     assert _trace_files(cfg.profile_dir), "trainer wrote no profile"
+
+
+def test_profiler_defers_past_unseen_tail_chunk(tmp_path):
+    """A window landing exactly on a tail chunk's FIRST dispatch (a fused
+    length never dispatched before = fresh jit compile) defers to the next
+    already-compiled length."""
+    log_dir = str(tmp_path / "prof")
+    prof = profiler.Profiler(log_dir, start_step=100, num_steps=5)
+    x = jnp.ones((8, 8))
+    with prof.step(0, span=100):      # compiles span-100 program
+        jax.block_until_ready(x + 1)
+    assert not prof._active
+    with prof.step(100, span=50):     # window start — but span 50 is new
+        jax.block_until_ready(x + 1)
+    assert not prof._active and prof._deferred
+    with prof.step(150, span=100):    # span 100 already seen -> open
+        jax.block_until_ready(x + 1)
+    assert prof._active
+    prof.close()
+    assert _trace_files(log_dir)
