@@ -264,17 +264,27 @@ def build_moe_lm_train_step(
     """step(params, opt_state, global_step, tokens, rng)
         -> (params, opt_state, global_step, metrics)  # loss + aux
 
-    DP over 'data' × EP over 'model' in one program. Gradient sync is a
-    data-axis mean only: expert grads are shard-owned (each model shard owns
+    DP over 'data' × EP over ``ep_axis`` in one program. Gradient sync is a
+    data-axis mean only: expert grads are shard-owned (each ep shard owns
     distinct experts, and the all_to_all AD is exact), replicated-param grads
-    come out identical on every model shard."""
-    if kw.get("ep_axis", "model") != "model":
-        # moe_param_specs, the in_specs, and the grad normalization below all
-        # assume the 'model' axis.
-        raise NotImplementedError("build_moe_lm_train_step supports ep_axis='model' only")
+    come out identical on every ep shard.
+
+    ``ep_axis`` may be any TOKEN-REPLICATED mesh axis — 'model' (default) or
+    'pipe' on a 3-axis mesh whose pipeline axis is free. It may NOT be the
+    'data' axis: this EP design dispatches the same replicated tokens from
+    every ep shard (buying expert *memory* scaling), and its ÷ep gradient
+    normalization is exact only for duplicate contributions. EP over the
+    batch axis routes *distinct* tokens per shard — a different algorithm
+    with a different gradient story (docs/DESIGN.md)."""
+    ep_axis = kw.get("ep_axis", "model")
+    if ep_axis == "data":
+        raise ValueError(
+            "build_moe_lm_train_step: ep_axis must be a token-replicated axis "
+            "('model' or 'pipe'), not the batch axis 'data' — see docstring."
+        )
     model = MoeTransformerLM(cfg, num_experts=num_experts, **kw)
-    p_specs = moe_param_specs(params_template)
-    o_specs = moe_param_specs(jax.eval_shape(tx.init, params_template))
+    p_specs = moe_param_specs(params_template, ep_axis)
+    o_specs = moe_param_specs(jax.eval_shape(tx.init, params_template), ep_axis)
 
     def _shard_step(params, opt_state, global_step, tokens, rng):
         # Dropout key: fold the global step and DATA-shard index only — model
@@ -292,13 +302,13 @@ def build_moe_lm_train_step(
 
         (loss, aux), grads = jax.value_and_grad(compute_loss, has_aux=True)(params)
 
-        # Every model shard dispatches the SAME (model-replicated) tokens, so
-        # each expert processes its tokens once per shard and its owner's
+        # Every ep shard dispatches the SAME (replicated) tokens, so each
+        # expert processes its tokens once per shard and its owner's
         # gradient accumulates ep duplicate contributions — normalize by the
         # axis size (the duplicate compute itself is wall-clock neutral:
         # per-shard expert work is E·cap tokens regardless of ep; EP buys
-        # expert MEMORY scaling). Replicated params need no model collective.
-        ep_size = lax.axis_size("model")
+        # expert MEMORY scaling). Replicated params need no ep collective.
+        ep_size = lax.axis_size(ep_axis)
 
         def sync(path, g):
             names = [q.key for q in path if hasattr(q, "key")]
@@ -324,25 +334,29 @@ def build_moe_lm_train_step(
     return jax.jit(shard_fn, donate_argnums=donate_args)
 
 
-def moe_param_specs(tree: Any) -> Any:
+def moe_param_specs(tree: Any, ep_axis: str = "model") -> Any:
     """Expert-stacked leaves (w_in/b_in/w_out/b_out) sharded on dim 0 over
-    'model'; router and everything else replicated."""
+    ``ep_axis``; router and everything else replicated."""
 
     def spec(path, leaf):
         if getattr(leaf, "ndim", None) == 0:
             return P()
         names = [p.key for p in path if hasattr(p, "key")]
         if names and names[-1] in ("w_in", "b_in", "w_out", "b_out"):
-            return P("model")
+            return P(ep_axis)
         return P()
 
     return jax.tree_util.tree_map_with_path(spec, tree)
 
 
-def shard_moe_params(tree: Any, mesh: Mesh, specs: Any | None = None) -> Any:
+def shard_moe_params(
+    tree: Any, mesh: Mesh, specs: Any | None = None, ep_axis: str = "model"
+) -> Any:
     from distributed_tensorflow_tpu.parallel.data_parallel import place_by_specs
 
-    return place_by_specs(tree, mesh, specs if specs is not None else moe_param_specs(tree))
+    return place_by_specs(
+        tree, mesh, specs if specs is not None else moe_param_specs(tree, ep_axis)
+    )
 
 
 def init_moe_params(
@@ -370,8 +384,14 @@ def build_moe_layer_fn(
     shard, but expert-leaf grads accumulate one duplicate contribution per
     model shard (every shard dispatches the same replicated tokens) — divide
     them by the axis size before use, as ``build_moe_lm_train_step`` does."""
+    if kw.get("ep_axis", "model") == "data":
+        raise ValueError(
+            "build_moe_layer_fn: ep_axis must be a token-replicated axis "
+            "('model' or 'pipe'), not the batch axis 'data' — this layer "
+            "dispatches replicated tokens (see build_moe_lm_train_step)."
+        )
     layer = MoeMlp(cfg, num_experts=num_experts, **kw)
-    specs = moe_param_specs(params_template)
+    specs = moe_param_specs(params_template, kw.get("ep_axis", "model"))
 
     def _apply(params, x):
         y, aux = layer.apply({"params": params}, x)

@@ -20,15 +20,21 @@ from jax.sharding import Mesh
 
 
 def unit_mesh_init(init_fn, *args):
-    """Run a parameter-init function inside a trivial 1×1 ('data','model')
-    shard_map on one LOCAL device and return host numpy — the standard way to
-    get GLOBAL-shape params for modules that query ``lax.axis_size`` (TP/MoE).
+    """Run a parameter-init function inside a trivial 1×1×1
+    ('data','pipe','model') shard_map on one LOCAL device and return host
+    numpy — the standard way to get GLOBAL-shape params for modules that
+    query ``lax.axis_size`` (TP/MoE). All three framework axis names are
+    bound (each size 1) so a module parameterized on ANY of them — e.g.
+    ``ep_axis='pipe'`` — initializes without an unbound-axis error.
     The shard_map is jitted as a whole: eager shard_map dispatches every
     primitive as its own program, which takes minutes through the axon tunnel.
     Multi-process safe (local device + shared seed ⇒ identical host trees)."""
     from jax.sharding import PartitionSpec as P
 
-    mesh1 = Mesh(np.asarray(jax.local_devices()[:1]).reshape(1, 1), ("data", "model"))
+    mesh1 = Mesh(
+        np.asarray(jax.local_devices()[:1]).reshape(1, 1, 1),
+        ("data", "pipe", "model"),
+    )
     fn = jax.jit(
         jax.shard_map(
             init_fn,
