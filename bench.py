@@ -19,15 +19,22 @@ dispatch amortization):
   * ``flash_attention_8k_*`` — the flash kernel fwd+bwd at the round-1
     comparable shape (B=1 H=8 S=8192 D=64, causal bf16; BASELINE.md's
     19.7 ms row) and at the MXU-native D=128 shape.
-  * ``mnist_synthetic_test_accuracy`` — full-test-set accuracy after 2k
-    steps on the synthetic MNIST task (the real idx files need egress;
-    data/mnist.py). North star (BASELINE.md): >= 99% on real MNIST.
+  * ``lm_decode_tokens_per_sec*`` — KV-cache greedy generation throughput
+    (the inference half of the LM story), difference-method timed.
+  * ``mnist_real_test_accuracy`` — holdout accuracy on GENUINE MNIST
+    digits (the public t10k idx files ship in demo1/MNIST_data; 9k train /
+    1k fixed holdout — the 60k train blob is the only piece needing
+    egress). The closest offline measure of BASELINE.md's >= 99% north
+    star; ~97% is the 10k-example ceiling.
+  * ``mnist_synthetic_test_accuracy`` — the synthetic training-path
+    regression canary (noise 0.7 keeps it off the 1.0 ceiling).
   * ``retrain_e2e_test_accuracy`` — the full retrain pipeline (SHA-1
-    split, bottleneck cache, linear head) on the grating task via fixed
-    random-conv features; >= 0.9 north-star evidence.
+    split, bottleneck cache, linear head) on the 8-orientation grating
+    task via fixed random-conv features; >= 0.9 north-star evidence,
+    de-saturated below 1.0.
   * ``vit_e2e_test_accuracy`` — tools/train_image_classifier.py end to end
-    on a generated orientation task (horizontal vs vertical gratings —
-    NOT linearly separable in pixel space, unlike round 1's color blobs).
+    on the 4-orientation grating task (NOT linearly separable in pixel
+    space, unlike round 1's color blobs), de-saturated below 1.0.
 
 ``vs_baseline`` context: the reference publishes no numbers
 (BASELINE.md; BASELINE.json "published" is empty), so the denominator is a
@@ -324,6 +331,77 @@ def bench_lm_mfu() -> list[dict]:
     return out
 
 
+def bench_lm_decode() -> list[dict]:
+    """KV-cache generation throughput (greedy, whole generation is ONE jitted
+    program: batched prefill + lax.scan token loop — models/decoding.py).
+    Difference-method timed: two generation lengths share the identical
+    prefill, dispatch, and drain costs, so (t_long − t_short)/(n_long −
+    n_short) is the pure per-token decode step. Decode is HBM-bound (every
+    token step re-reads all params), so tokens/s ≈ B · HBM_bw / param_bytes
+    is the ceiling to compare against."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_tpu.models.decoding import build_generate_fn
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    if jax.default_backend() != "tpu":
+        return []
+
+    out = []
+    B, P = 8, 128
+    n_long, n_short = 256, 64
+    for tag, (dm, h, nl, dff) in (
+        ("", (1024, 8, 8, 4096)),       # mid-size, ~100M params
+        ("_403m", (2048, 16, 8, 8192)),  # the training-bench flagship
+    ):
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=dm, num_heads=h, num_layers=nl, d_ff=dff,
+            max_seq_len=P + n_long, compute_dtype=jnp.bfloat16,
+        )
+        model = TransformerLM(cfg)
+        p = jax.jit(
+            lambda k, model=model: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"]
+        )(jax.random.PRNGKey(0))
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (B, P)), jnp.int32
+        )
+        key = jax.random.PRNGKey(1)
+        # Common cache_len: both programs must do IDENTICAL per-step work
+        # (the short one would otherwise read a smaller static KV cache,
+        # biasing the difference).
+        fns = {
+            n: build_generate_fn(cfg, n, cache_len=P + n_long)
+            for n in (n_long, n_short)
+        }
+        for n in (n_long, n_short):
+            _drain(fns[n](p, prompt, key)[0, -1])  # compile + complete
+
+        def run(n):
+            t0 = time.perf_counter()
+            _drain(fns[n](p, prompt, key)[0, -1])
+            return time.perf_counter() - t0
+
+        per_step = _per_iter_time(run, n_long, n_short)
+        if per_step is None:
+            continue
+        out.append(
+            {
+                "metric": f"lm_decode_tokens_per_sec{tag}",
+                "value": round(B / per_step, 0),
+                "unit": "tokens/s",
+                "detail": f"{n_params/1e6:.0f}M params, batch {B}, prompt {P}, "
+                f"greedy KV-cache decode, {per_step*1e3:.2f} ms/step",
+            }
+        )
+    return out
+
+
 def bench_flash_kernel() -> list[dict]:
     """Flash attention at the round-1-comparable 8k shape (D=64) and the
     MXU-native D=128 shape, two timing modes per shape:
@@ -513,10 +591,23 @@ def _mnist_train_and_eval(datasets) -> tuple[float, int]:
 def bench_mnist_accuracy() -> list[dict]:
     """Full-test-set accuracy after 2k steps on the synthetic MNIST task
     (kept alongside the real-data metric: synthetic is the throughput-bench
-    dataset, so a regression here localises to the training path)."""
-    from distributed_tensorflow_tpu.data.mnist import read_data_sets
+    dataset, so a regression here localises to the training path). Noise
+    0.7 instead of the throughput default 0.25: hard enough to keep the
+    metric off the 1.0 ceiling, where it couldn't show a regression."""
+    from distributed_tensorflow_tpu.data.mnist import (
+        DataSet,
+        Datasets,
+        one_hot,
+        synthetic_mnist,
+    )
 
-    datasets = read_data_sets("MNIST_data", one_hot=True, seed=0, synthetic=True)
+    # Smoke mode trains 10x fewer steps on CPU — the de-saturation noise
+    # level would read as failure there, so it keeps the easy task.
+    noise = 0.25 if SMOKE else 0.7
+    xi, yi, xt, yt = synthetic_mnist(5000, 1000, seed=0, noise=noise)
+    datasets = Datasets(
+        train=DataSet(xi, one_hot(yi), seed=0), test=DataSet(xt, one_hot(yt), seed=1)
+    )
     acc, steps_done = _mnist_train_and_eval(datasets)
     return [
         {
@@ -524,7 +615,8 @@ def bench_mnist_accuracy() -> list[dict]:
             "value": round(acc, 4),
             "unit": "accuracy",
             "detail": f"after {steps_done} steps, batch {BATCH_PER_CHIP}/chip; "
-            "synthetic task (see mnist_real_test_accuracy for real digits)",
+            f"synthetic task, noise {noise} "
+            "(see mnist_real_test_accuracy for real digits)",
         }
     ]
 
@@ -577,7 +669,10 @@ def bench_retrain_accuracy() -> list[dict]:
     steps = 100 if SMOKE else 300
     with tempfile.TemporaryDirectory() as tmp:
         data = os.path.join(tmp, "gratings")
-        grating_dataset(data, per_class=40, size=64)
+        # 8 orientations (22.5° apart) + heavier pixel noise: hard enough
+        # that accuracy sits below the 1.0 ceiling (a saturated metric
+        # can't show a regression) while holding the >= 0.9 north star.
+        grating_dataset(data, per_class=40, size=64, orientations=8, noise=35)
         cfg = RetrainConfig(
             image_dir=data,
             bottleneck_dir=os.path.join(tmp, "bn"),
@@ -609,9 +704,9 @@ def bench_retrain_accuracy() -> list[dict]:
             "metric": "retrain_e2e_test_accuracy",
             "value": round(float(stats["test_accuracy"]), 4),
             "unit": "accuracy",
-            "detail": f"linear head on generic random-conv features, grating "
-            f"task (not separable in pixel stats), {steps} steps; >= 0.9 "
-            "north star (BASELINE.md)",
+            "detail": f"linear head on generic random-conv features, "
+            f"8-orientation grating task, noise 35 (not separable in pixel "
+            f"stats), {steps} steps; >= 0.9 north star (BASELINE.md)",
         }
     ]
 
@@ -631,8 +726,9 @@ def bench_vit_accuracy() -> list[dict]:
 
         # 50/class: the SHA-1 split hashes full paths (tmpdir changes per
         # run), so small test splits vary run to run — more data + steps
-        # keeps the recorded accuracy stable.
-        grating_dataset(data, per_class=50, size=64)
+        # keeps the recorded accuracy stable. 4 orientations + noise keep
+        # the metric off the 1.0 ceiling (see bench_retrain_accuracy).
+        grating_dataset(data, per_class=50, size=64, orientations=4, noise=25)
         # The CLI prints its own JSON progress lines; swallow them so this
         # process emits exactly ONE line (the driver's contract).
         with contextlib.redirect_stdout(io.StringIO()):
@@ -655,7 +751,7 @@ def bench_vit_accuracy() -> list[dict]:
             "metric": "vit_e2e_test_accuracy",
             "value": round(float(acc), 4),
             "unit": "accuracy",
-            "detail": f"ViT on horizontal/vertical gratings (not linearly "
+            "detail": f"ViT on 4-orientation gratings, noise 25 (not linearly "
             f"separable in pixel space), {steps} steps",
         }
     ]
@@ -673,6 +769,7 @@ def main() -> None:
     if SUITE == "full":
         for fn in (
             bench_lm_mfu,
+            bench_lm_decode,
             bench_flash_kernel,
             bench_mnist_real_accuracy,
             bench_mnist_accuracy,

@@ -40,10 +40,14 @@ def build_generate_fn(
     cfg: TransformerConfig,
     max_new_tokens: int,
     temperature: float = 0.0,
+    cache_len: int | None = None,
 ):
     """Returns jitted ``generate(params, prompt (B, P) int32, rng) ->
     tokens (B, P + max_new_tokens)``. ``temperature == 0`` is greedy.
-    P must be ≥ 1 (conditional generation; the model has no BOS token)."""
+    P must be ≥ 1 (conditional generation; the model has no BOS token).
+    ``cache_len`` overrides the KV-cache length (default: exactly
+    ``P + max_new_tokens``) — benchmarks comparing different generation
+    lengths pass a common value so per-step work is identical."""
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     model = TransformerLM(cfg)
@@ -63,6 +67,10 @@ def build_generate_fn(
             raise ValueError(
                 f"prompt {p} + {max_new_tokens} new > max_seq_len {cfg.max_seq_len}"
             )
+        if cache_len is not None:
+            if cache_len < max_len:
+                raise ValueError(f"cache_len {cache_len} < needed {max_len}")
+            max_len = cache_len
         cache = init_cache(cfg, b, max_len)
 
         # Prefill: ONE batched causal forward over the whole prompt, filling

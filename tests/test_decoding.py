@@ -176,3 +176,19 @@ def test_generate_cli_roundtrip(tmp_path):
         ["--model", out, "--prompt", "3,5,7", "--max_new_tokens", "5"] + shape
     )
     assert tokens.shape == (1, 8)
+
+
+def test_generate_oversized_cache_matches_exact_cache(model_and_params):
+    """cache_len > P + max_new (bench's equal-work requirement) changes only
+    buffer size, not results: unfilled slots are position-masked out."""
+    _, params = model_and_params
+    prompt = jnp.asarray([[5, 9, 3]], jnp.int32)
+    exact = decoding.build_generate_fn(CFG, 6)(params, prompt, jax.random.PRNGKey(1))
+    over = decoding.build_generate_fn(CFG, 6, cache_len=CFG.max_seq_len)(
+        params, prompt, jax.random.PRNGKey(1)
+    )
+    np.testing.assert_array_equal(np.asarray(exact), np.asarray(over))
+    with pytest.raises(ValueError, match="cache_len"):
+        decoding.build_generate_fn(CFG, 6, cache_len=4)(
+            params, prompt, jax.random.PRNGKey(1)
+        )
