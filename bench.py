@@ -354,11 +354,16 @@ def bench_lm_decode() -> list[dict]:
 
     out = []
     B, P = 8, 128
-    n_long, n_short = 256, 64
-    for tag, (dm, h, nl, dff) in (
-        ("", (1024, 8, 8, 4096)),       # mid-size, ~100M params
-        ("_403m", (2048, 16, 8, 8192)),  # the training-bench flagship
-    ):
+    if SMOKE:  # quick on-chip validation: tiny model, short generations
+        n_long, n_short = 32, 8
+        shapes = (("", (64, 2, 2, 128)),)
+    else:
+        n_long, n_short = 256, 64
+        shapes = (
+            ("", (1024, 8, 8, 4096)),       # mid-size, ~100M params
+            ("_403m", (2048, 16, 8, 8192)),  # the training-bench flagship
+        )
+    for tag, (dm, h, nl, dff) in shapes:
         cfg = TransformerConfig(
             vocab_size=256, d_model=dm, num_heads=h, num_layers=nl, d_ff=dff,
             max_seq_len=P + n_long, compute_dtype=jnp.bfloat16,
@@ -594,20 +599,17 @@ def bench_mnist_accuracy() -> list[dict]:
     dataset, so a regression here localises to the training path). Noise
     0.7 instead of the throughput default 0.25: hard enough to keep the
     metric off the 1.0 ceiling, where it couldn't show a regression."""
-    from distributed_tensorflow_tpu.data.mnist import (
-        DataSet,
-        Datasets,
-        one_hot,
-        synthetic_mnist,
-    )
+    import tempfile
+
+    from distributed_tensorflow_tpu.data.mnist import read_data_sets
 
     # Smoke mode trains 10x fewer steps on CPU — the de-saturation noise
     # level would read as failure there, so it keeps the easy task.
     noise = 0.25 if SMOKE else 0.7
-    xi, yi, xt, yt = synthetic_mnist(5000, 1000, seed=0, noise=noise)
-    datasets = Datasets(
-        train=DataSet(xi, one_hot(yi), seed=0), test=DataSet(xt, one_hot(yt), seed=1)
-    )
+    with tempfile.TemporaryDirectory() as empty:
+        datasets = read_data_sets(
+            empty, one_hot=True, seed=0, synthetic=True, synthetic_noise=noise
+        )
     acc, steps_done = _mnist_train_and_eval(datasets)
     return [
         {

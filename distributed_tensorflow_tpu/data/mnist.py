@@ -248,6 +248,7 @@ def read_data_sets(
     synthetic: bool = False,
     num_synthetic_train: int = 5000,
     num_synthetic_test: int = 1000,
+    synthetic_noise: float = 0.25,
     download: bool = False,
     base_url: str = MNIST_BASE_URL,
     t10k_split: int = 0,
@@ -315,7 +316,7 @@ def read_data_sets(
         test_y = read_idx_labels(paths[TEST_LABELS])
     elif synthetic:
         train_x, train_y, test_x, test_y = synthetic_mnist(
-            num_synthetic_train, num_synthetic_test, seed
+            num_synthetic_train, num_synthetic_test, seed, noise=synthetic_noise
         )
     else:
         missing = [k for k, p in paths.items() if not os.path.exists(p)]

@@ -91,6 +91,18 @@ def test_retrain2_two_process_end_to_end(tmp_path):
     assert os.path.exists(str(tmp_path / "graph.msgpack"))
 
 
+def test_train_lm_four_process_two_axis(tmp_path):
+    """4 OS processes forming a 2x2 (data x model) mesh via
+    tools/train_lm.py --parallelism tp: cross-process tensor-parallel
+    collectives compose with cross-process gradient means, and a
+    cross-process-sharded save resumes correctly (VERDICT r2 #6)."""
+    outs = _run_workers("mp_lm_4proc_worker.py", str(tmp_path), "LM4_WORKER_{i}_OK", n=4)
+    # Phase 2 genuinely restored the phase-1 save (a None restore would
+    # silently retrain from step 0 and still print a finite loss).
+    assert "restored checkpoint at step 4" in outs[0]
+    assert (tmp_path / "tp_ck" / "8").is_dir()
+
+
 def test_train_lm_two_process_end_to_end(tmp_path):
     """tools/train_lm.py across 2 OS processes: cluster flags -> global mesh
     -> dp LM training on identical global batches sliced per process ->
