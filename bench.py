@@ -92,7 +92,7 @@ def _drain(x) -> float:
     return float(jax.device_get(x))
 
 
-def _per_iter_time(run, n_long: int, n_short: int, reps: int = 2) -> float | None:
+def _per_iter_time(run, n_long: int, n_short: int, reps: int = 3) -> float | None:
     """Fixed-cost-cancelling timing: ``run(n)`` executes n iterations of the
     workload and returns wall time including the drain round-trip; the
     long/short difference is pure per-iteration work (the round-trip — 2.5 to
@@ -496,7 +496,11 @@ def bench_flash_kernel() -> list[dict]:
             return time.perf_counter() - t0
 
         _drain(step(q, k, v, zero)[0])  # compile + complete
-        per_call = _per_iter_time(chain, n, n // 4)
+        # reps=6: each run is ~0.1 s of compute, so the per-length min is
+        # cheap to stabilize — and the tunnel round-trip some days swings by
+        # more than the whole long/short spread (observed: a scanned timing
+        # reading 3x the dispatched one on the same kernel at reps=2).
+        per_call = _per_iter_time(chain, n, n // 4, reps=6)
         if per_call is not None:
             # "_dispatched" (not r2's bare "_fwd_bwd"): the methodology
             # changed in r3 — the old name's values carried 1/20 of a drain
@@ -536,7 +540,7 @@ def bench_flash_kernel() -> list[dict]:
 
             _drain(fn(q, k, v, 4 * n))  # compile + complete
             _drain(fn(q, k, v, n))
-            per_iter = _per_iter_time(run, 4 * n, n)
+            per_iter = _per_iter_time(run, 4 * n, n, reps=6)
             if per_iter is not None:
                 emit(f"flash_attention_{shape_tag}_{tag}", per_iter, flops)
     return out

@@ -178,12 +178,329 @@ def table_retrain(args) -> None:
     _emit(rows, ["configuration", "total_wall_clock_s"])
 
 
+def table_step_budget(args) -> None:
+    """Per-component time budget of the flagship LM training step (VERDICT
+    r2 #3): each component of the 403M-param step (bench.py LM_SHAPE) is
+    timed IN ISOLATION at the step's exact shapes with the fixed-cost-
+    cancelling difference method — the component body runs inside a chained
+    ``lax.scan`` at two lengths and ``(t_long - t_short)/(n_long - n_short)``
+    cancels the dispatch and drain round-trip exactly (BASELINE.md r3
+    methodology). Each iteration's input is derived from the previous
+    iteration's OUTPUTS (including a scalar folded in from every parameter
+    gradient leaf), so no part of the fwd+bwd can be hoisted or DCE'd.
+
+    The table reports ms/step (x num_layers for per-layer components), the
+    component's model FLOPs share, its achieved %% of bf16 peak, and %% of the
+    measured full step; components + optimizer should sum to ~the full step,
+    with the residual = fusion interactions / misc the isolation can't see.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import flax.linen as nn
+
+    from distributed_tensorflow_tpu.models import transformer as T
+    from distributed_tensorflow_tpu.ops import attention as A
+    from distributed_tensorflow_tpu.parallel import data_parallel as dp
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+    from distributed_tensorflow_tpu.utils.compile_cache import (
+        enable_compilation_cache,
+    )
+    from distributed_tensorflow_tpu.utils.flops import chip_peak_flops
+
+    if jax.default_backend() != "tpu":
+        raise SystemExit("step_budget isolates Mosaic kernels; TPU required")
+    enable_compilation_cache()
+
+    import bench  # repo root (sys.path has it): the flagship shape lives there
+
+    sh = bench.LM_SHAPE
+    B, S, d, H, L, dff = (
+        sh["batch"], sh["seq"], sh["d_model"], sh["num_heads"],
+        sh["num_layers"], sh["d_ff"],
+    )
+    vocab = 256
+    cfg = T.TransformerConfig(
+        vocab_size=vocab, d_model=d, num_heads=H, num_layers=L, d_ff=dff,
+        max_seq_len=S,
+        attention=lambda q, k, v: A.flash_attention(
+            q, k, v, causal=True, block_q=1024, block_kv=1024
+        ),
+        compute_dtype=jnp.bfloat16,
+    )
+    if len(jax.devices()) != 1:
+        # Components are timed un-sharded on one device; comparing them
+        # against a mesh-wide full step would misattribute by the chip count.
+        raise SystemExit("step_budget assumes a single-chip host")
+    peak = chip_peak_flops()
+    if peak is None:
+        raise SystemExit("unknown TPU device_kind — no peak-FLOPs denominator")
+    drain = lambda x: jax.device_get(x)
+
+    def timed_pair(fn, n_long, n_short, reps=6):
+        """bench._per_iter_time (per-length minima, then difference — robust
+        to the tunnel's drain-round-trip spikes) over a chained-scan runner;
+        returns None when the difference doesn't credibly scale, and the row
+        is then reported as unmeasured rather than a fabricated number."""
+        for n in (n_long, n_short):
+            drain(fn(n))  # compile + complete
+
+        def run(n):
+            t0 = time.perf_counter()
+            drain(fn(n))
+            return time.perf_counter() - t0
+
+        return bench._per_iter_time(run, n_long, n_short, reps=reps)
+
+    def scan_component(body, x0, n_long=16, n_short=2):
+        """Time one iteration of ``body`` (x -> x, same shape/dtype) via a
+        chained scan at two lengths."""
+        fns = {}
+
+        def make(n):
+            @jax.jit
+            def run(x):
+                out = jax.lax.scan(lambda c, _: (body(c), None), x, None, length=n)[0]
+                return jnp.sum(out.astype(jnp.float32))
+
+            return run
+
+        def fn(n):
+            if n not in fns:
+                fns[n] = make(n)
+            return fns[n](x0)
+
+        return timed_pair(fn, n_long, n_short)
+
+    def grad_chain(module, params, loss_of_out):
+        """x -> x body running module fwd+bwd: grads w.r.t. (params, x) are
+        both computed; every param-grad leaf is folded into the carry via a
+        cheap reduction so none of the backward pass can be DCE'd."""
+
+        def body(x):
+            def loss(p, xx):
+                return loss_of_out(module.apply({"params": p}, xx))
+
+            (gp, gx) = jax.grad(loss, argnums=(0, 1))(params, x)
+            gp_scalar = sum(
+                jnp.sum(l.astype(jnp.float32)) for l in jax.tree_util.tree_leaves(gp)
+            )
+            return x + 1e-3 * gx + (1e-6 * gp_scalar).astype(x.dtype)
+
+        return body
+
+    rng = np.random.default_rng(0)
+    x0 = jnp.asarray(rng.standard_normal((B, S, d)) * 0.02, jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    mean_loss = lambda out: jnp.mean(out.astype(jnp.float32) ** 2)
+
+    class AttnSublayer(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return T.attention_sublayer(cfg, x, T._attention_fn(cfg))[0]
+
+    class FfnSublayer(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(x)
+            h = nn.Dense(dff, dtype=cfg.compute_dtype, name="mlp_in")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(d, dtype=cfg.compute_dtype, name="mlp_out")(h)
+            return x + h
+
+    class Head(nn.Module):
+        """Final LN + vocab head + next-token loss, plus the token/pos
+        embedding lookups (their bwd is the scatter-add) — everything in the
+        step outside the L blocks and the optimizer."""
+
+        @nn.compact
+        def __call__(self, h, tokens):
+            e = nn.Embed(vocab, d, dtype=cfg.compute_dtype, name="tok_embed")(tokens)
+            pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), tokens.shape)
+            e = e + nn.Embed(S, d, dtype=cfg.compute_dtype, name="pos_embed")(pos)
+            x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(e + h)
+            logits = nn.Dense(vocab, dtype=cfg.compute_dtype, name="lm_head")(x)
+            return T.next_token_loss(logits.astype(jnp.float32), tokens)
+
+    tokens = jnp.asarray(rng.integers(0, vocab, (B, S)), jnp.int32)
+
+    # FLOPs accounting per component (fwd; train = 3x), matching utils/flops.
+    tok = B * S
+    fl_attn = 3 * (2 * tok * 4 * d * d + 4 * B * S * S * d // 2)
+    fl_ffn = 3 * (2 * tok * 2 * d * dff)
+    fl_head = 3 * (2 * tok * d * vocab)
+    fl_flash = 3 * (4 * B * S * S * d // 2)
+
+    rows = []
+
+    def add(component, ms, mult=1, flops=0):
+        print(f"# measured: {component}", file=sys.stderr, flush=True)
+        if ms is None:  # timing discarded as non-scaling (jitter > signal)
+            rows.append(
+                {
+                    "component": component,
+                    "ms_per_step": "unmeasured",
+                    "x": mult,
+                    "model_tflops": round(flops * mult / 1e12, 2),
+                    "pct_of_peak": "—",
+                }
+            )
+            return
+        rows.append(
+            {
+                "component": component,
+                "ms_per_step": round(ms * mult * 1e3, 1),
+                "x": mult,
+                "model_tflops": round(flops * mult / 1e12, 2),
+                "pct_of_peak": (
+                    round(flops * mult / (ms * mult) / peak * 100, 1) if flops else "—"
+                ),
+            }
+        )
+
+    # --- full step, measured exactly as bench_lm_mfu does ---
+    tx = optax.adam(1e-4)
+    mesh = make_mesh()
+    rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    model = T.TransformerLM(cfg)
+    p_full = jax.jit(
+        lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"],
+        out_shardings=rep,
+    )(key)
+    o_full = jax.jit(tx.init, out_shardings=rep)(p_full)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    step = dp.build_lm_train_step(cfg, tx, mesh, donate=True)
+    toks_sharded = dp.shard_global_batch({"x": np.asarray(tokens)}, mesh)["x"]
+    for _ in range(3):
+        p_full, o_full, g, _m = step(p_full, o_full, g, toks_sharded, key)
+    base = int(drain(g))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        p_full, o_full, g, _m = step(p_full, o_full, g, toks_sharded, key)
+    steps_done = int(drain(g)) - base  # the drain must precede the clock read
+    step_ms = (time.perf_counter() - t0) / steps_done
+    # Free the full state before the component measurements need HBM.
+    fl_step = (fl_attn + fl_ffn) * L + fl_head
+
+    # --- adam update on the full 403M tree (uses p/o while still alive) ---
+    grads_like = jax.tree_util.tree_map(lambda t: t * 1e-3, p_full)
+
+    def adam_body(carry):
+        p, o = carry
+        up, o2 = tx.update(grads_like, o, p)
+        return (optax.apply_updates(p, up), o2)
+
+    fns = {}
+
+    def adam_fn(n):
+        if n not in fns:
+
+            def run(po):
+                p_out, _o_out = jax.lax.scan(
+                    lambda c, _: (adam_body(c), None), po, None, length=n
+                )[0]
+                # Sum EVERY param leaf: draining a single leaf would let XLA
+                # dead-code-eliminate the other 403M params' update chains
+                # (observed: the adam row measured ~0 ms that way).
+                return sum(
+                    jnp.sum(l.astype(jnp.float32))
+                    for l in jax.tree_util.tree_leaves(p_out)
+                )
+
+            fns[n] = jax.jit(run)
+        return fns[n]((p_full, o_full))
+
+    adam_s = timed_pair(adam_fn, 16, 2)
+    del p_full, o_full, g, grads_like, fns
+    add("adam update (403M params, f32 m+v)", adam_s, 1, 0)
+
+    # --- per-layer components ---
+    attn_mod = AttnSublayer()
+    pa = jax.jit(lambda k: attn_mod.init(k, x0)["params"], out_shardings=rep)(key)
+    attn_s = scan_component(grad_chain(attn_mod, pa, mean_loss), x0)
+    fwd_attn_s = scan_component(
+        lambda x: x + 1e-3 * attn_mod.apply({"params": pa}, x), x0
+    )
+    del pa
+    add("attn sublayer fwd (ln1+qkv+flash+proj)", fwd_attn_s, L, fl_attn // 3)
+    add("attn sublayer fwd+bwd", attn_s, L, fl_attn)
+
+    ffn_mod = FfnSublayer()
+    pf = jax.jit(lambda k: ffn_mod.init(k, x0)["params"], out_shardings=rep)(key)
+    ffn_s = scan_component(grad_chain(ffn_mod, pf, mean_loss), x0)
+    fwd_ffn_s = scan_component(
+        lambda x: x + 1e-3 * ffn_mod.apply({"params": pf}, x), x0
+    )
+    del pf
+    add("ffn sublayer fwd (ln2+mlp+gelu)", fwd_ffn_s, L, fl_ffn // 3)
+    add("ffn sublayer fwd+bwd", ffn_s, L, fl_ffn)
+
+    # --- embeddings + final LN + head + loss ---
+    head_mod = Head()
+    ph = jax.jit(lambda k: head_mod.init(k, x0, tokens)["params"], out_shardings=rep)(
+        key
+    )
+
+    def head_body(h):
+        def loss(p, hh):
+            return head_mod.apply({"params": p}, hh, tokens)
+
+        gp, gh = jax.grad(loss, argnums=(0, 1))(ph, h)
+        gp_scalar = sum(
+            jnp.sum(l.astype(jnp.float32)) for l in jax.tree_util.tree_leaves(gp)
+        )
+        return h + gh.astype(h.dtype) + (1e-6 * gp_scalar).astype(h.dtype)
+
+    head_s = scan_component(head_body, x0)
+    del ph
+    add("embed + final LN + head + CE loss fwd+bwd", head_s, 1, fl_head)
+
+    # --- flash kernel alone at the step's attention shape ---
+    q0 = jnp.asarray(rng.standard_normal((B, H, S, d // H)) * 0.1, jnp.bfloat16)
+
+    def flash_body(q):
+        # q, k and v all flow from the carry so the backward computes the
+        # full dq + dk + dv (a constant k/v would let XLA drop the dkv
+        # kernel as dead code).
+        def loss(qq):
+            return jnp.mean(
+                A.flash_attention(
+                    qq, qq, qq, causal=True, block_q=1024, block_kv=1024
+                ).astype(jnp.float32)
+                ** 2
+            )
+
+        return q + 1e-3 * jax.grad(loss)(q)
+
+    flash_s = scan_component(flash_body, q0)
+    add("  (flash kernel only, fwd+bwd, B*H=%d)" % (B * H), flash_s, L, fl_flash)
+
+    # --- totals (only when every summed component actually measured) ---
+    parts = [attn_s, ffn_s, head_s, adam_s]
+    if all(x is not None for x in parts):
+        attributed = (attn_s + ffn_s) * L + head_s + adam_s
+        add("SUM of components + adam", attributed, 1, 0)
+        add("FULL STEP (measured, one XLA program)", step_ms, 1, fl_step)
+        add("unattributed (fusion interactions / misc)", step_ms - attributed, 1, 0)
+    else:
+        add("FULL STEP (measured, one XLA program)", step_ms, 1, fl_step)
+    for r in rows:
+        r["pct_of_step"] = (
+            round(r["ms_per_step"] / (step_ms * 1e3) * 100, 1)
+            if isinstance(r["ms_per_step"], (int, float)) and r["ms_per_step"]
+            else "—"
+        )
+    _emit(rows, ["component", "ms_per_step", "x", "model_tflops", "pct_of_peak", "pct_of_step"])
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--table",
         required=True,
-        choices=("dispatch_modes", "long_context", "retrain"),
+        choices=("dispatch_modes", "long_context", "retrain", "step_budget"),
     )
     parser.add_argument(
         "--seconds", type=float, default=10.0,
@@ -194,6 +511,7 @@ def main(argv=None):
         "dispatch_modes": table_dispatch_modes,
         "long_context": table_long_context,
         "retrain": table_retrain,
+        "step_budget": table_step_budget,
     }[args.table](args)
 
 
