@@ -21,11 +21,15 @@ Three tiers, one semantics (causal or full softmax attention over
     sequential on TPU — and the running max/denominator/accumulator carried
     in VMEM scratch, so VMEM holds only (block_q + 2·block_kv)·D rows, never
     the full sequence; f32 accumulation, MXU dots; emits the row logsumexp.
-    Backward: FlashAttention-2-style Pallas pair (dq with kv innermost;
-    dk/dv with q innermost) recomputing p per tile from the saved logsumexp,
-    with block-sparse causal skipping in both directions. Measured v5e-1,
-    8k causal bf16: fwd+bwd 2.7× faster than differentiating the blockwise
-    scan, ~13× faster than dense.
+    Backward: by default ONE fused Pallas kernel (kv outer, q inner) that
+    recomputes p per tile from the saved logsumexp once and accumulates
+    dk/dv per-kv-block and dq in a whole-sequence f32 VMEM scratch — 5 MXU
+    dots + 1 softmax recompute per tile pair vs the two-pass
+    FlashAttention-2 pair's 7 + 2 (measured v5e-1: flagship-shape kernel
+    34 → 55% of bf16 peak, BASELINE.md). Sequences whose dq scratch
+    exceeds ``_FUSED_BWD_DQ_LIMIT`` run as fused q-SEGMENTS (partial dk/dv
+    summed), and shapes with no clean segmentation fall back to the
+    original two-pass pair. Block-sparse causal skipping everywhere.
 
 Causal masking is **end-aligned** in all three tiers: query ``i`` attends to
 keys ``<= i + (Skv - Sq)``, so with cached keys (Sq < Skv, decode) the last
@@ -514,12 +518,235 @@ def _flash_bwd_dkv_kernel(
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc,
+    *, num_q: int, num_kv: int, causal: bool, s: float,
+    q_pos_offset: int,
+):
+    """ONE-pass backward: grid (bh, kj, i) — kv outer so dk/dv accumulate in
+    per-kj scratch exactly like :func:`_flash_bwd_dkv_kernel`, while dq
+    accumulates into a WHOLE-SEQUENCE (sq, D) f32 scratch that persists
+    across the entire (kj, i) grid and is written out at the last cell.
+
+    vs the two-pass FlashAttention-2 scheme this computes each (q, kv) tile
+    pair ONCE: 5 MXU dots + 1 softmax recompute instead of 7 + 2 (the qk
+    logits, exp and do·vᵀ were previously done in BOTH kernels). Bitwise
+    equal to the two-pass result: for fixed i the dq contributions arrive in
+    ascending-kj order, the same order the dq kernel's inner loop used.
+
+    The sq·D f32 dq scratch is the cost — callers gate on it fitting VMEM
+    (``_FUSED_BWD_DQ_LIMIT``) and fall back to the two-pass kernels."""
+    kj = pl.program_id(1)
+    i = pl.program_id(2)
+    bkv = k_ref.shape[1]
+    bq = q_ref.shape[1]
+
+    @pl.when((kj == 0) & (i == 0))
+    def _init_dq():
+        dq_acc[...] = jnp.zeros(dq_acc.shape, dq_acc.dtype)
+
+    @pl.when(i == 0)
+    def _init_dkv():
+        dk_acc[...] = jnp.zeros(dk_acc.shape, dk_acc.dtype)
+        dv_acc[...] = jnp.zeros(dv_acc.shape, dv_acc.dtype)
+
+    def compute():
+        q = q_ref[0]  # (bq, D)
+        k_blk = k_ref[0]  # (bkv, D)
+        v_blk = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]  # (bq, 1)
+        delta = delta_ref[0]
+        logits = jax.lax.dot_general(
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * s  # (bq, bkv)
+        if causal:
+            q_pos = q_pos_offset + i * bq + lax.broadcasted_iota(
+                jnp.int32, (bq, 1), 0
+            )
+            k_pos = kj * bkv + lax.broadcasted_iota(jnp.int32, (1, bkv), 1)
+            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(logits - lse))
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # pᵀ·dO: (bkv, D)
+        dp = jax.lax.dot_general(
+            do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_acc[...] += s * jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # dSᵀ·q: (bkv, D)
+        rows = pl.dslice(i * bq, bq)
+        dq_acc[rows, :] += s * jax.lax.dot_general(
+            ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # dS·k: (bq, D)
+
+    if causal:
+        @pl.when(q_pos_offset + (i + 1) * bq - 1 >= kj * bkv)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(i == num_q - 1)
+    def _finalize_kv():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+    @pl.when((kj == num_kv - 1) & (i == num_q - 1))
+    def _finalize_q():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+# The fused backward holds a whole (sq, D) f32 dq range in VMEM scratch;
+# past this many BYTES for one call, the q axis is SEGMENTED into fused
+# calls of this size (or, if no clean segmentation exists, the two-pass
+# kernels take over). 2 MB ≈ sq 4096 at D=128 — together with the
+# (block, block) f32 intermediates that is comfortably inside a v5e core's
+# ~16 MB VMEM.
+_FUSED_BWD_DQ_LIMIT = 2 * 1024 * 1024
+
+
+def _fused_segment_rows(sq: int, d: int, block_q: int) -> int | None:
+    """Largest q-segment length whose f32 dq scratch fits
+    ``_FUSED_BWD_DQ_LIMIT``: a multiple of ``block_q`` that divides ``sq``
+    evenly. None when no such segmentation exists (callers fall back to the
+    two-pass kernels)."""
+    max_rows = _FUSED_BWD_DQ_LIMIT // (d * 4)
+    if block_q > max_rows:
+        return None
+    for n_seg in range(-(-sq // max_rows), sq + 1):  # smallest count first
+        if sq % n_seg:
+            continue
+        seg = sq // n_seg
+        if seg <= max_rows and seg % block_q == 0:
+            return seg
+    return None
+
+
+def _flash_backward_fused(
+    q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
+    q_pos_offset: int | None = None,
+):
+    """One fused-kernel call; ``q_pos_offset`` overrides the end-aligned
+    default when the q tensor is a SEGMENT of a longer sequence (the
+    segmented path below) — its queries' global positions start at
+    ``q_pos_offset`` rather than ``skv - sq``."""
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    s = _scale(q, scale)
+    block_q = _fit_block(block_q, sq, interpret)
+    block_kv = _fit_block(block_kv, skv, interpret)
+    num_q, num_kv = sq // block_q, skv // block_kv
+    if q_pos_offset is None:
+        q_pos_offset = skv - sq
+
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, skv, d)
+    vf = v.reshape(b * h, skv, d)
+    gf = g.reshape(b * h, sq, d)
+    lsef = lse.reshape(b * h, sq, 1)
+    deltaf = delta.reshape(b * h, sq, 1)
+
+    if causal:
+        def q_index(bh, kj, i):
+            first_block = jnp.clip(
+                (kj * block_kv - q_pos_offset) // block_q, 0, num_q - 1
+            )
+            return (bh, jnp.maximum(i, first_block), 0)
+    else:
+        q_index = lambda bh, kj, i: (bh, i, 0)
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_fused_kernel,
+            num_q=num_q, num_kv=num_kv, causal=causal, s=s,
+            q_pos_offset=q_pos_offset,
+        ),
+        grid=(b * h, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh, kj, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, 1), q_index),
+            pl.BlockSpec((1, block_q, 1), q_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh, kj, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh, kj, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, skv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((sq, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lsef, deltaf)
+
+    return (
+        dq.reshape(b, h, sq, d),
+        dk.reshape(b, h, skv, d),
+        dv.reshape(b, h, skv, d),
+    )
+
+
 def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret):
     b, h, sq, d = q.shape
     skv = k.shape[2]
     s = _scale(q, scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if sq * d * 4 <= _FUSED_BWD_DQ_LIMIT:
+        return _flash_backward_fused(
+            q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
+        )
+    # Longer sequences: run the fused kernel per q-SEGMENT (each segment's
+    # dq scratch fits VMEM). Segment dqs are disjoint row ranges
+    # (concatenated); each segment contributes a partial dk/dv (summed —
+    # T extra (skv, D) adds, negligible next to the saved recompute pass).
+    # Total k/v DMA matches the single call: every computed (q, kv) tile
+    # pair is fetched exactly once across segments.
+    # Fit the block first: an oversize requested block (clamped by
+    # _fit_block inside every kernel call anyway) must not forfeit the
+    # fused path for want of a block-multiple segment.
+    seg = _fused_segment_rows(sq, d, _fit_block(block_q, sq, interpret))
+    if seg is not None:
+        offset0 = skv - sq
+        dqs, dk_tot, dv_tot = [], None, None
+        for a in range(0, sq, seg):
+            dq_s, dk_s, dv_s = _flash_backward_fused(
+                q[:, :, a : a + seg],
+                k,
+                v,
+                out[:, :, a : a + seg],
+                lse[:, :, a : a + seg],
+                g[:, :, a : a + seg],
+                causal,
+                block_q,
+                block_kv,
+                scale,
+                interpret,
+                q_pos_offset=offset0 + a,
+            )
+            dqs.append(dq_s)
+            dk_tot = dk_s if dk_tot is None else dk_tot + dk_s
+            dv_tot = dv_s if dv_tot is None else dv_tot + dv_s
+        return jnp.concatenate(dqs, axis=2), dk_tot, dv_tot
     block_q = _fit_block(block_q, sq, interpret)
     block_kv = _fit_block(block_kv, skv, interpret)
     num_q, num_kv = sq // block_q, skv // block_kv
@@ -623,16 +850,19 @@ def flash_attention(
     interpret: bool | None = None,
 ):
     """Pallas flash-attention (TPU; interpret-mode elsewhere): forward with
-    online softmax in VMEM scratch, FlashAttention-2-style Pallas backward
-    (saved row logsumexp, recomputed p per tile, dq and dk/dv as two
-    kernels) — O(S·block) memory in both directions, block-sparse causal
-    skipping in both directions.
+    online softmax in VMEM scratch; backward is the fused one-pass kernel
+    (dq in a whole-sequence f32 VMEM scratch, q-segmented past
+    ``_FUSED_BWD_DQ_LIMIT``, two-pass FlashAttention-2 fallback) — see
+    :func:`_flash_backward`. O(S·block) memory in both directions plus the
+    backward's ≤2 MB dq scratch, block-sparse causal skipping throughout.
 
-    Default blocks 1024/1024: best of a measured v5e-1 sweep
-    (256–2048 x 256–1024, bf16 causal; BASELINE.md) — 8k D=64 fwd+bwd
-    dropped 11.45→7.86 ms vs the old 512/512 default, D=128 35→53 TFLOP/s.
-    Blocks auto-shrink to fit shorter sequences (:func:`_fit_block`);
-    VMEM at D=128 is ~2.3 MB of tiles+scratch, well inside a v5e core."""
+    Default blocks 1024/1024: best of a measured v5e-1 sweep, re-confirmed
+    after the fused backward (BASELINE.md; 512-blocks cost ~3 MFU points on
+    the flagship step, 2048-row blocks exceed VMEM). Blocks auto-shrink to
+    fit shorter sequences (:func:`_fit_block`). Forward VMEM at D=128 is
+    ~2.3 MB of tiles+scratch; the fused backward adds the dq scratch and
+    resident (block, block) f32 intermediates, still inside a v5e core's
+    ~16 MB."""
     return _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret)
 
 

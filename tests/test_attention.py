@@ -252,3 +252,42 @@ def test_flash_backward_mixed_masked_tile():
     )(q, k, v)
     for a, b in zip(gd, gf):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_segmented_matches_whole(monkeypatch, causal):
+    """q-segmented fused backward (sequence too long for one dq scratch):
+    shrinking _FUSED_BWD_DQ_LIMIT forces the segment loop, whose grads must
+    match the single-call fused path bit-for-bit in dq (disjoint row ranges)
+    and to adds-only reassociation in dk/dv (partial sums)."""
+    q, k, v = _qkv(s=64, d=8)
+    g = jnp.asarray(np.random.default_rng(7).standard_normal(q.shape), q.dtype)
+
+    def grads():
+        return jax.grad(
+            lambda q, k, v: jnp.sum(
+                A.flash_attention(q, k, v, causal=causal, block_q=16, block_kv=16) * g
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+
+    whole = grads()
+    # 64 rows x 8 cols x 4 B = 2 KiB; cap at 512 B -> 16-row segments (4).
+    monkeypatch.setattr(A, "_FUSED_BWD_DQ_LIMIT", 512)
+    assert A._fused_segment_rows(64, 8, 16) == 16
+    seg = grads()
+    np.testing.assert_array_equal(np.asarray(whole[0]), np.asarray(seg[0]))
+    for a, b in zip(whole[1:], seg[1:]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_segment_rows_choices():
+    """Segment chooser: largest block-multiple divisor under the VMEM cap;
+    None when the requested block alone exceeds it (two-pass fallback)."""
+    limit_rows = A._FUSED_BWD_DQ_LIMIT // (128 * 4)  # 4096 at D=128
+    assert A._fused_segment_rows(4096, 128, 1024) == 4096
+    assert A._fused_segment_rows(8192, 128, 1024) == limit_rows
+    assert A._fused_segment_rows(65536, 64, 1024) == 8192
+    assert A._fused_segment_rows(8192, 128, 8192) is None
+    # No block-multiple divisor under the cap: 3 * 4096 at D=128 splits 3x.
+    assert A._fused_segment_rows(12288, 128, 1024) == 4096

@@ -291,9 +291,13 @@ def table_step_budget(args) -> None:
 
         return body
 
-    rng = np.random.default_rng(0)
-    x0 = jnp.asarray(rng.standard_normal((B, S, d)) * 0.02, jnp.bfloat16)
+    # Activations/tokens are generated ON DEVICE: a (B, S, d) bf16 host
+    # upload is ~100 MB, and tunnel bandwidth some days makes that a
+    # many-minute stall (the dispatch_modes table documents the same swing).
     key = jax.random.PRNGKey(0)
+    x0 = jax.jit(
+        lambda k: 0.02 * jax.random.normal(k, (B, S, d), jnp.bfloat16)
+    )(key)
     mean_loss = lambda out: jnp.mean(out.astype(jnp.float32) ** 2)
 
     class AttnSublayer(nn.Module):
@@ -324,7 +328,9 @@ def table_step_budget(args) -> None:
             logits = nn.Dense(vocab, dtype=cfg.compute_dtype, name="lm_head")(x)
             return T.next_token_loss(logits.astype(jnp.float32), tokens)
 
-    tokens = jnp.asarray(rng.integers(0, vocab, (B, S)), jnp.int32)
+    tokens = jax.jit(
+        lambda k: jax.random.randint(k, (B, S), 0, vocab, jnp.int32)
+    )(key)
 
     # FLOPs accounting per component (fwd; train = 3x), matching utils/flops.
     tok = B * S
@@ -361,6 +367,7 @@ def table_step_budget(args) -> None:
         )
 
     # --- full step, measured exactly as bench_lm_mfu does ---
+    log = lambda msg: print(f"# {msg}", file=sys.stderr, flush=True)
     tx = optax.adam(1e-4)
     mesh = make_mesh()
     rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
@@ -373,9 +380,11 @@ def table_step_budget(args) -> None:
     g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
     step = dp.build_lm_train_step(cfg, tx, mesh, donate=True)
     toks_sharded = dp.shard_global_batch({"x": np.asarray(tokens)}, mesh)["x"]
+    log("full step: warmup/compile")
     for _ in range(3):
         p_full, o_full, g, _m = step(p_full, o_full, g, toks_sharded, key)
     base = int(drain(g))
+    log("full step: timing")
     t0 = time.perf_counter()
     for _ in range(10):
         p_full, o_full, g, _m = step(p_full, o_full, g, toks_sharded, key)
@@ -384,37 +393,39 @@ def table_step_budget(args) -> None:
     # Free the full state before the component measurements need HBM.
     fl_step = (fl_attn + fl_ffn) * L + fl_head
 
-    # --- adam update on the full 403M tree (uses p/o while still alive) ---
-    grads_like = jax.tree_util.tree_map(lambda t: t * 1e-3, p_full)
-
-    def adam_body(carry):
-        p, o = carry
-        up, o2 = tx.update(grads_like, o, p)
-        return (optax.apply_updates(p, up), o2)
-
-    fns = {}
-
-    def adam_fn(n):
-        if n not in fns:
-
-            def run(po):
-                p_out, _o_out = jax.lax.scan(
-                    lambda c, _: (adam_body(c), None), po, None, length=n
-                )[0]
-                # Sum EVERY param leaf: draining a single leaf would let XLA
-                # dead-code-eliminate the other 403M params' update chains
-                # (observed: the adam row measured ~0 ms that way).
-                return sum(
-                    jnp.sum(l.astype(jnp.float32))
-                    for l in jax.tree_util.tree_leaves(p_out)
-                )
-
-            fns[n] = jax.jit(run)
-        return fns[n]((p_full, o_full))
-
-    adam_s = timed_pair(adam_fn, 16, 2)
-    del p_full, o_full, g, grads_like, fns
-    add("adam update (403M params, f32 m+v)", adam_s, 1, 0)
+    # --- optimizer: measured as a TX-SWAP DELTA. Directly timing an
+    # isolated 403M-tree update proved unmeasurable on this runtime (a scan
+    # draining one leaf is DCE'd to ~0; a scan consuming every leaf, and a
+    # donated standalone-update jit, both wedge the compiler for 10+ min).
+    # Instead the SAME well-behaved step builder runs with SGD in place of
+    # Adam: the difference is Adam's extra work — the f32 m/v state's
+    # 3.2 GB x2 HBM traffic plus its elementwise math. (The param+grad
+    # read/write pass SGD itself does is fused into the backward and is not
+    # separable; the sum row therefore slightly UNDER-attributes.)
+    log("sgd-step: warmup/compile")
+    del p_full, o_full
+    tx_sgd = optax.sgd(1e-4)
+    p2 = jax.jit(
+        lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"],
+        out_shardings=rep,
+    )(key)
+    o2 = jax.jit(tx_sgd.init, out_shardings=rep)(p2)
+    g2 = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    sgd_step = dp.build_lm_train_step(cfg, tx_sgd, mesh, donate=True)
+    for _ in range(3):
+        p2, o2, g2, _m = sgd_step(p2, o2, g2, toks_sharded, key)
+    base = int(drain(g2))
+    log("sgd-step: timing")
+    t0 = time.perf_counter()
+    for _ in range(10):
+        p2, o2, g2, _m = sgd_step(p2, o2, g2, toks_sharded, key)
+    sgd_done = int(drain(g2)) - base
+    sgd_step_ms = (time.perf_counter() - t0) / sgd_done
+    del p2, o2, g2
+    adam_s = step_ms - sgd_step_ms
+    if adam_s <= 0:  # a drain spike in one 10-step window — not credible
+        adam_s = None
+    add("adam m/v state (adam step − sgd step)", adam_s, 1, 0)
 
     # --- per-layer components ---
     attn_mod = AttnSublayer()
@@ -458,7 +469,9 @@ def table_step_budget(args) -> None:
     add("embed + final LN + head + CE loss fwd+bwd", head_s, 1, fl_head)
 
     # --- flash kernel alone at the step's attention shape ---
-    q0 = jnp.asarray(rng.standard_normal((B, H, S, d // H)) * 0.1, jnp.bfloat16)
+    q0 = jax.jit(
+        lambda k: 0.1 * jax.random.normal(k, (B, H, S, d // H), jnp.bfloat16)
+    )(key)
 
     def flash_body(q):
         # q, k and v all flow from the carry so the backward computes the
