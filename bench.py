@@ -225,8 +225,9 @@ def bench_mnist_throughput() -> list[dict]:
 # so remat would depress it), with donated param/opt buffers — donation
 # frees the old copies during the step, which both speeds the step AND
 # fits batch 12 (without it batch 16 OOMs and 8 was the edge).
-# Measured v5e-1 2026-07-31: 66.0% MFU, 49.6k tok/s, 495 ms/step at B=12
-# (donate, B=8: 63.8%; without donation ~61% at B=8 — BASELINE.md table).
+# Measured v5e-1 2026-07-31 (r3 fused-bwd flash kernel): 68.8% MFU,
+# 51.7k tok/s, 476 ms/step at B=12 donate (B=14/16 regress to ~64% on HBM
+# pressure; r2 two-pass kernel was 66.0% — BASELINE.md table + budget).
 LM_SHAPE = dict(d_model=2048, num_heads=16, num_layers=8, d_ff=8192, seq=2048, batch=12)
 LM_SMOKE_SHAPE = dict(d_model=64, num_heads=2, num_layers=2, d_ff=128, seq=128, batch=4)
 

@@ -606,12 +606,34 @@ def _flash_bwd_fused_kernel(
 
 
 # The fused backward holds a whole (sq, D) f32 dq range in VMEM scratch;
-# past this many BYTES for one call, the q axis is SEGMENTED into fused
-# calls of this size (or, if no clean segmentation exists, the two-pass
-# kernels take over). 2 MB ≈ sq 4096 at D=128 — together with the
+# past this many VMEM BYTES for one call, the q axis is SEGMENTED into
+# fused calls of this size (or, if no clean segmentation exists, the
+# two-pass kernels take over). 2 MB ≈ sq 4096 at D=128 — together with the
 # (block, block) f32 intermediates that is comfortably inside a v5e core's
-# ~16 MB VMEM.
+# ~16 MB VMEM. Sized in TILED bytes: Mosaic pads the lane (last) dim to
+# 128, so a D=32 scratch occupies 4x its logical size (measured: a 16k
+# D=32 whole-sequence call hit 21 MB and failed to compile when this gate
+# counted logical bytes).
 _FUSED_BWD_DQ_LIMIT = 2 * 1024 * 1024
+
+
+def _dq_scratch_bytes_per_row(d: int) -> int:
+    return -(-d // 128) * 128 * 4  # f32, lane dim padded to a multiple of 128
+
+
+def _causal_q_index(q_pos_offset: int, block_q: int, block_kv: int, num_q: int):
+    """q-side twin of :func:`_causal_kv_index` for kv-outer grids: q tiles
+    strictly before kv block ``kj`` are skipped, and clamping the mapped
+    index over the skipped prefix keeps it constant so Pallas elides the
+    HBM→VMEM DMA."""
+
+    def q_index(bh, kj, i):
+        first_block = jnp.clip(
+            (kj * block_kv - q_pos_offset) // block_q, 0, num_q - 1
+        )
+        return (bh, jnp.maximum(i, first_block), 0)
+
+    return q_index
 
 
 def _fused_segment_rows(sq: int, d: int, block_q: int) -> int | None:
@@ -619,7 +641,7 @@ def _fused_segment_rows(sq: int, d: int, block_q: int) -> int | None:
     ``_FUSED_BWD_DQ_LIMIT``: a multiple of ``block_q`` that divides ``sq``
     evenly. None when no such segmentation exists (callers fall back to the
     two-pass kernels)."""
-    max_rows = _FUSED_BWD_DQ_LIMIT // (d * 4)
+    max_rows = _FUSED_BWD_DQ_LIMIT // _dq_scratch_bytes_per_row(d)
     if block_q > max_rows:
         return None
     for n_seg in range(-(-sq // max_rows), sq + 1):  # smallest count first
@@ -657,13 +679,16 @@ def _flash_backward_fused(
     deltaf = delta.reshape(b * h, sq, 1)
 
     if causal:
-        def q_index(bh, kj, i):
-            first_block = jnp.clip(
-                (kj * block_kv - q_pos_offset) // block_q, 0, num_q - 1
-            )
-            return (bh, jnp.maximum(i, first_block), 0)
+        q_index = _causal_q_index(q_pos_offset, block_q, block_kv, num_q)
+        # kv blocks wholly after this call's LAST q position (a q SEGMENT of
+        # a longer sequence sees only a prefix of kv) are compute-skipped —
+        # clamping their mapped index keeps it constant so the k/v DMAs are
+        # elided, not just the math.
+        last_kv = max(0, min(num_kv - 1, (q_pos_offset + sq - 1) // block_kv))
+        kv_index = lambda bh, kj, i: (bh, jnp.minimum(kj, last_kv), 0)
     else:
         q_index = lambda bh, kj, i: (bh, i, 0)
+        kv_index = lambda bh, kj, i: (bh, kj, 0)
 
     dq, dk, dv = pl.pallas_call(
         functools.partial(
@@ -674,8 +699,8 @@ def _flash_backward_fused(
         grid=(b * h, num_kv, num_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh, kj, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh, kj, 0)),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+            pl.BlockSpec((1, block_kv, d), kv_index),
             pl.BlockSpec((1, block_q, d), q_index),
             pl.BlockSpec((1, block_q, 1), q_index),
             pl.BlockSpec((1, block_q, 1), q_index),
@@ -711,7 +736,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
     s = _scale(q, scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if sq * d * 4 <= _FUSED_BWD_DQ_LIMIT:
+    if sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_DQ_LIMIT:
         return _flash_backward_fused(
             q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
         )
@@ -719,8 +744,10 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
     # dq scratch fits VMEM). Segment dqs are disjoint row ranges
     # (concatenated); each segment contributes a partial dk/dv (summed —
     # T extra (skv, D) adds, negligible next to the saved recompute pass).
-    # Total k/v DMA matches the single call: every computed (q, kv) tile
-    # pair is fetched exactly once across segments.
+    # Each segment's call clamps its kv index map past the segment's last
+    # q position, so causal segments fetch only the kv prefix they can see
+    # — k/v DMA stays proportional to COMPUTED tile pairs, not to
+    # segments x num_kv.
     # Fit the block first: an oversize requested block (clamped by
     # _fit_block inside every kernel call anyway) must not forfeit the
     # fused path for want of a block-multiple segment.
@@ -789,15 +816,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
     )(qf, kf, vf, gf, lsef, deltaf)
 
     if causal:
-        # q-innermost grid: skip q tiles strictly before this kv block; keep
-        # the mapped q index constant over the skipped prefix so the DMA is
-        # elided (mirror of the forward's kv skip).
-        def q_index(bh, kj, i):
-            first_block = jnp.clip(
-                (kj * block_kv - q_pos_offset) // block_q, 0, num_q - 1
-            )
-            return (bh, jnp.maximum(i, first_block), 0)
-
+        q_index = _causal_q_index(q_pos_offset, block_q, block_kv, num_q)
     else:
         q_index = lambda bh, kj, i: (bh, i, 0)
 

@@ -272,8 +272,9 @@ def test_flash_backward_segmented_matches_whole(monkeypatch, causal):
         )(q, k, v)
 
     whole = grads()
-    # 64 rows x 8 cols x 4 B = 2 KiB; cap at 512 B -> 16-row segments (4).
-    monkeypatch.setattr(A, "_FUSED_BWD_DQ_LIMIT", 512)
+    # d=8 pads to 128 lanes -> 512 B/row of scratch; cap at 16 rows' worth
+    # so the 64-row sequence splits into four 16-row segments.
+    monkeypatch.setattr(A, "_FUSED_BWD_DQ_LIMIT", 16 * 512)
     assert A._fused_segment_rows(64, 8, 16) == 16
     seg = grads()
     np.testing.assert_array_equal(np.asarray(whole[0]), np.asarray(seg[0]))
@@ -284,10 +285,11 @@ def test_flash_backward_segmented_matches_whole(monkeypatch, causal):
 def test_fused_segment_rows_choices():
     """Segment chooser: largest block-multiple divisor under the VMEM cap;
     None when the requested block alone exceeds it (two-pass fallback)."""
-    limit_rows = A._FUSED_BWD_DQ_LIMIT // (128 * 4)  # 4096 at D=128
+    limit_rows = A._FUSED_BWD_DQ_LIMIT // (128 * 4)  # 4096: lane dim >= 128
     assert A._fused_segment_rows(4096, 128, 1024) == 4096
     assert A._fused_segment_rows(8192, 128, 1024) == limit_rows
-    assert A._fused_segment_rows(65536, 64, 1024) == 8192
+    # D=64 pads to 128 lanes, so its cap matches D=128's, not double it.
+    assert A._fused_segment_rows(65536, 64, 1024) == 4096
     assert A._fused_segment_rows(8192, 128, 8192) is None
     # No block-multiple divisor under the cap: 3 * 4096 at D=128 splits 3x.
     assert A._fused_segment_rows(12288, 128, 1024) == 4096
