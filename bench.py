@@ -32,9 +32,14 @@ dispatch amortization):
     split, bottleneck cache, linear head) on the 8-orientation grating
     task via fixed random-conv features; >= 0.9 north-star evidence,
     de-saturated below 1.0.
-  * ``vit_e2e_test_accuracy`` — tools/train_image_classifier.py end to end
-    on the 4-orientation grating task (NOT linearly separable in pixel
-    space, unlike round 1's color blobs), de-saturated below 1.0.
+  * ``vit_real_test_accuracy`` — the ViT classifier family on the same
+    GENUINE t10k digits/split as ``mnist_real_test_accuracy`` (replaced
+    r2/r3's grating metric, which saturated at 1.0 where it could not
+    show a regression).
+
+Metrics named in ``FLOORS`` are enforced: any stated floor violated (or a
+floored metric missing) exits nonzero after the record prints, on TPU full
+(non-smoke) runs.
 
 ``vs_baseline`` context: the reference publishes no numbers
 (BASELINE.md; BASELINE.json "published" is empty), so the denominator is a
@@ -93,16 +98,25 @@ def _drain(x) -> float:
     return float(jax.device_get(x))
 
 
-def _per_iter_time(run, n_long: int, n_short: int, reps: int = 3) -> float | None:
+def _per_iter_time(
+    run, n_long: int, n_short: int, reps: int = 3, diag: dict | None = None
+) -> float | None:
     """Fixed-cost-cancelling timing: ``run(n)`` executes n iterations of the
     workload and returns wall time including the drain round-trip; the
     long/short difference is pure per-iteration work (the round-trip — 2.5 to
     95 ms depending on tunnel weather — and any one-time dispatch cost appear
     identically in both). min over ``reps`` filters tunnel jitter. Returns
     None when the difference is not credibly positive (hoisted/CSE'd loop or
-    jitter exceeding signal) — callers skip the metric rather than emit a lie."""
-    t_long = min(run(n_long) for _ in range(reps))
+    jitter exceeding signal) — callers skip the metric rather than emit a lie.
+
+    ``diag`` (optional dict) receives the long-window min/median so callers
+    can surface the differencing noise next to the reported value."""
+    longs = sorted(run(n_long) for _ in range(reps))
+    t_long = longs[0]
     t_short = min(run(n_short) for _ in range(reps))
+    if diag is not None:
+        diag["long_min_ms"] = round(longs[0] * 1e3, 2)
+        diag["long_med_ms"] = round(longs[len(longs) // 2] * 1e3, 2)
     if t_long - t_short <= 0.1 * t_short:
         import sys
 
@@ -257,11 +271,12 @@ def bench_lm_mfu() -> list[dict]:
     mesh = make_mesh()
     n_chips = len(jax.devices())
     batch = shape["batch"] * n_chips  # per-chip batch fixed, DP-scaled
-    attention = (
-        (lambda q, k, v: A.flash_attention(q, k, v, causal=True, block_q=1024, block_kv=1024))
-        if on_tpu
-        else "dense"  # smoke/CPU path: no Mosaic
-    )
+    # "flash" resolves to the BSHD-native kernel path (models/transformer
+    # _attention_fn): q/k/v reach the Pallas kernels as a free reshape of the
+    # qkv projection — no materialized head transposes at the custom-call
+    # boundary (~40 ms/step recovered on this flagship, r4; blocks are the
+    # kernel defaults, 1024/1024).
+    attention = "flash" if on_tpu else "dense"  # smoke/CPU path: no Mosaic
     cfg = TransformerConfig(
         vocab_size=256,
         d_model=shape["d_model"],
@@ -271,6 +286,10 @@ def bench_lm_mfu() -> list[dict]:
         max_seq_len=shape["seq"],
         attention=attention,
         compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        # Bias-free (the modern-LM convention): each Dense bias GRADIENT is
+        # a separate whole-activation reduce XLA won't fuse — measured
+        # 9.8 ms/step (~2%) at this shape (r4 A/B in BASELINE.md).
+        use_bias=False,
     )
     tx = optax.adam(1e-4)
     # Init ON DEVICE, mesh-replicated: a host round trip of this model's
@@ -515,7 +534,9 @@ def bench_flash_kernel() -> list[dict]:
         # tunnel round-trip some days swings by more than a short chain's
         # whole spread (observed: dispatched readings from 1.4 to 4.0 ms
         # for the same kernel at 20/5-call chains).
-        per_call = _per_iter_time(chain, 4 * n, n, reps=4)
+        disp_diag: dict = {}
+        per_call = _per_iter_time(chain, 4 * n, n, reps=4, diag=disp_diag)
+        dispatched_idx = None
         if per_call is not None and _credible(
             f"{shape_tag}_fwd_bwd_dispatched", per_call, 3 * fwd_flops
         ):
@@ -524,6 +545,11 @@ def bench_flash_kernel() -> list[dict]:
             # round-trip, so reusing it would read as a ~40% kernel
             # improvement that never happened (BASELINE.md, r3 correction).
             emit(f"flash_attention_{shape_tag}_fwd_bwd_dispatched", per_call, 3 * fwd_flops)
+            out[-1]["detail"] += (
+                f"; long-window min/med {disp_diag.get('long_min_ms')}"
+                f"/{disp_diag.get('long_med_ms')} ms"
+            )
+            dispatched_idx = len(out) - 1
 
         # --- kernel-only: n calls fused into ONE scanned program, so the
         # per-dispatch cost appears once (and cancels in the length
@@ -562,18 +588,44 @@ def bench_flash_kernel() -> list[dict]:
 
             _drain(fn(q, k, v, 4 * n_scan))  # compile + complete
             _drain(fn(q, k, v, n_scan))
-            per_iter = _per_iter_time(run, 4 * n_scan, n_scan, reps=3)
+            diag: dict = {}
+            per_iter = _per_iter_time(run, 4 * n_scan, n_scan, reps=3, diag=diag)
             if per_iter is None or not _credible(
                 f"{shape_tag}_{tag}", per_iter, flops
             ):
                 continue
             emit(f"flash_attention_{shape_tag}_{tag}", per_iter, flops)
+            out[-1]["detail"] += (
+                f"; long-window min/med {diag.get('long_min_ms')}"
+                f"/{diag.get('long_med_ms')} ms"
+            )
+            # Cross-mode consistency (VERDICT r3 #4): a dispatched-per-call
+            # reading BELOW the same work scan-fused is physically impossible
+            # — per-call dispatch adds cost, never removes it. It means
+            # differencing noise leaked through the per-length minima; the
+            # DISPATCHED number is the corrupt one (its short chains are the
+            # jitter-sensitive windows), so discard it loudly.
+            if (
+                tag == "fwd_bwd_kernel_only"
+                and dispatched_idx is not None
+                and per_call < per_iter - max(1e-4, 0.03 * per_iter)
+            ):
+                bad = out.pop(dispatched_idx)
+                print(
+                    f"bench: DISCARDED {bad['metric']}: {bad['value']} ms "
+                    f"dispatched < {per_iter*1e3:.2f} ms kernel-only — "
+                    "cross-mode impossible, differencing noise",
+                    file=sys.stderr,
+                )
+                dispatched_idx = None
     return out
 
 
-def _mnist_train_and_eval(datasets) -> tuple[float, int]:
-    """Shared accuracy-bench core: train the reference convnet on
-    ``datasets.train`` for BENCH_ACC_STEPS, return (test accuracy, steps)."""
+def _mnist_train_and_eval(datasets, model=None) -> tuple[float, int]:
+    """Shared accuracy-bench core: train ``model`` (default: the reference
+    convnet) on ``datasets.train`` for BENCH_ACC_STEPS, return
+    (test accuracy, steps). Any ``apply(variables, (B, 784)) -> logits``
+    model rides the same data-parallel pool path (the ViT does)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -584,9 +636,10 @@ def _mnist_train_and_eval(datasets) -> tuple[float, int]:
 
     steps = int(os.environ.get("BENCH_ACC_STEPS", 200 if SMOKE else 2000))
     mesh = make_mesh()
-    model = MnistCNN() if jax.default_backend() == "tpu" else MnistCNN(
-        compute_dtype=jnp.float32
-    )
+    if model is None:
+        model = MnistCNN() if jax.default_backend() == "tpu" else MnistCNN(
+            compute_dtype=jnp.float32
+        )
     tx = optax.adam(1e-4)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784), jnp.float32))["params"]
     p = dp.replicate(params, mesh)
@@ -697,12 +750,15 @@ def bench_retrain_accuracy() -> list[dict]:
     from distributed_tensorflow_tpu.parallel.mesh import make_mesh
     from distributed_tensorflow_tpu.train.retrain_loop import RetrainTrainer
 
-    steps = 100 if SMOKE else 300
+    steps = 100 if SMOKE else 1000
     with tempfile.TemporaryDirectory() as tmp:
         data = os.path.join(tmp, "gratings")
         # 8 orientations (22.5° apart) + heavier pixel noise: hard enough
         # that accuracy sits below the 1.0 ceiling (a saturated metric
         # can't show a regression) while holding the >= 0.9 north star.
+        # 1000 steps (r3 ran 300 and undertrained to 0.65 — VERDICT r3 #1);
+        # the r4 calibration sweep measured 0.966 here, with 600-step/
+        # noise-30 variants already brushing the ceiling at 0.99.
         grating_dataset(data, per_class=40, size=64, orientations=8, noise=35)
         cfg = RetrainConfig(
             image_dir=data,
@@ -737,55 +793,75 @@ def bench_retrain_accuracy() -> list[dict]:
             "unit": "accuracy",
             "detail": f"linear head on generic random-conv features, "
             f"8-orientation grating task, noise 35 (not separable in pixel "
-            f"stats), {steps} steps; >= 0.9 north star (BASELINE.md)",
+            f"stats), {steps} steps; >= 0.9 north star ENFORCED (bench.FLOORS)",
         }
     ]
 
 
 def bench_vit_accuracy() -> list[dict]:
-    """tools/train_image_classifier.py end to end on the grating task."""
-    import contextlib
-    import io
-    import tempfile
+    """ViT holdout accuracy on GENUINE MNIST digits (the bundled t10k set,
+    same 9k/1k fixed split as ``mnist_real_test_accuracy``) — the second
+    classifier family on real data. Replaces r2/r3's synthetic-grating e2e
+    metric, which sat on the 1.0 ceiling where it could not show a
+    regression (VERDICT r3 #3; the grating CLI path stays covered by
+    tests/test_image_classifier.py)."""
+    import sys
 
-    from tools.train_image_classifier import main as classifier_main
+    import jax
+    import jax.numpy as jnp
 
-    steps = 60 if SMOKE else 500
-    with tempfile.TemporaryDirectory() as tmp:
-        data = os.path.join(tmp, "data")
-        from distributed_tensorflow_tpu.data.gratings import grating_dataset
+    from distributed_tensorflow_tpu.data.mnist import bundled_mnist_dir, read_data_sets
+    from distributed_tensorflow_tpu.models.vit import ViT, ViTConfig
 
-        # 50/class: the SHA-1 split hashes full paths (tmpdir changes per
-        # run), so small test splits vary run to run — more data + steps
-        # keeps the recorded accuracy stable. 4 orientations + noise keep
-        # the metric off the 1.0 ceiling (see bench_retrain_accuracy).
-        grating_dataset(data, per_class=50, size=64, orientations=4, noise=25)
-        # The CLI prints its own JSON progress lines; swallow them so this
-        # process emits exactly ONE line (the driver's contract).
-        with contextlib.redirect_stdout(io.StringIO()):
-            acc = classifier_main(
-                [
-                    "--image_dir", data,
-                    "--training_steps", str(steps),
-                    "--image_size", "32",
-                    "--patch_size", "8",
-                    "--d_model", "64",
-                    "--num_layers", "2",
-                    "--d_ff", "128",
-                    "--eval_step_interval", str(steps),
-                    "--testing_percentage", "20",
-                    "--validation_percentage", "10",
-                ]
-            )
+    d = bundled_mnist_dir()
+    if d is None:
+        print("bench: bundled real MNIST absent; skipping vit real-accuracy",
+              file=sys.stderr)
+        return []
+    datasets = read_data_sets(d, one_hot=True, seed=0, t10k_split=1000)
+    cfg = ViTConfig(
+        compute_dtype=jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    )
+    acc, steps_done = _mnist_train_and_eval(datasets, model=ViT(cfg))
     return [
         {
-            "metric": "vit_e2e_test_accuracy",
-            "value": round(float(acc), 4),
+            "metric": "vit_real_test_accuracy",
+            "value": round(acc, 4),
             "unit": "accuracy",
-            "detail": f"ViT on 4-orientation gratings, noise 25 (not linearly "
-            f"separable in pixel space), {steps} steps",
+            "detail": f"ViT ({cfg.num_layers}L d{cfg.d_model} p{cfg.patch_size}) "
+            f"after {steps_done} steps, batch {BATCH_PER_CHIP}/chip; REAL t10k "
+            "digits, 9k train / 1k holdout (fixed split)",
         }
     ]
+
+
+# Metrics with a stated floor are GATES, not log lines (VERDICT r3 #1):
+# after printing its record the bench exits nonzero on any violation, so a
+# regression fails the driver's run loudly instead of sitting silently in
+# the JSON (r3 shipped retrain at 0.6481 against its own >= 0.9 north star
+# and nothing tripped). A MISSING floored metric is also a violation — a
+# crashed accuracy bench must not read as a pass. Floors hold for the full
+# suite on real hardware; smoke mode (tiny shapes) skips them unless
+# BENCH_ENFORCE_FLOORS=1 forces the check (used by the gating test).
+FLOORS = {
+    "retrain_e2e_test_accuracy": 0.90,
+    "mnist_real_test_accuracy": 0.95,
+    "vit_real_test_accuracy": 0.90,
+    "lm_train_mfu": 0.60,
+}
+
+
+def enforce_floors(metrics: list[dict]) -> list[str]:
+    """Return human-readable floor violations (empty = all floors hold)."""
+    by_name = {m.get("metric"): m for m in metrics}
+    problems = []
+    for name, floor in FLOORS.items():
+        m = by_name.get(name)
+        if m is None or "value" not in m:
+            problems.append(f"{name}: MISSING (floor {floor})")
+        elif m["value"] < floor:
+            problems.append(f"{name}: {m['value']} < floor {floor}")
+    return problems
 
 
 def main() -> None:
@@ -813,6 +889,20 @@ def main() -> None:
                 extra.append({"metric": f"{fn.__name__}_error", "error": str(e)[:300]})
     headline["extra_metrics"] = extra
     print(json.dumps(headline))
+    # Floors describe the real-hardware record: off-TPU (e.g. a CPU-only
+    # checkout running the full suite) lm_train_mfu is legitimately absent
+    # (unknown chip peak), so only the driver's TPU runs enforce by default.
+    import jax
+
+    enforce = (
+        SUITE == "full" and not SMOKE and jax.default_backend() == "tpu"
+    ) or os.environ.get("BENCH_ENFORCE_FLOORS") == "1"
+    if enforce:
+        problems = enforce_floors(extra)
+        if problems:
+            for p in problems:
+                print(f"bench: FLOOR VIOLATION — {p}", file=sys.stderr)
+            raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -40,6 +40,13 @@ class TransformerConfig:
     dropout_rate: float = 0.0
     attention: str | Callable = "dense"  # 'dense' | 'blockwise' | 'flash' | callable
     compute_dtype: Any = jnp.bfloat16
+    # Dense-layer biases (qkv/proj/mlp/lm_head; LayerNorm keeps its affine
+    # params either way). Default True for continuity with earlier rounds;
+    # the bench flagship runs False — the modern-LM convention, worth a
+    # measured ~2% of the flagship step: XLA emits each bias GRADIENT as a
+    # separate whole-activation reduce pass it will not fuse into the
+    # weight-grad matmul (9.8 ms/step at the flagship shape, XPlane r4).
+    use_bias: bool = True
     # Rematerialise each block on the backward pass (jax.checkpoint): saves
     # only block boundaries instead of every intermediate — activation memory
     # drops from O(L·S·(d_ff+4·d_model)) to O(L·S·d_model) + one block's
@@ -48,7 +55,19 @@ class TransformerConfig:
     remat: bool = False
 
 
-def _attention_fn(cfg: TransformerConfig) -> Callable:
+def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callable:
+    """Resolve ``cfg.attention`` to a callable. The callable's optional
+    ``input_layout`` attribute ("bhsd" default, "bshd", or "packed_qkv")
+    tells :func:`attention_sublayer` which layout to feed it.
+
+    ``prefer_packed`` opts the flash path into the layout-native
+    packed-qkv kernels — the attend fn then takes the fused (B, S,
+    3·d_model) qkv projection output directly, so no q/k/v slice copies or
+    head transposes materialize at the Pallas custom-call boundary
+    (~10 ms/step on the flagship, XPlane r4). Only callers that route
+    through :func:`attention_sublayer` may pass it (TransformerLM, the MoE
+    block, the pipeline stages); direct (q, k, v) consumers like TpBlock
+    keep the default 3-arg BHSD callable."""
     if callable(cfg.attention):
         return cfg.attention
     if cfg.attention == "dense":
@@ -56,6 +75,12 @@ def _attention_fn(cfg: TransformerConfig) -> Callable:
     if cfg.attention == "blockwise":
         return lambda q, k, v: A.blockwise_attention(q, k, v, causal=True)
     if cfg.attention == "flash":
+        if prefer_packed:
+            def fn(qkv):
+                return A.flash_attention_qkv(qkv, cfg.num_heads, causal=True)
+
+            fn.input_layout = "packed_qkv"
+            return fn
         return lambda q, k, v: A.flash_attention(q, k, v, causal=True)
     raise ValueError(f"unknown attention implementation: {cfg.attention!r}")
 
@@ -70,11 +95,41 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
     h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x)
     b, s, _ = h.shape
     dh = cfg.d_model // cfg.num_heads
-    qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.compute_dtype, name="qkv")(h)
+    qkv = nn.Dense(
+        3 * cfg.d_model, dtype=cfg.compute_dtype, name="qkv",
+        use_bias=cfg.use_bias,
+    )(h)
+    layout = getattr(attend, "input_layout", "bhsd")
+    if cache is None and layout == "packed_qkv":
+        # Layout-native attention: the attend fn consumes the fused qkv
+        # projection output DIRECTLY — neither the q/k/v split copies nor
+        # the (B,H,S,D) head transposes ever materialize at the kernel
+        # boundary (measured ~10 ms/step of boundary passes on the
+        # flagship, XPlane r4 — ops/attention.py packed-qkv section).
+        attn = attend(qkv)
+        attn = nn.Dense(
+            cfg.d_model, dtype=cfg.compute_dtype, name="proj",
+            use_bias=cfg.use_bias,
+        )(attn)
+        if cfg.dropout_rate:
+            attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
+        return x + attn, None
     q, k, v = jnp.split(qkv, 3, axis=-1)
     # (B, S, D) -> (B, H, S, dh)
     to_heads = lambda t: t.reshape(b, s, cfg.num_heads, dh).transpose(0, 2, 1, 3)
     if cache is None:
+        if layout == "bshd":
+            # (B, S, H, dh) is a FREE reshape of the split slices; no head
+            # transposes materialize.
+            heads = lambda t: t.reshape(b, s, cfg.num_heads, dh)
+            attn = attend(heads(q), heads(k), heads(v)).reshape(b, s, cfg.d_model)
+            attn = nn.Dense(
+                cfg.d_model, dtype=cfg.compute_dtype, name="proj",
+                use_bias=cfg.use_bias,
+            )(attn)
+            if cfg.dropout_rate:
+                attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
+            return x + attn, None
         attn = attend(to_heads(q), to_heads(k), to_heads(v))
     else:
         # Cached decode (s tokens: 1 for the sampling loop, the whole
@@ -102,7 +157,10 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
         ).astype(qh.dtype)
         cache = {"k": ks, "v": vs, "len": cache["len"] + s}
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
-    attn = nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, name="proj")(attn)
+    attn = nn.Dense(
+        cfg.d_model, dtype=cfg.compute_dtype, name="proj",
+        use_bias=cfg.use_bias,
+    )(attn)
     if cfg.dropout_rate:
         attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
     return x + attn, cache
@@ -121,9 +179,15 @@ class Block(nn.Module):
         x, cache = attention_sublayer(cfg, x, attend, train=train, cache=cache)
 
         h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(x)
-        h = nn.Dense(cfg.d_ff, dtype=cfg.compute_dtype, name="mlp_in")(h)
+        h = nn.Dense(
+            cfg.d_ff, dtype=cfg.compute_dtype, name="mlp_in",
+            use_bias=cfg.use_bias,
+        )(h)
         h = nn.gelu(h)
-        h = nn.Dense(cfg.d_model, dtype=cfg.compute_dtype, name="mlp_out")(h)
+        h = nn.Dense(
+            cfg.d_model, dtype=cfg.compute_dtype, name="mlp_out",
+            use_bias=cfg.use_bias,
+        )(h)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         x = x + h
@@ -143,18 +207,25 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, positions=None, train: bool = False, cache=None):
         cfg = self.cfg
         b, s = tokens.shape
-        if positions is None:
-            # Cached decode continues at the filled prefix length; plain
-            # forward starts at 0.
-            start = cache["len"] if cache is not None else 0
-            positions = jnp.broadcast_to(start + jnp.arange(s, dtype=jnp.int32), (b, s))
+        pos_embed = nn.Embed(
+            cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
+        )
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="tok_embed")(
             tokens
         )
-        x = x + nn.Embed(
-            cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
-        )(positions)
-        attend = _attention_fn(cfg)
+        if positions is None:
+            # Cached decode continues at the filled prefix length; plain
+            # forward starts at 0. The lookup runs on the UNBATCHED (s,)
+            # positions and broadcasts: every batch row embeds the same
+            # positions, and the batched (b, s) gather made the backward a
+            # b·s-update scatter-add (1.75 ms/step on the flagship, XPlane
+            # r4) where an s-update scatter + the broadcast's reduce does
+            # the same job.
+            start = cache["len"] if cache is not None else 0
+            x = x + pos_embed(start + jnp.arange(s, dtype=jnp.int32))[None]
+        else:
+            x = x + pos_embed(positions)
+        attend = _attention_fn(cfg, prefer_packed=cache is None)
         if cache is None:
             # static_argnums count self at 0: attend (callable) and train
             # (bool) are compile-time constants. Param tree is unchanged —
@@ -174,7 +245,10 @@ class TransformerLM(nn.Module):
                 new_layers.append({"k": layer["k"], "v": layer["v"]})
             cache = {"layers": new_layers, "len": cache["len"] + s}
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
-        logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head",
+            use_bias=cfg.use_bias,
+        )(x)
         logits = logits.astype(jnp.float32)
         return logits if cache is None else (logits, cache)
 

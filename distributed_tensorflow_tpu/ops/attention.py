@@ -27,7 +27,7 @@ Three tiers, one semantics (causal or full softmax attention over
     dots + 1 softmax recompute per tile pair vs the two-pass
     FlashAttention-2 pair's 7 + 2 (measured v5e-1: flagship-shape kernel
     34 → 55% of bf16 peak, BASELINE.md). Sequences whose dq scratch
-    exceeds ``_FUSED_BWD_DQ_LIMIT`` run as fused q-SEGMENTS (partial dk/dv
+    exceeds ``_FUSED_BWD_SCRATCH_LIMIT`` run as fused q-SEGMENTS (partial dk/dv
     summed), and shapes with no clean segmentation fall back to the
     original two-pass pair. Block-sparse causal skipping everywhere.
 
@@ -203,11 +203,15 @@ def _flash_kernel(
 
     def compute():
         # MXU dots take the INPUT dtype operands (bf16 in training) with f32
-        # accumulation — upcasting q/k to f32 first would demote the matmul
-        # to the ~3x-slower f32 MXU path (measured: the whole fwd kernel sat
-        # at 51% of bf16 peak ≈ 2/(1 + 3), exactly one fast + one slow dot).
-        # Softmax statistics and the accumulator stay f32.
-        q = q_ref[0]  # (bq, D)
+        # accumulation — kept as standard practice; the measured benefit over
+        # upcasting to f32 first is small (~1-3%, BASELINE.md negative
+        # results — Mosaic handles the upcast well). Softmax statistics and
+        # the accumulator stay f32. The softmax scale is folded into the q
+        # TILE (block_q·D multiplies) instead of the logits (block_q·block_kv
+        # — 8x more VPU work at 1024-blocks/D=128); bf16 rounding of q·s is
+        # the FlashAttention-2 convention and is covered by the kernel-vs-
+        # dense parity tests.
+        q = (q_ref[0].astype(jnp.float32) * s).astype(q_ref.dtype)  # (bq, D)
         k_blk = k_ref[0]  # (bkv, D)
         v_blk = v_ref[0]
         logits = jax.lax.dot_general(
@@ -215,7 +219,7 @@ def _flash_kernel(
             k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * s  # (bq, bkv)
+        )  # (bq, bkv)
         if causal:
             q_pos = (
                 q_pos_offset
@@ -410,7 +414,9 @@ def _flash_bwd_dq_kernel(
         # takes the fast MXU path; p/ds are computed in f32 and cast back to
         # the operand dtype for their dots — the FlashAttention-2 recipe
         # (accumulation is f32 via preferred_element_type throughout).
-        q = q_ref[0]
+        # The q tile carries the softmax scale (same fold as the forward
+        # kernel, so the recomputed p matches it bitwise).
+        q = (q_ref[0].astype(jnp.float32) * s).astype(q_ref.dtype)
         k_blk = k_ref[0]
         v_blk = v_ref[0]
         do = do_ref[0]
@@ -419,7 +425,7 @@ def _flash_bwd_dq_kernel(
         logits = jax.lax.dot_general(
             q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * s
+        )
         if causal:
             q_pos = q_pos_offset + qi * bq + lax.broadcasted_iota(
                 jnp.int32, (bq, 1), 0
@@ -470,8 +476,10 @@ def _flash_bwd_dkv_kernel(
     def compute():
         # Same dtype discipline as the dq kernel: operand-dtype (bf16) MXU
         # dots, f32 softmax statistics and accumulators, p/ds cast back to
-        # the operand dtype before their dots.
-        q = q_ref[0]  # (bq, D)
+        # the operand dtype before their dots. q carries the softmax scale
+        # (matching the forward bitwise); dk's trailing ·s is absorbed by
+        # the scaled q: s·dSᵀ·q == dSᵀ·(q·s).
+        q = (q_ref[0].astype(jnp.float32) * s).astype(q_ref.dtype)  # (bq, D)
         k_blk = k_ref[0]  # (bkv, D)
         v_blk = v_ref[0]
         do = do_ref[0]
@@ -481,7 +489,7 @@ def _flash_bwd_dkv_kernel(
         logits = jax.lax.dot_general(
             q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * s  # (bq, bkv)
+        )  # (bq, bkv)
         if causal:
             q_pos = q_pos_offset + i * bq + lax.broadcasted_iota(
                 jnp.int32, (bq, 1), 0
@@ -498,10 +506,10 @@ def _flash_bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )
         ds = (p * (dp - delta)).astype(q.dtype)
-        dk_acc[...] += s * jax.lax.dot_general(
+        dk_acc[...] += jax.lax.dot_general(
             ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # dSᵀ·q: (bkv, D)
+        )  # dSᵀ·(q·s): (bkv, D)
 
     if causal:
         # Skip q tiles that end before this kv block starts (no query in the
@@ -519,8 +527,8 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_bwd_fused_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, out_ref,
+    dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc, delta_acc,
     *, num_q: int, num_kv: int, causal: bool, s: float,
     q_pos_offset: int,
 ):
@@ -531,12 +539,22 @@ def _flash_bwd_fused_kernel(
 
     vs the two-pass FlashAttention-2 scheme this computes each (q, kv) tile
     pair ONCE: 5 MXU dots + 1 softmax recompute instead of 7 + 2 (the qk
-    logits, exp and do·vᵀ were previously done in BOTH kernels). Bitwise
-    equal to the two-pass result: for fixed i the dq contributions arrive in
-    ascending-kj order, the same order the dq kernel's inner loop used.
+    logits, exp and do·vᵀ were previously done in BOTH kernels). For fixed i
+    the dq contributions arrive in ascending-kj order, the same order the dq
+    kernel's inner loop used.
 
-    The sq·D f32 dq scratch is the cost — callers gate on it fitting VMEM
-    (``_FUSED_BWD_DQ_LIMIT``) and fall back to the two-pass kernels."""
+    delta = rowsum(dO ∘ O) is computed IN-KERNEL during the first kv sweep
+    (kj == 0 visits every q tile — the causal skip never drops kv block 0)
+    into a whole-sequence VMEM scratch read by later cells. The XLA-side
+    alternative materializes a (B·H, Sq, 1) array whose trailing-1 tiled
+    layout pads 128x — a ~2 ms/step copy plus padded reads at the flagship
+    shape (XPlane r4). ``out`` blocks ride the q-side index map, PINNED to
+    block 0 after the kj==0 sweep so their DMA is elided where delta is
+    already known.
+
+    The sq·D f32 dq scratch plus the sq-row delta scratch are the cost —
+    callers gate on them fitting VMEM (``_FUSED_BWD_SCRATCH_LIMIT``) and
+    fall back to q-segmentation or the two-pass kernels."""
     kj = pl.program_id(1)
     i = pl.program_id(2)
     bkv = k_ref.shape[1]
@@ -551,17 +569,30 @@ def _flash_bwd_fused_kernel(
         dk_acc[...] = jnp.zeros(dk_acc.shape, dk_acc.dtype)
         dv_acc[...] = jnp.zeros(dv_acc.shape, dv_acc.dtype)
 
+    @pl.when(kj == 0)
+    def _compute_delta():
+        d_rows = jnp.sum(
+            do_ref[0].astype(jnp.float32) * out_ref[0].astype(jnp.float32),
+            axis=-1,
+            keepdims=True,
+        )  # (bq, 1)
+        delta_acc[pl.dslice(i * bq, bq), :] = jnp.broadcast_to(
+            d_rows, (bq, delta_acc.shape[1])
+        )
+
     def compute():
-        q = q_ref[0]  # (bq, D)
+        # q carries the softmax scale (matching the forward kernel bitwise);
+        # dk's trailing ·s is absorbed: s·dSᵀ·q == dSᵀ·(q·s).
+        q = (q_ref[0].astype(jnp.float32) * s).astype(q_ref.dtype)  # (bq, D)
         k_blk = k_ref[0]  # (bkv, D)
         v_blk = v_ref[0]
         do = do_ref[0]
         lse = lse_ref[0]  # (bq, 1)
-        delta = delta_ref[0]
+        delta = delta_acc[pl.dslice(i * bq, bq), :1]  # (bq, 1)
         logits = jax.lax.dot_general(
             q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * s  # (bq, bkv)
+        )  # (bq, bkv)
         if causal:
             q_pos = q_pos_offset + i * bq + lax.broadcasted_iota(
                 jnp.int32, (bq, 1), 0
@@ -578,10 +609,10 @@ def _flash_bwd_fused_kernel(
             preferred_element_type=jnp.float32,
         )
         ds = (p * (dp - delta)).astype(q.dtype)
-        dk_acc[...] += s * jax.lax.dot_general(
+        dk_acc[...] += jax.lax.dot_general(
             ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # dSᵀ·q: (bkv, D)
+        )  # dSᵀ·(q·s): (bkv, D)
         rows = pl.dslice(i * bq, bq)
         dq_acc[rows, :] += s * jax.lax.dot_general(
             ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
@@ -605,20 +636,32 @@ def _flash_bwd_fused_kernel(
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-# The fused backward holds a whole (sq, D) f32 dq range in VMEM scratch;
-# past this many VMEM BYTES for one call, the q axis is SEGMENTED into
-# fused calls of this size (or, if no clean segmentation exists, the
-# two-pass kernels take over). 2 MB ≈ sq 4096 at D=128 — together with the
-# (block, block) f32 intermediates that is comfortably inside a v5e core's
-# ~16 MB VMEM. Sized in TILED bytes: Mosaic pads the lane (last) dim to
-# 128, so a D=32 scratch occupies 4x its logical size (measured: a 16k
-# D=32 whole-sequence call hit 21 MB and failed to compile when this gate
+# The fused backward holds TWO whole-sequence VMEM scratches: the (sq, D)
+# f32 dq accumulator and the (sq, _STAT_LANES) f32 delta rows; past this
+# many TILED bytes for their sum, the q axis is SEGMENTED into fused calls
+# that fit (or, if no clean segmentation exists, the two-pass kernels take
+# over). 2 MB ≈ sq 2048 at D=128 (1 KB/row: 512 B dq + 512 B delta), the
+# same total whole-seq scratch the r3 dq-only kernel carried — segments
+# halve vs r3 (2048 rows, not 4096), paying a few extra partial-dk/dv adds
+# to fund the in-kernel delta. A 4 MB limit was measured OVER budget: the
+# 16k D=32 remat path's 4096-row segment hit 16.85 MB of scoped VMEM
+# (868 KB past the 16 MB limit) once the delta scratch and the pinned
+# ``out`` operand blocks joined the r3 layout. The limit is tuned JOINTLY
+# with the 1024/1024 default blocks: the resident per-tile f32
+# intermediates (logits/p/dp at (block_q, block_kv)) dominate VMEM at
+# several MB each, and Mosaic's buffer reuse is what makes the whole
+# kernel fit a v5e core's ~16 MB; this gate bounds only the part that
+# GROWS with sq, which is what the caller controls via segmentation. Sized
+# in TILED bytes: Mosaic pads the lane (last) dim to 128, so a D=32 dq
+# scratch occupies 4x its logical size (measured: a 16k D=32
+# whole-sequence call hit 21 MB and failed to compile when this gate
 # counted logical bytes).
-_FUSED_BWD_DQ_LIMIT = 2 * 1024 * 1024
+_FUSED_BWD_SCRATCH_LIMIT = 2 * 1024 * 1024
 
 
 def _dq_scratch_bytes_per_row(d: int) -> int:
-    return -(-d // 128) * 128 * 4  # f32, lane dim padded to a multiple of 128
+    # f32 dq row (lane dim padded to a multiple of 128) + f32 delta row.
+    return -(-d // 128) * 128 * 4 + _STAT_LANES * 4
 
 
 def _causal_q_index(q_pos_offset: int, block_q: int, block_kv: int, num_q: int):
@@ -638,10 +681,10 @@ def _causal_q_index(q_pos_offset: int, block_q: int, block_kv: int, num_q: int):
 
 def _fused_segment_rows(sq: int, d: int, block_q: int) -> int | None:
     """Largest q-segment length whose f32 dq scratch fits
-    ``_FUSED_BWD_DQ_LIMIT``: a multiple of ``block_q`` that divides ``sq``
+    ``_FUSED_BWD_SCRATCH_LIMIT``: a multiple of ``block_q`` that divides ``sq``
     evenly. None when no such segmentation exists (callers fall back to the
     two-pass kernels)."""
-    max_rows = _FUSED_BWD_DQ_LIMIT // _dq_scratch_bytes_per_row(d)
+    max_rows = _FUSED_BWD_SCRATCH_LIMIT // _dq_scratch_bytes_per_row(d)
     if block_q > max_rows:
         return None
     for n_seg in range(-(-sq // max_rows), sq + 1):  # smallest count first
@@ -670,13 +713,12 @@ def _flash_backward_fused(
     if q_pos_offset is None:
         q_pos_offset = skv - sq
 
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, skv, d)
     vf = v.reshape(b * h, skv, d)
     gf = g.reshape(b * h, sq, d)
+    outf = out.reshape(b * h, sq, d)
     lsef = lse.reshape(b * h, sq, 1)
-    deltaf = delta.reshape(b * h, sq, 1)
 
     if causal:
         q_index = _causal_q_index(q_pos_offset, block_q, block_kv, num_q)
@@ -689,6 +731,10 @@ def _flash_backward_fused(
     else:
         q_index = lambda bh, kj, i: (bh, i, 0)
         kv_index = lambda bh, kj, i: (bh, kj, 0)
+
+    # out is only read during the kj==0 sweep (in-kernel delta); pinning the
+    # index afterwards elides its DMA for every later cell.
+    out_index = lambda bh, kj, i: (bh, jnp.where(kj == 0, i, 0), 0)
 
     dq, dk, dv = pl.pallas_call(
         functools.partial(
@@ -703,7 +749,7 @@ def _flash_backward_fused(
             pl.BlockSpec((1, block_kv, d), kv_index),
             pl.BlockSpec((1, block_q, d), q_index),
             pl.BlockSpec((1, block_q, 1), q_index),
-            pl.BlockSpec((1, block_q, 1), q_index),
+            pl.BlockSpec((1, block_q, d), out_index),
         ],
         out_specs=[
             pl.BlockSpec((1, sq, d), lambda bh, kj, i: (bh, 0, 0)),
@@ -719,9 +765,10 @@ def _flash_backward_fused(
             pltpu.VMEM((sq, d), jnp.float32),
             pltpu.VMEM((block_kv, d), jnp.float32),
             pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((sq, _STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, gf, lsef, deltaf)
+    )(qf, kf, vf, gf, lsef, outf)
 
     return (
         dq.reshape(b, h, sq, d),
@@ -736,7 +783,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
     s = _scale(q, scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_DQ_LIMIT:
+    if sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_SCRATCH_LIMIT:
         return _flash_backward_fused(
             q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
         )
@@ -857,6 +904,513 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
     )
 
 
+# ---------------------------------------------------------------------------
+# BSHD (activation-layout-native) wrappers: the SAME kernel bodies, with
+# grids/index maps that read and write the (B, S, H·dh) layout directly.
+#
+# Motivation (measured v5e-1, tools/attn_probe.py): the attention sublayer
+# minus the flash call runs at ~99% of bf16 peak — ln1/qkv/proj and even the
+# head transposes fuse perfectly — but inserting the Pallas custom call
+# forces every (B,S,H,D)<->(B,H,S,D) layout change to MATERIALIZE (XLA
+# cannot fuse through a custom call): ~8 extra 100 MB HBM passes per layer
+# at the flagship shape, ~40 ms/step of pure boundary cost. These wrappers
+# delete ALL of them: q/k/v arrive as a free reshape of the qkv matmul
+# output, and each grid cell's (1, block, dh) block is a strided slab the
+# DMA engine gathers directly (256 B rows at dh=128 — measured as fast as
+# the contiguous BHSD fetch, tools/bshd_probe.py: bitwise-equal output,
+# kernel time equal or better).
+#
+# Constraint: blocks on the lane (last) dim must be 128-aligned, so the
+# fast path needs dh % 128 == 0; other head dims transpose-fallback to the
+# BHSD path (exactly the pre-existing behavior).
+# ---------------------------------------------------------------------------
+
+
+def _bshd_maps(h: int, base_q=None, base_kv=None):
+    """Lift 3D (bh, i, j)->(bh, blk, 0) index maps onto a (B, S, H*dh) array:
+    same grid, but dim 0 splits into (batch = bh // h, head-column = bh % h)."""
+
+    def q_index(bh, i, j):
+        blk = i if base_q is None else base_q(bh, i, j)[1]
+        return (bh // h, blk, bh % h)
+
+    def kv_index(bh, i, j):
+        blk = j if base_kv is None else base_kv(bh, i, j)[1]
+        return (bh // h, blk, bh % h)
+
+    return q_index, kv_index
+
+
+def _flash_forward_bshd(
+    q, k, v, causal, block_q, block_kv, scale, interpret, with_lse: bool = False
+):
+    """q, k, v: (B, S, H, dh) — the layout the qkv projection produces.
+    Returns out in the same layout (and lse as (B*H, Sq, 1) when asked)."""
+    if not HAVE_PALLAS:
+        raise RuntimeError(
+            "jax.experimental.pallas unavailable — use blockwise_attention instead"
+        )
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not interpret and d % 128:
+        # Mosaic requires lane-dim blocks in 128 multiples when the block is
+        # narrower than the array; odd head dims take the transpose path.
+        bhsd = lambda t: t.transpose(0, 2, 1, 3)
+        res = _flash_forward(
+            bhsd(q), bhsd(k), bhsd(v), causal, block_q, block_kv, scale,
+            interpret, with_lse=with_lse,
+        )
+        if with_lse:
+            out, lse = res
+            return out.transpose(0, 2, 1, 3), lse.reshape(b * h, sq, 1)
+        return res.transpose(0, 2, 1, 3)
+    s = _scale(q, scale)
+    block_q = _fit_block(block_q, sq, interpret)
+    block_kv = _fit_block(block_kv, skv, interpret)
+    num_kv = skv // block_kv
+    qf = q.reshape(b, sq, h * d)  # free: same memory layout
+    kf = k.reshape(b, skv, h * d)
+    vf = v.reshape(b, skv, h * d)
+    kernel = functools.partial(
+        _flash_kernel,
+        block_kv=block_kv,
+        num_kv=num_kv,
+        causal=causal,
+        s=s,
+        q_pos_offset=skv - sq,
+    )
+    base_kv = (
+        _causal_kv_index(skv - sq, block_q, block_kv, num_kv) if causal else None
+    )
+    q_index, kv_index = _bshd_maps(h, base_kv=base_kv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, h * d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(b, sq, h, d)
+    if with_lse:
+        return out, lse
+    return out
+
+
+def _flash_backward_fused_bshd(
+    q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
+    q_pos_offset: int | None = None,
+):
+    """Fused one-pass backward reading/writing (B, S, H, dh) directly.
+    ``lse`` is the forward's (B*H, Sq, 1) statistic."""
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    s = _scale(q, scale)
+    block_q = _fit_block(block_q, sq, interpret)
+    block_kv = _fit_block(block_kv, skv, interpret)
+    num_q, num_kv = sq // block_q, skv // block_kv
+    if q_pos_offset is None:
+        q_pos_offset = skv - sq
+
+    qf = q.reshape(b, sq, h * d)
+    kf = k.reshape(b, skv, h * d)
+    vf = v.reshape(b, skv, h * d)
+    gf = g.reshape(b, sq, h * d)
+    outf = out.reshape(b, sq, h * d)
+
+    base_q = (
+        _causal_q_index(q_pos_offset, block_q, block_kv, num_q) if causal else None
+    )
+    if causal:
+        last_kv = max(0, min(num_kv - 1, (q_pos_offset + sq - 1) // block_kv))
+        base_kv = lambda bh, kj, i: (bh, jnp.minimum(kj, last_kv), 0)
+    else:
+        base_kv = None
+    # Fused grid is (bh, kj, i): q-side blocks key on i (3rd grid axis),
+    # kv-side on kj (2nd) — mirror _flash_backward_fused's maps.
+    def q_index(bh, kj, i):
+        blk = i if base_q is None else base_q(bh, kj, i)[1]
+        return (bh // h, blk, bh % h)
+
+    def stat_index(bh, kj, i):
+        blk = i if base_q is None else base_q(bh, kj, i)[1]
+        return (bh, blk, 0)
+
+    def kv_index(bh, kj, i):
+        blk = kj if base_kv is None else base_kv(bh, kj, i)[1]
+        return (bh // h, blk, bh % h)
+
+    def out_index(bh, kj, i):
+        # Read only during the kj==0 sweep (in-kernel delta); pinned after.
+        return (bh // h, jnp.where(kj == 0, i, 0), bh % h)
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_fused_kernel,
+            num_q=num_q, num_kv=num_kv, causal=causal, s=s,
+            q_pos_offset=q_pos_offset,
+        ),
+        grid=(b * h, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, 1), stat_index),
+            pl.BlockSpec((1, block_q, d), out_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh, kj, i: (bh // h, 0, bh % h)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh // h, kj, bh % h)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh // h, kj, bh % h)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, h * d), q.dtype),
+            jax.ShapeDtypeStruct((b, skv, h * d), k.dtype),
+            jax.ShapeDtypeStruct((b, skv, h * d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((sq, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((sq, _STAT_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, outf)
+
+    return (
+        dq.reshape(b, sq, h, d),
+        dk.reshape(b, skv, h, d),
+        dv.reshape(b, skv, h, d),
+    )
+
+
+def _flash_backward_bshd(
+    q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
+):
+    b, sq, h, d = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def via_bhsd():
+        # Transpose fallback (the pre-BSHD behavior, bit-identical results):
+        # used for odd head dims (lane-alignment gate, same as the forward)
+        # and for shapes with no clean q-segmentation.
+        bhsd = lambda t: t.transpose(0, 2, 1, 3)
+        dq, dk, dv = _flash_backward(
+            bhsd(q), bhsd(k), bhsd(v), bhsd(out), lse.reshape(b, h, sq),
+            bhsd(g), causal, block_q, block_kv, scale, interpret,
+        )
+        return bhsd(dq), bhsd(dk), bhsd(dv)
+
+    if not interpret and d % 128:
+        return via_bhsd()
+    if sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_SCRATCH_LIMIT:
+        return _flash_backward_fused_bshd(
+            q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
+        )
+    seg = _fused_segment_rows(sq, d, _fit_block(block_q, sq, interpret))
+    if seg is not None:
+        # Same q-segmentation as _flash_backward, sliced on the S axis of the
+        # BSHD layout (lse rows are the matching (B*H, seg, 1) slices).
+        skv = k.shape[1]
+        offset0 = skv - sq
+        dqs, dk_tot, dv_tot = [], None, None
+        for a in range(0, sq, seg):
+            dq_s, dk_s, dv_s = _flash_backward_fused_bshd(
+                q[:, a : a + seg],
+                k,
+                v,
+                out[:, a : a + seg],
+                lse[:, a : a + seg],
+                g[:, a : a + seg],
+                causal,
+                block_q,
+                block_kv,
+                scale,
+                interpret,
+                q_pos_offset=offset0 + a,
+            )
+            dqs.append(dq_s)
+            dk_tot = dk_s if dk_tot is None else dk_tot + dk_s
+            dv_tot = dv_s if dv_tot is None else dv_tot + dv_s
+        return jnp.concatenate(dqs, axis=1), dk_tot, dv_tot
+    # No clean segmentation: two-pass BHSD pair via transposes (rare shapes).
+    return via_bhsd()
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_bshd(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: int = 1024,
+    block_kv: int = 1024,
+    scale: float | None = None,
+    interpret: bool | None = None,
+):
+    """:func:`flash_attention` on the ACTIVATION layout: q, k, v and the
+    result are (B, S, H, head_dim) — a free reshape of the qkv projection's
+    (B, S, 3·d_model) output — so callers never materialize the
+    (B,H,S,D) transposes a custom call would otherwise force (module
+    docstring has the measured motivation). Semantics, blocks, causal
+    end-alignment, segmentation and fallbacks are identical to
+    :func:`flash_attention`; head dims not divisible by 128 transparently
+    take the transpose path."""
+    return _flash_forward_bshd(q, k, v, causal, block_q, block_kv, scale, interpret)
+
+
+def _flash_bshd_fwd(q, k, v, causal, block_q, block_kv, scale, interpret):
+    out, lse = _flash_forward_bshd(
+        q, k, v, causal, block_q, block_kv, scale, interpret, with_lse=True
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bshd_bwd(causal, block_q, block_kv, scale, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    return _flash_backward_bshd(
+        q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
+    )
+
+
+flash_attention_bshd.defvjp(_flash_bshd_fwd, _flash_bshd_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Packed-qkv self-attention: one step further than BSHD — the kernel's
+# operand IS the qkv projection's (B, S, 3·d_model) output, passed three
+# times with index maps that pick the q / k / v column sections per head.
+# The XLA `split` that otherwise materializes three (B, S, d_model) operand
+# copies at the custom-call boundary (measured 6.2 ms/step on the flagship,
+# XPlane r4) never exists. Self-attention only (Sq == Skv by construction).
+# ---------------------------------------------------------------------------
+
+
+def _unpack_qkv(qkv, h):
+    b, sq, three_d = qkv.shape
+    dm = three_d // 3
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shd = lambda t: t.reshape(b, sq, h, dm // h)
+    return shd(q), shd(k), shd(v)
+
+
+def _flash_forward_qkv(
+    qkv, h, causal, block_q, block_kv, scale, interpret, with_lse: bool = False
+):
+    """qkv: (B, S, 3·d_model), columns [q | k | v], heads contiguous within
+    each section. Returns out (B, S, d_model) (+ lse (B·H, S, 1))."""
+    if not HAVE_PALLAS:
+        raise RuntimeError(
+            "jax.experimental.pallas unavailable — use blockwise_attention instead"
+        )
+    b, sq, three_d = qkv.shape
+    dm = three_d // 3
+    d = dm // h
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not interpret and d % 128:
+        q, k, v = _unpack_qkv(qkv, h)
+        res = _flash_forward_bshd(
+            q, k, v, causal, block_q, block_kv, scale, interpret, with_lse=with_lse
+        )
+        if with_lse:
+            out, lse = res
+            return out.reshape(b, sq, dm), lse
+        return res.reshape(b, sq, dm)
+    s = (1.0 / math.sqrt(d)) if scale is None else scale
+    block_q = _fit_block(block_q, sq, interpret)
+    block_kv = _fit_block(block_kv, sq, interpret)
+    num_kv = sq // block_kv
+    kernel = functools.partial(
+        _flash_kernel,
+        block_kv=block_kv,
+        num_kv=num_kv,
+        causal=causal,
+        s=s,
+        q_pos_offset=0,
+    )
+    base_kv = _causal_kv_index(0, block_q, block_kv, num_kv) if causal else None
+
+    def q_index(bh, i, j):
+        return (bh // h, i, bh % h)
+
+    def k_index(bh, i, j):
+        blk = j if base_kv is None else base_kv(bh, i, j)[1]
+        return (bh // h, blk, h + bh % h)
+
+    def v_index(bh, i, j):
+        blk = j if base_kv is None else base_kv(bh, i, j)[1]
+        return (bh // h, blk, 2 * h + bh % h)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_kv, d), k_index),
+            pl.BlockSpec((1, block_kv, d), v_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, dm), qkv.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qkv, qkv, qkv)
+    if with_lse:
+        return out, lse
+    return out
+
+
+def _flash_backward_qkv(
+    qkv, h, out, lse, g, causal, block_q, block_kv, scale, interpret
+):
+    b, sq, three_d = qkv.shape
+    dm = three_d // 3
+    d = dm // h
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    fits_fused = sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_SCRATCH_LIMIT
+    if (not interpret and d % 128) or not fits_fused:
+        # Odd head dims or segmented/two-pass shapes: unpack once and take
+        # the BSHD backward (which handles segmentation and fallbacks); the
+        # packed fast path exists for shapes that fit ONE fused call —
+        # q-segmenting a packed array would slice k/v along with q.
+        q, k, v = _unpack_qkv(qkv, h)
+        dq, dk, dv = _flash_backward_bshd(
+            q, k, v, out.reshape(b, sq, h, d), lse, g.reshape(b, sq, h, d),
+            causal, block_q, block_kv, scale, interpret,
+        )
+        flat = lambda t: t.reshape(b, sq, dm)
+        return jnp.concatenate([flat(dq), flat(dk), flat(dv)], axis=-1)
+    s = (1.0 / math.sqrt(d)) if scale is None else scale
+    block_q = _fit_block(block_q, sq, interpret)
+    block_kv = _fit_block(block_kv, sq, interpret)
+    num_q, num_kv = sq // block_q, sq // block_kv
+
+    base_q = _causal_q_index(0, block_q, block_kv, num_q) if causal else None
+
+    def q_index(bh, kj, i):
+        blk = i if base_q is None else base_q(bh, kj, i)[1]
+        return (bh // h, blk, bh % h)
+
+    def stat_index(bh, kj, i):
+        blk = i if base_q is None else base_q(bh, kj, i)[1]
+        return (bh, blk, 0)
+
+    def k_index(bh, kj, i):
+        return (bh // h, kj, h + bh % h)
+
+    def v_index(bh, kj, i):
+        return (bh // h, kj, 2 * h + bh % h)
+
+    def out_index(bh, kj, i):
+        # Read only during the kj==0 sweep (in-kernel delta); pinned after.
+        return (bh // h, jnp.where(kj == 0, i, 0), bh % h)
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_fused_kernel,
+            num_q=num_q, num_kv=num_kv, causal=causal, s=s, q_pos_offset=0,
+        ),
+        grid=(b * h, num_kv, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_kv, d), k_index),
+            pl.BlockSpec((1, block_kv, d), v_index),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, 1), stat_index),
+            pl.BlockSpec((1, block_q, d), out_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh, kj, i: (bh // h, 0, bh % h)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh // h, kj, bh % h)),
+            pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh // h, kj, bh % h)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, dm), qkv.dtype),
+            jax.ShapeDtypeStruct((b, sq, dm), qkv.dtype),
+            jax.ShapeDtypeStruct((b, sq, dm), qkv.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((sq, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((sq, _STAT_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qkv, qkv, qkv, g, lse, out)
+    return jnp.concatenate([dq, dk, dv], axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+def flash_attention_qkv(
+    qkv,
+    num_heads: int,
+    causal: bool = False,
+    block_q: int = 1024,
+    block_kv: int = 1024,
+    scale: float | None = None,
+    interpret: bool | None = None,
+):
+    """Flash SELF-attention on the packed qkv projection output: ``qkv`` is
+    (B, S, 3·d_model) with columns [q | k | v] (``jnp.split`` thirds, heads
+    contiguous within each third — exactly what a fused Dense(3·d_model)
+    produces). Returns (B, S, d_model). Same kernels, blocks, causal
+    semantics and fallbacks as :func:`flash_attention`; the gradient
+    arrives as one packed (B, S, 3·d_model) cotangent that feeds the qkv
+    matmul backward directly."""
+    return _flash_forward_qkv(
+        qkv, num_heads, causal, block_q, block_kv, scale, interpret
+    )
+
+
+def _flash_qkv_fwd(qkv, h, causal, block_q, block_kv, scale, interpret):
+    out, lse = _flash_forward_qkv(
+        qkv, h, causal, block_q, block_kv, scale, interpret, with_lse=True
+    )
+    return out, (qkv, out, lse)
+
+
+def _flash_qkv_bwd(h, causal, block_q, block_kv, scale, interpret, residuals, g):
+    qkv, out, lse = residuals
+    return (
+        _flash_backward_qkv(
+            qkv, h, out, lse, g, causal, block_q, block_kv, scale, interpret
+        ),
+    )
+
+
+flash_attention_qkv.defvjp(_flash_qkv_fwd, _flash_qkv_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q,
@@ -871,7 +1425,7 @@ def flash_attention(
     """Pallas flash-attention (TPU; interpret-mode elsewhere): forward with
     online softmax in VMEM scratch; backward is the fused one-pass kernel
     (dq in a whole-sequence f32 VMEM scratch, q-segmented past
-    ``_FUSED_BWD_DQ_LIMIT``, two-pass FlashAttention-2 fallback) — see
+    ``_FUSED_BWD_SCRATCH_LIMIT``, two-pass FlashAttention-2 fallback) — see
     :func:`_flash_backward`. O(S·block) memory in both directions plus the
     backward's ≤2 MB dq scratch, block-sparse causal skipping throughout.
 

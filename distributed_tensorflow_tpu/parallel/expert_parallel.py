@@ -215,7 +215,7 @@ class MoeTransformerLM(nn.Module):
         x = x + nn.Embed(
             cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
         )(positions)
-        attend = _attention_fn(cfg)
+        attend = _attention_fn(cfg, prefer_packed=True)
         aux_total = jnp.zeros((), jnp.float32)
         # cfg.remat: recompute each block on backward. The all_to_all token
         # exchange replays identically on every shard (pure function of the
@@ -233,7 +233,10 @@ class MoeTransformerLM(nn.Module):
             )(x, attend, train)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
-        logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head",
+            use_bias=getattr(cfg, "use_bias", True),
+        )(x)
         return logits.astype(jnp.float32), aux_total / cfg.num_layers
 
 

@@ -173,8 +173,11 @@ def build_pp_lm_train_step(
     embed_mod = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype)
     pos_mod = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype)
     ln_f = nn.LayerNorm(dtype=cfg.compute_dtype)
-    head = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype)
-    attend = _attention_fn(cfg)
+    head = nn.Dense(
+        cfg.vocab_size, dtype=cfg.compute_dtype,
+        use_bias=getattr(cfg, "use_bias", True),
+    )
+    attend = _attention_fn(cfg, prefer_packed=True)
     M = num_microbatches
 
     def forward(params, tokens, rng_drop):

@@ -115,16 +115,22 @@ class TpBlock(nn.Module):
         # Column-parallel projections: local kernels (D, D/tp) produce this
         # shard's heads directly — no communication in the forward here.
         # (features are the LOCAL width: flax validates stored-param shapes.)
-        q = nn.Dense(cfg.d_model // tp, dtype=d, name="q")(h)
-        k = nn.Dense(cfg.d_model // tp, dtype=d, name="k")(h)
-        v = nn.Dense(cfg.d_model // tp, dtype=d, name="v")(h)
+        bias = getattr(cfg, "use_bias", True)
+        q = nn.Dense(cfg.d_model // tp, dtype=d, name="q", use_bias=bias)(h)
+        k = nn.Dense(cfg.d_model // tp, dtype=d, name="k", use_bias=bias)(h)
+        v = nn.Dense(cfg.d_model // tp, dtype=d, name="v", use_bias=bias)(h)
         to_heads = lambda t: t.reshape(b, s, local_heads, dh).transpose(0, 2, 1, 3)
         attn = attend(to_heads(q), to_heads(k), to_heads(v))
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, local_heads * dh)
         # Row-parallel output projection: partial sums -> THE tp collective.
+        # (proj/mlp_out biases, when enabled, are added AFTER the psum so
+        # they aren't summed tp times — hence the explicit params.)
         attn = nn.Dense(cfg.d_model, use_bias=False, dtype=d, name="proj")(attn)
         attn = _reduce_from_tp(attn, self.tp_axis)
-        attn = attn + self.param("proj_bias", nn.initializers.zeros, (cfg.d_model,), jnp.float32).astype(d)
+        if bias:
+            attn = attn + self.param(
+                "proj_bias", nn.initializers.zeros, (cfg.d_model,), jnp.float32
+            ).astype(d)
         # Dropout on the REPLICATED (post-psum) activation: every model shard
         # draws the same mask from the same key, so tp parity is exact.
         if cfg.dropout_rate:
@@ -132,11 +138,14 @@ class TpBlock(nn.Module):
         x = x + attn
 
         h = _copy_to_tp(nn.LayerNorm(dtype=d, name="ln2")(x), self.tp_axis)
-        h = nn.Dense(cfg.d_ff // tp, dtype=d, name="mlp_in")(h)  # (D, F/tp) local
+        h = nn.Dense(cfg.d_ff // tp, dtype=d, name="mlp_in", use_bias=bias)(h)
         h = nn.gelu(h)
         h = nn.Dense(cfg.d_model, use_bias=False, dtype=d, name="mlp_out")(h)
         h = _reduce_from_tp(h, self.tp_axis)
-        h = h + self.param("mlp_out_bias", nn.initializers.zeros, (cfg.d_model,), jnp.float32).astype(d)
+        if bias:
+            h = h + self.param(
+                "mlp_out_bias", nn.initializers.zeros, (cfg.d_model,), jnp.float32
+            ).astype(d)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         return x + h
@@ -172,7 +181,10 @@ class TpTransformerLM(nn.Module):
         for i in range(cfg.num_layers):
             x = block_cls(cfg, tp_axis=self.tp_axis, name=f"block_{i}")(x, attend, train)
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
-        logits = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head",
+            use_bias=getattr(cfg, "use_bias", True),
+        )(x)
         return logits.astype(jnp.float32)
 
 

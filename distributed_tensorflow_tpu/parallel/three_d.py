@@ -128,7 +128,10 @@ def build_3d_lm_train_step(
     embed_mod = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype)
     pos_mod = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype)
     ln_f = nn.LayerNorm(dtype=cfg.compute_dtype)
-    head = nn.Dense(cfg.vocab_size, dtype=cfg.compute_dtype)
+    head = nn.Dense(
+        cfg.vocab_size, dtype=cfg.compute_dtype,
+        use_bias=getattr(cfg, "use_bias", True),
+    )
     attend = _attention_fn(cfg)
     M = num_microbatches
 

@@ -257,9 +257,9 @@ def test_flash_backward_mixed_masked_tile():
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_backward_segmented_matches_whole(monkeypatch, causal):
     """q-segmented fused backward (sequence too long for one dq scratch):
-    shrinking _FUSED_BWD_DQ_LIMIT forces the segment loop, whose grads must
-    match the single-call fused path bit-for-bit in dq (disjoint row ranges)
-    and to adds-only reassociation in dk/dv (partial sums)."""
+    shrinking _FUSED_BWD_SCRATCH_LIMIT forces the segment loop, whose grads
+    must match the single-call fused path bit-for-bit in dq (disjoint row
+    ranges) and to adds-only reassociation in dk/dv (partial sums)."""
     q, k, v = _qkv(s=64, d=8)
     g = jnp.asarray(np.random.default_rng(7).standard_normal(q.shape), q.dtype)
 
@@ -272,9 +272,9 @@ def test_flash_backward_segmented_matches_whole(monkeypatch, causal):
         )(q, k, v)
 
     whole = grads()
-    # d=8 pads to 128 lanes -> 512 B/row of scratch; cap at 16 rows' worth
-    # so the 64-row sequence splits into four 16-row segments.
-    monkeypatch.setattr(A, "_FUSED_BWD_DQ_LIMIT", 16 * 512)
+    # d=8 pads to 128 lanes -> 512 B/row of dq scratch + 512 B/row of delta
+    # scratch; cap at 16 rows' worth so 64 rows split into four segments.
+    monkeypatch.setattr(A, "_FUSED_BWD_SCRATCH_LIMIT", 16 * 1024)
     assert A._fused_segment_rows(64, 8, 16) == 16
     seg = grads()
     np.testing.assert_array_equal(np.asarray(whole[0]), np.asarray(seg[0]))
@@ -285,11 +285,122 @@ def test_flash_backward_segmented_matches_whole(monkeypatch, causal):
 def test_fused_segment_rows_choices():
     """Segment chooser: largest block-multiple divisor under the VMEM cap;
     None when the requested block alone exceeds it (two-pass fallback)."""
-    limit_rows = A._FUSED_BWD_DQ_LIMIT // (128 * 4)  # 4096: lane dim >= 128
-    assert A._fused_segment_rows(4096, 128, 1024) == 4096
+    # 2048 rows at D<=128: 512 B/row lane-padded dq + 512 B/row delta.
+    limit_rows = A._FUSED_BWD_SCRATCH_LIMIT // (2 * 128 * 4)
+    assert limit_rows == 2048
+    assert A._fused_segment_rows(2048, 128, 1024) == 2048
     assert A._fused_segment_rows(8192, 128, 1024) == limit_rows
     # D=64 pads to 128 lanes, so its cap matches D=128's, not double it.
-    assert A._fused_segment_rows(65536, 64, 1024) == 4096
+    assert A._fused_segment_rows(65536, 64, 1024) == 2048
     assert A._fused_segment_rows(8192, 128, 8192) is None
-    # No block-multiple divisor under the cap: 3 * 4096 at D=128 splits 3x.
-    assert A._fused_segment_rows(12288, 128, 1024) == 4096
+    # No block-multiple divisor under the cap: 6 * 2048 at D=128 splits 6x.
+    assert A._fused_segment_rows(12288, 128, 1024) == 2048
+
+
+# ---------------------------------------------------------------------------
+# Layout-native entries (r4): BSHD and packed-qkv wrappers share the BHSD
+# kernel bodies — only grids/index maps differ — so values and grads must
+# match the BHSD path bitwise.
+# ---------------------------------------------------------------------------
+
+
+def _bshd(t):
+    return t.transpose(0, 2, 1, 3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bshd_matches_bhsd_bitwise(causal):
+    q, k, v = _qkv(s=64, d=16)
+    qs, ks, vs = (_bshd(t) for t in (q, k, v))
+
+    out1 = A.flash_attention_bshd(qs, ks, vs, causal=causal, block_q=16, block_kv=16)
+    out2 = A.flash_attention(q, k, v, causal=causal, block_q=16, block_kv=16)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(_bshd(out2)))
+
+    # Grads under the SAME elementwise cotangent (2·out); a scalar loss like
+    # sum(out²) would reduce in layout order and differ by f32 reassociation.
+    def loss_bshd(q, k, v):
+        return jnp.sum(
+            A.flash_attention_bshd(q, k, v, causal=causal, block_q=16, block_kv=16)
+            ** 2
+        )
+
+    def loss_bhsd(q, k, v):
+        return jnp.sum(
+            A.flash_attention(q, k, v, causal=causal, block_q=16, block_kv=16) ** 2
+        )
+
+    g1 = jax.grad(loss_bshd, argnums=(0, 1, 2))(qs, ks, vs)
+    g2 = jax.grad(loss_bhsd, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(_bshd(b)))
+
+
+def test_flash_bshd_decode_alignment():
+    """sq != skv end-aligned causal (the decode convention) holds in BSHD."""
+    q, k, v = _qkv(s=48, d=16)
+    out = A.flash_attention_bshd(
+        _bshd(q)[:, :16], _bshd(k), _bshd(v), causal=True, block_q=8, block_kv=16
+    )
+    ref = A.dense_attention(q[:, :, :16], k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(_bshd(out)), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_packed_qkv_matches_bhsd(causal):
+    """flash_attention_qkv consumes the fused (B, S, 3·d_model) projection
+    output; its packed cotangent must equal the concatenated per-tensor
+    grads of the BHSD path."""
+    b, h, s, d = 2, 3, 64, 16
+    r = np.random.default_rng(3)
+    qkv = jnp.asarray(r.standard_normal((b, s, 3 * h * d)), jnp.float32)
+    g_out = jnp.asarray(r.standard_normal((b, s, h * d)), jnp.float32)
+
+    def loss_packed(qkv):
+        return jnp.sum(
+            A.flash_attention_qkv(qkv, h, causal=causal, block_q=16, block_kv=16)
+            * g_out
+        )
+
+    def loss_ref(qkv):
+        q, k, v = (
+            t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+            for t in jnp.split(qkv, 3, axis=-1)
+        )
+        out = A.flash_attention(q, k, v, causal=causal, block_q=16, block_kv=16)
+        return jnp.sum(out.transpose(0, 2, 1, 3).reshape(b, s, h * d) * g_out)
+
+    v1, g1 = jax.value_and_grad(loss_packed)(qkv)
+    v2, g2 = jax.value_and_grad(loss_ref)(qkv)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fused_matches_two_pass(monkeypatch, causal):
+    """The fused one-pass backward vs the two-pass FlashAttention-2 pair
+    (forced by making segmentation unavailable): same grads. Tight allclose,
+    not bitwise — the fused kernel computes delta in-kernel while the
+    two-pass path sums it in XLA, a benign f32 reassociation."""
+    q, k, v = _qkv(s=64, d=8)
+    gcot = jnp.asarray(np.random.default_rng(9).standard_normal(q.shape), q.dtype)
+
+    def grads():
+        return jax.grad(
+            lambda q, k, v: jnp.sum(
+                A.flash_attention(q, k, v, causal=causal, block_q=16, block_kv=16)
+                * gcot
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+
+    fused = grads()
+    monkeypatch.setattr(A, "_FUSED_BWD_SCRATCH_LIMIT", 0)
+    monkeypatch.setattr(A, "_fused_segment_rows", lambda *a: None)
+    two_pass = grads()
+    for a, b in zip(fused, two_pass):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        )

@@ -53,5 +53,53 @@ def test_bench_emits_one_json_line_with_extra_metrics():
     assert not [k for k in extra if k.endswith("_error")], extra
     assert extra["lm_train_tokens_per_sec_per_chip"]["value"] > 0
     assert extra["mnist_synthetic_test_accuracy"]["value"] >= 0.5
-    assert extra["vit_e2e_test_accuracy"]["value"] >= 0.5
+    # ViT on the bundled REAL t10k digits; 60 smoke steps just needs to beat
+    # 10-class chance convincingly (the TPU run trains 2000 and is floored
+    # at 0.90 by bench.FLOORS).
+    assert extra["vit_real_test_accuracy"]["value"] >= 0.3
     # CPU backend: no MFU (unknown peak) and no Mosaic kernel timings.
+
+
+def test_floor_gate_flags_regressions_and_missing_metrics():
+    """bench.FLOORS is a gate: a below-floor value or a MISSING floored
+    metric must be reported (VERDICT r3 #1 — r3's retrain miss at 0.6481
+    sat silently in the record)."""
+    sys.path.insert(0, _REPO)
+    import bench
+
+    good = [{"metric": k, "value": v + 0.05} for k, v in bench.FLOORS.items()]
+    assert bench.enforce_floors(good) == []
+    injected = [dict(m) for m in good]
+    injected[0]["value"] = bench.FLOORS[injected[0]["metric"]] - 0.01
+    problems = bench.enforce_floors(injected)
+    assert len(problems) == 1 and injected[0]["metric"] in problems[0]
+    # A floored metric that never made it into the record is a violation
+    # too — a crashed accuracy bench must not read as a pass.
+    assert len(bench.enforce_floors(good[1:])) == 1
+
+
+def test_floor_gate_exits_nonzero_end_to_end():
+    """`python bench.py` itself must exit nonzero when floors are enforced
+    and violated. The headline suite records no accuracy metrics, so every
+    floored metric is missing — the cheapest end-to-end injected failure."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_SMOKE="1",
+        BENCH_SUITE="headline",
+        BENCH_ENFORCE_FLOORS="1",
+        BENCH_WARMUP_STEPS="1",
+        BENCH_TIMED_STEPS="4",
+        BENCH_STEPS_PER_CALL="2",
+        DTF_COMPILATION_CACHE="0",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode != 0
+    assert "FLOOR VIOLATION" in proc.stderr
+    # The record still prints (the driver parses stdout before rc).
+    assert json.loads(proc.stdout.strip().splitlines()[-1])["metric"]

@@ -1,0 +1,138 @@
+"""Validate BSHD-native flash fwd kernel specs before committing the design.
+
+Times the existing _flash_kernel body with (a) today's BHSD specs and (b)
+BSHD specs that index directly into a (B, S, H*dh) array — same body, only
+grids/index maps differ. If strided DMA holds up, the sublayer can drop all
+materialized head transposes.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import bench
+from distributed_tensorflow_tpu.ops import attention as A
+from distributed_tensorflow_tpu.utils.flops import chip_peak_flops
+
+B, H, S, dh = 12, 16, 2048, 128
+bq = bkv = 1024
+num_q, num_kv = S // bq, S // bkv
+s = 1.0 / np.sqrt(dh)
+peak = chip_peak_flops()
+drain = lambda x: jax.device_get(x)
+
+
+def bshd_forward(q, k, v):
+    """q, k, v: (B, S, H*dh). Returns out (B, S, H*dh), lse (B*H, S, 1)."""
+    kernel = functools.partial(
+        A._flash_kernel, block_kv=bkv, num_kv=num_kv, causal=True, s=s, q_pos_offset=0
+    )
+
+    def q_index(bh, i, j):
+        return (bh // H, i, bh % H)
+
+    def kv_index(bh, i, j):
+        last_block = jnp.clip(((i + 1) * bq - 1) // bkv, 0, num_kv - 1)
+        return (bh // H, jnp.minimum(j, last_block), bh % H)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), q_index),
+            pl.BlockSpec((1, bkv, dh), kv_index),
+            pl.BlockSpec((1, bkv, dh), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, dh), q_index),
+            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S, H * dh), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, dh), jnp.float32),
+            pltpu.VMEM((bq, A._STAT_LANES), jnp.float32),
+            pltpu.VMEM((bq, A._STAT_LANES), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+def timed_pair(fn, n_long, n_short, reps=5):
+    for n in (n_long, n_short):
+        drain(fn(n))
+
+    def run(n):
+        t0 = time.perf_counter()
+        drain(fn(n))
+        return time.perf_counter() - t0
+
+    return bench._per_iter_time(run, n_long, n_short, reps=reps)
+
+
+def scan_time(body, x0, n_long=32, n_short=8):
+    fns = {}
+
+    def make(n):
+        @jax.jit
+        def run(x):
+            out = jax.lax.scan(lambda c, _: (body(c), None), x, None, length=n)[0]
+            return jnp.sum(out.astype(jnp.float32))
+
+        return run
+
+    def fn(n):
+        if n not in fns:
+            fns[n] = make(n)
+        return fns[n](x0)
+
+    return timed_pair(fn, n_long, n_short)
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    fwd_flops = 2 * B * H * S * S * dh  # causal half of 4BHS^2D
+
+    # correctness: BSHD vs existing BHSD path on a small-noise input
+    x = jax.jit(lambda k: 0.1 * jax.random.normal(k, (B, S, H * dh), jnp.bfloat16))(key)
+    xh = x.reshape(B, S, H, dh).transpose(0, 2, 1, 3)
+    ref = A.flash_attention(xh, xh, xh, causal=True, block_q=bq, block_kv=bkv)
+    got, _ = bshd_forward(x, x, x)
+    got_h = got.reshape(B, S, H, dh).transpose(0, 2, 1, 3)
+    err = jnp.max(jnp.abs(got_h.astype(jnp.float32) - ref.astype(jnp.float32)))
+    print(f"max |bshd - bhsd| = {float(err):.2e}")
+
+    # timing: fwd only, both layouts
+    def body_bshd(c):
+        out, _ = bshd_forward(c, c, c)
+        return c + out * 1e-6
+
+    def body_bhsd(c):
+        out = A.flash_attention(c, c, c, causal=True, block_q=bq, block_kv=bkv)
+        return c + out * 1e-6
+
+    t = scan_time(body_bshd, x)
+    if t:
+        print(f"BSHD fwd: {t*1e3:.2f} ms  ({fwd_flops/t/1e12:.1f} TFLOP/s, "
+              f"{fwd_flops/t/peak*100:.1f}% peak)")
+    t = scan_time(body_bhsd, xh)
+    if t:
+        print(f"BHSD fwd: {t*1e3:.2f} ms  ({fwd_flops/t/1e12:.1f} TFLOP/s, "
+              f"{fwd_flops/t/peak*100:.1f}% peak)")
+
+
+if __name__ == "__main__":
+    main()
