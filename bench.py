@@ -369,12 +369,14 @@ def bench_lm_decode() -> list[dict]:
         TransformerConfig,
         TransformerLM,
     )
+    from distributed_tensorflow_tpu.utils.flops import chip_hbm_bandwidth
 
     if jax.default_backend() != "tpu":
         return []
 
     out = []
-    B, P = 8, 128
+    P = 128
+    bw = chip_hbm_bandwidth()
     if SMOKE:  # quick on-chip validation: tiny model, short generations
         n_long, n_short = 32, 8
         shapes = (("", (64, 2, 2, 128)),)
@@ -384,16 +386,9 @@ def bench_lm_decode() -> list[dict]:
             ("", (1024, 8, 8, 4096)),       # mid-size, ~100M params
             ("_403m", (2048, 16, 8, 8192)),  # the training-bench flagship
         )
-    for tag, (dm, h, nl, dff) in shapes:
-        cfg = TransformerConfig(
-            vocab_size=256, d_model=dm, num_heads=h, num_layers=nl, d_ff=dff,
-            max_seq_len=P + n_long, compute_dtype=jnp.bfloat16,
-        )
-        model = TransformerLM(cfg)
-        p = jax.jit(
-            lambda k, model=model: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"]
-        )(jax.random.PRNGKey(0))
-        n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))
+
+    def measure(cfg, p, B, cast_params=True):
+        """Difference-method tokens/s at batch B; returns (tok/s, ms/step)."""
         prompt = jnp.asarray(
             np.random.default_rng(0).integers(0, cfg.vocab_size, (B, P)), jnp.int32
         )
@@ -402,7 +397,7 @@ def bench_lm_decode() -> list[dict]:
         # (the short one would otherwise read a smaller static KV cache,
         # biasing the difference).
         fns = {
-            n: build_generate_fn(cfg, n, cache_len=P + n_long)
+            n: build_generate_fn(cfg, n, cache_len=P + n_long, cast_params=cast_params)
             for n in (n_long, n_short)
         }
         for n in (n_long, n_short):
@@ -415,16 +410,64 @@ def bench_lm_decode() -> list[dict]:
 
         per_step = _per_iter_time(run, n_long, n_short)
         if per_step is None:
-            continue
-        out.append(
-            {
-                "metric": f"lm_decode_tokens_per_sec{tag}",
-                "value": round(B / per_step, 0),
-                "unit": "tokens/s",
-                "detail": f"{n_params/1e6:.0f}M params, batch {B}, prompt {P}, "
-                f"greedy KV-cache decode, {per_step*1e3:.2f} ms/step",
-            }
+            return None, None
+        return B / per_step, per_step
+
+    for tag, (dm, h, nl, dff) in shapes:
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=dm, num_heads=h, num_layers=nl, d_ff=dff,
+            max_seq_len=P + n_long, compute_dtype=jnp.bfloat16,
         )
+        model = TransformerLM(cfg)
+        p = jax.jit(
+            lambda k, model=model: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"]
+        )(jax.random.PRNGKey(0))
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))
+
+        def emit_point(B, cast_params, metric):
+            toks, per_step = measure(cfg, p, B, cast_params=cast_params)
+            if toks is None:
+                return
+            detail = (
+                f"{n_params/1e6:.0f}M params, batch {B}, prompt {P}, greedy "
+                f"KV-cache decode, {per_step*1e3:.2f} ms/step"
+            )
+            if not cast_params:
+                # The A/B point: the stored tree is f32, but XLA hoists the
+                # per-use bf16 casts out of the scan, so per-step traffic is
+                # bf16 either way — which is exactly what this point
+                # measures (the roofline below deliberately uses bf16
+                # bytes; see BASELINE.md decode section).
+                detail += ", stored-f32 tree (casts hoisted by XLA)"
+            if bw is not None:
+                # Per-step HBM traffic: the whole param tree (bf16 reads —
+                # see the cast note above) plus every layer's FULL static
+                # KV cache (the cached-attention einsum reads all cache_len
+                # rows each step). tokens/s <= B / (bytes / bw).
+                kv_bytes = (
+                    2 * cfg.num_layers * B * cfg.num_heads
+                    * (P + n_long) * (cfg.d_model // cfg.num_heads) * 2
+                )
+                step_floor = (n_params * 2 + kv_bytes) / bw
+                ceil = B / step_floor
+                detail += (
+                    f"; params+KV HBM roofline {ceil:,.0f} tok/s"
+                    f" -> {toks/ceil*100:.0f}%"
+                )
+            out.append(
+                {"metric": metric, "value": round(toks, 0), "unit": "tokens/s",
+                 "detail": detail}
+            )
+
+        emit_point(8, True, f"lm_decode_tokens_per_sec{tag}")
+        if tag == "_403m" and not SMOKE:
+            # Decode perf story (VERDICT r3 #5): the batch sweep shows where
+            # the HBM param-read bound stops being the whole story (KV-cache
+            # reads and attention grow with B), and the cast A/B measures
+            # what commit-r3's params->bf16 change actually bought.
+            emit_point(1, True, "lm_decode_tokens_per_sec_403m_b1")
+            emit_point(32, True, "lm_decode_tokens_per_sec_403m_b32")
+            emit_point(8, False, "lm_decode_tokens_per_sec_403m_f32reads")
     return out
 
 
@@ -618,6 +661,80 @@ def bench_flash_kernel() -> list[dict]:
                     file=sys.stderr,
                 )
                 dispatched_idx = None
+    return out
+
+
+def bench_ckpt_403m() -> list[dict]:
+    """Flagship-scale checkpoint wall-clock (VERDICT r3 #6): Orbax save +
+    restore-latest of the 403M-param params+Adam tree (~4.8 GB of f32), the
+    state the trainer's timed autosave moves every ``--save_interval_secs``
+    (reference parity: demo2/train.py's 600 s Supervisor autosave). On this
+    runtime the save path includes the device→host transfer THROUGH the
+    axon tunnel, so the numbers bound the real operational cost here, not
+    just local-disk throughput — the detail strings say so."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from distributed_tensorflow_tpu.train.checkpoint import CheckpointManager
+
+    if jax.default_backend() != "tpu" and not SMOKE:
+        return []
+    shape = LM_SMOKE_SHAPE if SMOKE else LM_SHAPE
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=shape["d_model"], num_heads=shape["num_heads"],
+        num_layers=shape["num_layers"], d_ff=shape["d_ff"],
+        max_seq_len=shape["seq"], use_bias=False,
+    )
+    tx = optax.adam(1e-4)
+    model = TransformerLM(cfg)
+    p = jax.jit(
+        lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0))
+    state = {"params": p, "opt": jax.jit(tx.init)(p), "step": jnp.zeros((), jnp.int32)}
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))
+    gb = sum(
+        x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(state)
+    ) / 1e9
+    tmp = tempfile.mkdtemp(prefix="bench_ckpt_")
+    out = []
+    try:
+        mngr = CheckpointManager(tmp, save_interval_secs=0)
+        t0 = time.perf_counter()
+        mngr.save(1, state, wait=True)
+        save_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        restored = mngr.restore_latest(state)
+        jax.block_until_ready(restored)
+        restore_s = time.perf_counter() - t0
+        mngr.close()
+        tag = "403m" if not SMOKE else "smoke"
+        out = [
+            {
+                "metric": f"ckpt_save_seconds_{tag}",
+                "value": round(save_s, 2),
+                "unit": "s",
+                "detail": f"Orbax save, {n_params/1e6:.0f}M params + Adam state "
+                f"({gb:.1f} GB f32), device->host via axon tunnel + local disk",
+            },
+            {
+                "metric": f"ckpt_restore_seconds_{tag}",
+                "value": round(restore_s, 2),
+                "unit": "s",
+                "detail": f"restore_latest of the same tree ({gb:.1f} GB), "
+                "disk -> host -> device via axon tunnel",
+            },
+        ]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
     return out
 
 
@@ -882,6 +999,7 @@ def main() -> None:
             bench_mnist_accuracy,
             bench_retrain_accuracy,
             bench_vit_accuracy,
+            bench_ckpt_403m,
         ):
             try:
                 extra.extend(fn())

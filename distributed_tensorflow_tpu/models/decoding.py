@@ -41,13 +41,16 @@ def build_generate_fn(
     max_new_tokens: int,
     temperature: float = 0.0,
     cache_len: int | None = None,
+    cast_params: bool = True,
 ):
     """Returns jitted ``generate(params, prompt (B, P) int32, rng) ->
     tokens (B, P + max_new_tokens)``. ``temperature == 0`` is greedy.
     P must be ≥ 1 (conditional generation; the model has no BOS token).
     ``cache_len`` overrides the KV-cache length (default: exactly
     ``P + max_new_tokens``) — benchmarks comparing different generation
-    lengths pass a common value so per-step work is identical."""
+    lengths pass a common value so per-step work is identical.
+    ``cast_params=False`` keeps the stored f32 tree (the pre-r3 behavior;
+    exists so the bench can A/B the cast's measured effect)."""
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     model = TransformerLM(cfg)
@@ -63,12 +66,15 @@ def build_generate_fn(
         if p < 1:
             raise ValueError("prompt must contain at least one token")
         # Cast params to the compute dtype ONCE, outside the token loop.
-        # Flax casts each f32 param at every use anyway (bitwise-identical
-        # math), but decode is HBM-bound on re-reading the whole tree every
-        # step — reading bf16 instead of f32 halves that traffic.
-        params = jax.tree_util.tree_map(
-            lambda t: t.astype(cfg.compute_dtype), params
-        )
+        # Flax casts each f32 param at every use, but those casts are
+        # loop-invariant and XLA's LICM hoists them out of the scan ANYWAY —
+        # the r4 A/B measured the explicit cast worth only ~1% (BASELINE.md
+        # decode section). Kept because it documents the intent and guards
+        # against a future loop structure that defeats the hoist.
+        if cast_params:
+            params = jax.tree_util.tree_map(
+                lambda t: t.astype(cfg.compute_dtype), params
+            )
         max_len = p + max_new_tokens
         if max_len > cfg.max_seq_len:
             raise ValueError(

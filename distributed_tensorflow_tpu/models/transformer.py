@@ -107,31 +107,21 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
         # boundary (measured ~10 ms/step of boundary passes on the
         # flagship, XPlane r4 — ops/attention.py packed-qkv section).
         attn = attend(qkv)
-        attn = nn.Dense(
-            cfg.d_model, dtype=cfg.compute_dtype, name="proj",
-            use_bias=cfg.use_bias,
-        )(attn)
-        if cfg.dropout_rate:
-            attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
-        return x + attn, None
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    # (B, S, D) -> (B, H, S, dh)
-    to_heads = lambda t: t.reshape(b, s, cfg.num_heads, dh).transpose(0, 2, 1, 3)
-    if cache is None:
-        if layout == "bshd":
-            # (B, S, H, dh) is a FREE reshape of the split slices; no head
-            # transposes materialize.
-            heads = lambda t: t.reshape(b, s, cfg.num_heads, dh)
-            attn = attend(heads(q), heads(k), heads(v)).reshape(b, s, cfg.d_model)
-            attn = nn.Dense(
-                cfg.d_model, dtype=cfg.compute_dtype, name="proj",
-                use_bias=cfg.use_bias,
-            )(attn)
-            if cfg.dropout_rate:
-                attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
-            return x + attn, None
+    elif cache is None and layout == "bshd":
+        # (B, S, H, dh) is a FREE reshape of the split slices; no head
+        # transposes materialize.
+        heads = lambda t: t.reshape(b, s, cfg.num_heads, dh)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        attn = attend(heads(q), heads(k), heads(v)).reshape(b, s, cfg.d_model)
+    elif cache is None:
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # (B, S, D) -> (B, H, S, dh)
+        to_heads = lambda t: t.reshape(b, s, cfg.num_heads, dh).transpose(0, 2, 1, 3)
         attn = attend(to_heads(q), to_heads(k), to_heads(v))
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
     else:
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(b, s, cfg.num_heads, dh).transpose(0, 2, 1, 3)
         # Cached decode (s tokens: 1 for the sampling loop, the whole
         # prompt for prefill): append K/V at offset `len`, causally
         # attend over prefix + self. f32 accumulation like
@@ -155,8 +145,8 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
         attn = jnp.einsum(
             "bhqk,bhkd->bhqd", weights, vs.astype(jnp.float32)
         ).astype(qh.dtype)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
         cache = {"k": ks, "v": vs, "len": cache["len"] + s}
-    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
     attn = nn.Dense(
         cfg.d_model, dtype=cfg.compute_dtype, name="proj",
         use_bias=cfg.use_bias,

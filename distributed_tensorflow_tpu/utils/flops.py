@@ -73,3 +73,38 @@ def transformer_train_flops(
     if causal:
         attn //= 2
     return 3 * (dense + attn)
+
+
+# HBM bandwidth (bytes/s) per chip, by device_kind substring — the decode
+# roofline denominator (each KV-cache decode step re-reads the whole param
+# tree, so tokens/s ≤ B · bw / param_bytes). Public spec-sheet numbers:
+# v4 1228 GB/s, v5e 819 GB/s, v5p 2765 GB/s, v6e 1640 GB/s.
+_HBM_BW = (
+    ("v6e", 1640e9),
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5 lite", 819e9),
+    ("v5litepod", 819e9),
+    ("v5e", 819e9),
+    ("v4", 1228e9),
+)
+
+
+def chip_hbm_bandwidth(device=None) -> float | None:
+    """Peak HBM bytes/s of ``device`` (default: jax.devices()[0]), or None
+    when unknown — callers report roofline fractions as absent, never
+    invent a denominator."""
+    import jax
+
+    if device is None:
+        devices = jax.devices()
+        if not devices:
+            return None
+        device = devices[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    if getattr(device, "platform", "") != "tpu":
+        return None
+    for sub, bw in _HBM_BW:
+        if sub in kind:
+            return bw
+    return None

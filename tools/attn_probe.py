@@ -212,11 +212,21 @@ def main():
     if jax.default_backend() != "tpu":
         raise SystemExit("TPU required")
     print(f"flagship shapes: B={B} S={S} d={d} H={H} dh={dh}  ({L} layers/step)")
-    full = module_probe(AttnSublayer, "attn sublayer fwd+bwd (BSHD-native)", fl_attn)
+    full = module_probe(AttnSublayer, "attn sublayer fwd+bwd (packed-qkv native)", fl_attn)
     module_probe(AttnSublayerBhsd, "attn sublayer fwd+bwd (BHSD transposes)", fl_attn)
     noflash = module_probe(AttnNoFlash, "attn sublayer minus flash (identity attend)",
                            fl_attn - fl_flash)
     flash = flash_probe()
+    # Per-component candidates (unreliable on noisy tunnel days — each may
+    # report UNMEASURED; the XPlane trace is the authoritative attribution,
+    # BASELINE.md r4 section). QkvDense/EinsumHeads carry a caveat: XLA can
+    # algebraically fold their slice-sum / double-einsum reductions, so
+    # their % figures are lower bounds on the real matmul cost.
+    module_probe(Ln1, "ln1 alone fwd+bwd")
+    module_probe(QkvDense, "qkv Dense alone fwd+bwd (foldable, see note)", fl_qkv)
+    module_probe(ProjDense, "proj Dense alone fwd+bwd", fl_proj)
+    module_probe(PackOnly, "head split+transpose+untranspose alone fwd+bwd")
+    module_probe(EinsumHeads, "einsum-to-heads q+out pair (foldable, see note)")
     if full and noflash and flash:
         print(f"\nfull - noflash = {(full - noflash)*1e3*L:.1f} ms/step "
               f"(flash kernel measured alone: {flash*1e3*L:.1f})")
