@@ -235,7 +235,7 @@ class MoeTransformerLM(nn.Module):
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(
             cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head",
-            use_bias=getattr(cfg, "use_bias", True),
+            use_bias=cfg.use_bias,
         )(x)
         return logits.astype(jnp.float32), aux_total / cfg.num_layers
 

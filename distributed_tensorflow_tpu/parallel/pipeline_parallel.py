@@ -175,7 +175,7 @@ def build_pp_lm_train_step(
     ln_f = nn.LayerNorm(dtype=cfg.compute_dtype)
     head = nn.Dense(
         cfg.vocab_size, dtype=cfg.compute_dtype,
-        use_bias=getattr(cfg, "use_bias", True),
+        use_bias=cfg.use_bias,
     )
     attend = _attention_fn(cfg, prefer_packed=True)
     M = num_microbatches

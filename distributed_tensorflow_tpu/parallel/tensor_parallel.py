@@ -115,7 +115,7 @@ class TpBlock(nn.Module):
         # Column-parallel projections: local kernels (D, D/tp) produce this
         # shard's heads directly — no communication in the forward here.
         # (features are the LOCAL width: flax validates stored-param shapes.)
-        bias = getattr(cfg, "use_bias", True)
+        bias = cfg.use_bias
         q = nn.Dense(cfg.d_model // tp, dtype=d, name="q", use_bias=bias)(h)
         k = nn.Dense(cfg.d_model // tp, dtype=d, name="k", use_bias=bias)(h)
         v = nn.Dense(cfg.d_model // tp, dtype=d, name="v", use_bias=bias)(h)
@@ -183,7 +183,7 @@ class TpTransformerLM(nn.Module):
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(
             cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head",
-            use_bias=getattr(cfg, "use_bias", True),
+            use_bias=cfg.use_bias,
         )(x)
         return logits.astype(jnp.float32)
 

@@ -130,7 +130,7 @@ def build_3d_lm_train_step(
     ln_f = nn.LayerNorm(dtype=cfg.compute_dtype)
     head = nn.Dense(
         cfg.vocab_size, dtype=cfg.compute_dtype,
-        use_bias=getattr(cfg, "use_bias", True),
+        use_bias=cfg.use_bias,
     )
     attend = _attention_fn(cfg)
     M = num_microbatches

@@ -241,13 +241,13 @@ def table_step_budget(args) -> None:
         sh["num_layers"], sh["d_ff"],
     )
     vocab = 256
+    # EXACTLY the bench flagship definition (bench_lm_mfu): packed-qkv
+    # layout-native flash ("flash" resolves to it) and bias-free Dense
+    # layers — a budget measured on a different variant misattributes.
     cfg = T.TransformerConfig(
         vocab_size=vocab, d_model=d, num_heads=H, num_layers=L, d_ff=dff,
-        max_seq_len=S,
-        attention=lambda q, k, v: A.flash_attention(
-            q, k, v, causal=True, block_q=1024, block_kv=1024
-        ),
-        compute_dtype=jnp.bfloat16,
+        max_seq_len=S, attention="flash", compute_dtype=jnp.bfloat16,
+        use_bias=False,
     )
     if len(jax.devices()) != 1:
         # Components are timed un-sharded on one device; comparing them
