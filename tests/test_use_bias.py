@@ -1,0 +1,151 @@
+"""use_bias=False threading through every consumer of TransformerConfig.
+
+The r4 code review caught the pipeline LM head silently requesting a bias
+param that bias-free trees don't have (ScopeParamNotFoundError at first
+trace); this pins the whole class of bug: every model family and parallel
+builder must run a bias-free config end to end, and the param trees must
+actually be bias-free.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from distributed_tensorflow_tpu.parallel import data_parallel as dp
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=32, d_model=32, num_heads=2, num_layers=2, d_ff=64,
+        max_seq_len=16, compute_dtype=jnp.float32, use_bias=False,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _no_bias_leaves(tree):
+    """Dense-layer bias leaves (LayerNorm affine biases are kept by design
+    — use_bias covers Dense layers only)."""
+    names = [
+        "/".join(str(p.key) for p in path if hasattr(p, "key"))
+        for path, _ in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]
+    return [
+        n
+        for n in names
+        if n.split("/")[-1] == "bias"
+        and not any(part.startswith("ln") for part in n.split("/"))
+    ]
+
+
+def test_plain_lm_bias_free_tree_and_forward():
+    cfg = _cfg()
+    m = TransformerLM(cfg)
+    toks = jnp.zeros((2, 16), jnp.int32)
+    p = m.init(jax.random.PRNGKey(0), toks)["params"]
+    assert _no_bias_leaves(p) == []
+    out = m.apply({"params": p}, toks)
+    assert out.shape == (2, 16, 32)
+    # LayerNorm affine params survive (use_bias covers Dense layers only).
+    assert "scale" in p["ln_f"]
+
+
+def test_decode_bias_free():
+    from distributed_tensorflow_tpu.models.decoding import build_generate_fn
+
+    cfg = _cfg()
+    m = TransformerLM(cfg)
+    p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    gen = build_generate_fn(cfg, 4)
+    toks = gen(p, jnp.zeros((2, 4), jnp.int32), jax.random.PRNGKey(1))
+    assert toks.shape == (2, 8)
+
+
+def test_tp_pp_moe_3d_builders_run_bias_free():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from distributed_tensorflow_tpu.parallel import (
+        expert_parallel as epmod,
+        pipeline_parallel as ppmod,
+        tensor_parallel as tpmod,
+        three_d as td,
+    )
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh3
+
+    mesh = make_mesh(num_devices=8, model_parallel=2)
+    cfg = _cfg()
+    rng = np.random.default_rng(0)
+    tx = optax.sgd(0.1)
+
+    # Tensor parallel.
+    tp_host = tpmod.init_tp_params(cfg, seed=0)
+    assert _no_bias_leaves(tp_host) == []
+    assert not any(
+        "proj_bias" in "/".join(str(p.key) for p in path if hasattr(p, "key"))
+        for path, _ in jax.tree_util.tree_flatten_with_path(tp_host)[0]
+    )
+    tp_step = tpmod.build_tp_lm_train_step(cfg, tx, mesh, tp_host, donate=False)
+    tp_p = tpmod.shard_params(tp_host, mesh)
+    tp_o = tpmod.shard_params(jax.device_get(tx.init(tp_host)), mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    toks = jnp.asarray(rng.integers(0, 32, (8, 16)), jnp.int32)
+    _, _, _, m = tp_step(tp_p, tp_o, g, toks, jax.random.PRNGKey(1))
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+    # Pipeline (the reviewed bug: head requested a bias the tree lacks).
+    plain = jax.device_get(
+        TransformerLM(cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    stacked = ppmod.stack_stage_params(plain, num_stages=2)
+    pp_step = ppmod.build_pp_lm_train_step(
+        cfg, tx, mesh, stacked, num_microbatches=2, donate=False
+    )
+    pp_p = ppmod.shard_pp_params(stacked, mesh)
+    pp_o = ppmod.shard_pp_params(jax.device_get(tx.init(stacked)), mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    _, _, _, m = pp_step(pp_p, pp_o, g, toks, jax.random.PRNGKey(2))
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+    # MoE (expert b_in/b_out are EXPERT params, not Dense biases — present
+    # either way; the qkv/proj/lm_head Dense biases are what must vanish).
+    moe_host = epmod.init_moe_lm_params(cfg, num_experts=4, seed=0)
+    moe_step = epmod.build_moe_lm_train_step(
+        cfg, 4, tx, mesh, moe_host, donate=False
+    )
+    moe_p = epmod.shard_moe_params(moe_host, mesh)
+    moe_o = epmod.shard_moe_params(jax.device_get(tx.init(moe_host)), mesh)
+    g = jax.device_put(
+        jnp.zeros((), jnp.int32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    )
+    toks_moe = jax.device_put(
+        toks, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data", None))
+    )
+    _, _, _, m = moe_step(moe_p, moe_o, g, toks_moe, jax.random.PRNGKey(3))
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+    # 3D.
+    mesh3 = make_mesh3(8, pipeline_parallel=2, model_parallel=2)
+    td_host = td.init_3d_params(cfg, num_stages=2, seed=0)
+    td_step = td.build_3d_lm_train_step(
+        cfg, tx, mesh3, td_host, num_microbatches=2, donate=False
+    )
+    td_p = td.shard_3d_params(td_host, mesh3)
+    td_o = td.shard_3d_params(jax.device_get(tx.init(td_host)), mesh3)
+    g3 = jax.device_put(
+        jnp.zeros((), jnp.int32),
+        jax.sharding.NamedSharding(mesh3, jax.sharding.PartitionSpec()),
+    )
+    toks3 = jax.device_put(
+        jnp.asarray(rng.integers(0, 32, (4, 16)), jnp.int32),
+        jax.sharding.NamedSharding(mesh3, jax.sharding.PartitionSpec("data", None)),
+    )
+    _, _, _, m = td_step(td_p, td_o, g3, toks3, jax.random.PRNGKey(4))
+    assert np.isfinite(float(jax.device_get(m["loss"])))
