@@ -414,61 +414,82 @@ def bench_lm_decode() -> list[dict]:
             return None, None
         return B / per_step, per_step
 
+    def emit_point(cfg, p, n_params, B, cast_params, metric, model_note=""):
+        toks, per_step = measure(cfg, p, B, cast_params=cast_params)
+        if toks is None:
+            return
+        detail = (
+            f"{n_params/1e6:.0f}M params{model_note}, batch {B}, prompt {P}, "
+            f"greedy KV-cache decode, {per_step*1e3:.2f} ms/step"
+        )
+        if not cast_params:
+            # The A/B point: the stored tree is f32, but XLA hoists the
+            # per-use bf16 casts out of the scan, so per-step traffic is
+            # bf16 either way — which is exactly what this point
+            # measures (the roofline below deliberately uses bf16
+            # bytes; see BASELINE.md decode section).
+            detail += ", stored-f32 tree (casts hoisted by XLA)"
+        if bw is not None:
+            # Per-step HBM traffic: the whole param tree (bf16 reads —
+            # see the cast note above) plus every layer's FULL static
+            # KV cache (the cached-attention einsum reads all cache_len
+            # rows each step; cfg.kv_heads rows per layer — the GQA
+            # point's roofline shrinks with its cache).
+            # tokens/s <= B / (bytes / bw).
+            kv_bytes = (
+                2 * cfg.num_layers * B * cfg.kv_heads
+                * (P + n_long) * (cfg.d_model // cfg.num_heads) * 2
+            )
+            step_floor = (n_params * 2 + kv_bytes) / bw
+            ceil = B / step_floor
+            detail += (
+                f"; params+KV HBM roofline {ceil:,.0f} tok/s"
+                f" -> {toks/ceil*100:.0f}%"
+            )
+        out.append(
+            {"metric": metric, "value": round(toks, 0), "unit": "tokens/s",
+             "detail": detail}
+        )
+
+    def init_params(cfg):
+        model = TransformerLM(cfg)
+        p = jax.jit(
+            lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"]
+        )(jax.random.PRNGKey(0))
+        n = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))
+        return p, n
+
     for tag, (dm, h, nl, dff) in shapes:
         cfg = TransformerConfig(
             vocab_size=256, d_model=dm, num_heads=h, num_layers=nl, d_ff=dff,
             max_seq_len=P + n_long, compute_dtype=jnp.bfloat16,
         )
-        model = TransformerLM(cfg)
-        p = jax.jit(
-            lambda k, model=model: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"]
-        )(jax.random.PRNGKey(0))
-        n_params = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))
-
-        def emit_point(B, cast_params, metric):
-            toks, per_step = measure(cfg, p, B, cast_params=cast_params)
-            if toks is None:
-                return
-            detail = (
-                f"{n_params/1e6:.0f}M params, batch {B}, prompt {P}, greedy "
-                f"KV-cache decode, {per_step*1e3:.2f} ms/step"
-            )
-            if not cast_params:
-                # The A/B point: the stored tree is f32, but XLA hoists the
-                # per-use bf16 casts out of the scan, so per-step traffic is
-                # bf16 either way — which is exactly what this point
-                # measures (the roofline below deliberately uses bf16
-                # bytes; see BASELINE.md decode section).
-                detail += ", stored-f32 tree (casts hoisted by XLA)"
-            if bw is not None:
-                # Per-step HBM traffic: the whole param tree (bf16 reads —
-                # see the cast note above) plus every layer's FULL static
-                # KV cache (the cached-attention einsum reads all cache_len
-                # rows each step). tokens/s <= B / (bytes / bw).
-                kv_bytes = (
-                    2 * cfg.num_layers * B * cfg.num_heads
-                    * (P + n_long) * (cfg.d_model // cfg.num_heads) * 2
-                )
-                step_floor = (n_params * 2 + kv_bytes) / bw
-                ceil = B / step_floor
-                detail += (
-                    f"; params+KV HBM roofline {ceil:,.0f} tok/s"
-                    f" -> {toks/ceil*100:.0f}%"
-                )
-            out.append(
-                {"metric": metric, "value": round(toks, 0), "unit": "tokens/s",
-                 "detail": detail}
-            )
-
-        emit_point(8, True, f"lm_decode_tokens_per_sec{tag}")
+        p, n_params = init_params(cfg)
+        emit_point(cfg, p, n_params, 8, True, f"lm_decode_tokens_per_sec{tag}")
         if tag == "_403m" and not SMOKE:
             # Decode perf story (VERDICT r3 #5): the batch sweep shows where
             # the HBM param-read bound stops being the whole story (KV-cache
             # reads and attention grow with B), and the cast A/B measures
             # what commit-r3's params->bf16 change actually bought.
-            emit_point(1, True, "lm_decode_tokens_per_sec_403m_b1")
-            emit_point(32, True, "lm_decode_tokens_per_sec_403m_b32")
-            emit_point(8, False, "lm_decode_tokens_per_sec_403m_f32reads")
+            emit_point(cfg, p, n_params, 1, True, "lm_decode_tokens_per_sec_403m_b1")
+            emit_point(cfg, p, n_params, 32, True, "lm_decode_tokens_per_sec_403m_b32")
+            emit_point(cfg, p, n_params, 8, False, "lm_decode_tokens_per_sec_403m_f32reads")
+
+    if not SMOKE:
+        # GQA flagship variant at the KV-bound batch (B=32, where the MHA
+        # point sits at ~72-77% of a KV-dominated roofline): 4 kv heads
+        # shared by groups of 4 query heads cut the per-step KV read 4x —
+        # the modern-LM KV design as a measured decode lever (r4).
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=2048, num_heads=16, num_kv_heads=4,
+            num_layers=8, d_ff=8192, max_seq_len=P + n_long,
+            compute_dtype=jnp.bfloat16,
+        )
+        p, n_params = init_params(cfg)
+        emit_point(
+            cfg, p, n_params, 32, True, "lm_decode_tokens_per_sec_gqa4_b32",
+            model_note=f" (GQA {cfg.num_heads}q/{cfg.kv_heads}kv)",
+        )
     return out
 
 

@@ -5,7 +5,7 @@ family (train with ``tools/train_lm.py``, sample with ``tools/generate.py``).
 TPU-first: the whole generation is one jitted program — prompt prefill is a
 SINGLE batched causal forward that writes the prompt's K/V into the cache
 (one matmul set, not P sequential steps), then a ``lax.scan`` drives the
-token loop over static-shape ``(B, H, S_max, dh)`` buffers written with
+token loop over static-shape ``(B, KV_heads, S_max, dh)`` buffers written with
 ``dynamic_update_slice`` at the shared prefix length. Cached decode is
 test-verified to reproduce the full-forward logits exactly (teacher-forcing
 parity), with f32 score accumulation matching ``ops.attention``.
@@ -22,13 +22,17 @@ __all__ = ["init_cache", "build_generate_fn"]
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
-    """Static-shape per-layer KV buffers + one shared filled-prefix length."""
+    """Static-shape per-layer KV buffers + one shared filled-prefix length.
+    Under GQA the buffers hold the UNEXPANDED ``kv_heads`` — the cache (and
+    its per-step HBM read, the decode bound past small batches) shrinks by
+    the query-group factor."""
     dh = cfg.d_model // cfg.num_heads
+    kv = cfg.kv_heads
     return {
         "layers": [
             {
-                "k": jnp.zeros((batch, cfg.num_heads, max_len, dh), cfg.compute_dtype),
-                "v": jnp.zeros((batch, cfg.num_heads, max_len, dh), cfg.compute_dtype),
+                "k": jnp.zeros((batch, kv, max_len, dh), cfg.compute_dtype),
+                "v": jnp.zeros((batch, kv, max_len, dh), cfg.compute_dtype),
             }
             for _ in range(cfg.num_layers)
         ],

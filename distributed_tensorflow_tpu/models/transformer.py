@@ -47,12 +47,26 @@ class TransformerConfig:
     # separate whole-activation reduce pass it will not fuse into the
     # weight-grad matmul (9.8 ms/step at the flagship shape, XPlane r4).
     use_bias: bool = True
+    # Grouped-query attention (None = multi-head, the default): K/V get
+    # ``num_kv_heads`` heads and each group of num_heads/num_kv_heads query
+    # heads shares one. The modern-LM KV design and a direct TPU lever:
+    # the KV cache shrinks by the group factor (decode is KV-bandwidth
+    # bound past small batches — BASELINE.md decode roofline) and the kv
+    # projection matmuls shrink with it. num_heads must be divisible by
+    # num_kv_heads. Supported by the plain/MoE/pipeline model paths and
+    # cached decode; TpBlock (head-sharded tensor parallelism) requires
+    # MHA and says so.
+    num_kv_heads: int | None = None
     # Rematerialise each block on the backward pass (jax.checkpoint): saves
     # only block boundaries instead of every intermediate — activation memory
     # drops from O(L·S·(d_ff+4·d_model)) to O(L·S·d_model) + one block's
     # intermediates, for one extra forward's FLOPs. The standard long-context
     # trade on TPU, where HBM (not MXU) is the bottleneck.
     remat: bool = False
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_heads if self.num_kv_heads is None else self.num_kv_heads
 
 
 def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callable:
@@ -75,11 +89,20 @@ def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callab
     if cfg.attention == "blockwise":
         return lambda q, k, v: A.blockwise_attention(q, k, v, causal=True)
     if cfg.attention == "flash":
-        if prefer_packed:
+        if prefer_packed and cfg.kv_heads == cfg.num_heads:
+            # The packed kernel's equal-thirds column maps assume MHA; GQA
+            # configs take the BSHD layout (kv heads expanded by repeat in
+            # the sublayer) on the same kernels.
             def fn(qkv):
                 return A.flash_attention_qkv(qkv, cfg.num_heads, causal=True)
 
             fn.input_layout = "packed_qkv"
+            return fn
+        if prefer_packed:
+            def fn(q, k, v):
+                return A.flash_attention_bshd(q, k, v, causal=True)
+
+            fn.input_layout = "bshd"
             return fn
         return lambda q, k, v: A.flash_attention(q, k, v, causal=True)
     raise ValueError(f"unknown attention implementation: {cfg.attention!r}")
@@ -95,10 +118,29 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
     h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x)
     b, s, _ = h.shape
     dh = cfg.d_model // cfg.num_heads
+    kv = cfg.kv_heads
+    if cfg.num_heads % kv:
+        raise ValueError(
+            f"num_heads {cfg.num_heads} not divisible by num_kv_heads {kv}"
+        )
+    group = cfg.num_heads // kv
+    # GQA shrinks the fused projection: [q (H·dh) | k (KV·dh) | v (KV·dh)].
     qkv = nn.Dense(
-        3 * cfg.d_model, dtype=cfg.compute_dtype, name="qkv",
+        cfg.d_model + 2 * kv * dh, dtype=cfg.compute_dtype, name="qkv",
         use_bias=cfg.use_bias,
     )(h)
+
+    def split_qkv():
+        return jnp.split(qkv, [cfg.d_model, cfg.d_model + kv * dh], axis=-1)
+
+    def expand_kv(t4):
+        # (B, S, KV, dh) -> (B, S, H, dh): each query-head group reads its
+        # shared kv head (materialized repeat — the non-packed tiers want
+        # H-headed operands).
+        if group == 1:
+            return t4
+        return jnp.repeat(t4, group, axis=2)
+
     layout = getattr(attend, "input_layout", "bhsd")
     if cache is None and layout == "packed_qkv":
         # Layout-native attention: the attend fn consumes the fused qkv
@@ -106,46 +148,61 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
         # the (B,H,S,D) head transposes ever materialize at the kernel
         # boundary (measured ~10 ms/step of boundary passes on the
         # flagship, XPlane r4 — ops/attention.py packed-qkv section).
+        # (_attention_fn only hands out this layout for MHA: the packed
+        # kernel's equal-thirds column maps assume KV == H.)
         attn = attend(qkv)
     elif cache is None and layout == "bshd":
-        # (B, S, H, dh) is a FREE reshape of the split slices; no head
-        # transposes materialize.
-        heads = lambda t: t.reshape(b, s, cfg.num_heads, dh)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        attn = attend(heads(q), heads(k), heads(v)).reshape(b, s, cfg.d_model)
+        # (B, S, H, dh) is a FREE reshape of the split slices (kv heads
+        # expand by repeat under GQA); no head transposes materialize.
+        q, k, v = split_qkv()
+        qh = q.reshape(b, s, cfg.num_heads, dh)
+        kh = expand_kv(k.reshape(b, s, kv, dh))
+        vh = expand_kv(v.reshape(b, s, kv, dh))
+        attn = attend(qh, kh, vh).reshape(b, s, cfg.d_model)
     elif cache is None:
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        # (B, S, D) -> (B, H, S, dh)
-        to_heads = lambda t: t.reshape(b, s, cfg.num_heads, dh).transpose(0, 2, 1, 3)
-        attn = attend(to_heads(q), to_heads(k), to_heads(v))
+        q, k, v = split_qkv()
+        # (B, S, n·dh) -> (B, n, S, dh)
+        to_heads = lambda t, n: t.reshape(b, s, n, dh).transpose(0, 2, 1, 3)
+        attn = attend(
+            to_heads(q, cfg.num_heads),
+            expand_kv(k.reshape(b, s, kv, dh)).transpose(0, 2, 1, 3),
+            expand_kv(v.reshape(b, s, kv, dh)).transpose(0, 2, 1, 3),
+        )
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
     else:
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        to_heads = lambda t: t.reshape(b, s, cfg.num_heads, dh).transpose(0, 2, 1, 3)
+        q, k, v = split_qkv()
+        to_heads = lambda t, n: t.reshape(b, s, n, dh).transpose(0, 2, 1, 3)
         # Cached decode (s tokens: 1 for the sampling loop, the whole
         # prompt for prefill): append K/V at offset `len`, causally
-        # attend over prefix + self. f32 accumulation like
-        # ops.attention.dense_attention; NEG_INF (not -inf) keeps
-        # fully-masked softmax rows NaN-free.
+        # attend over prefix + self. The cache stores the UNEXPANDED
+        # (B, KV, S_max, dh) heads — under GQA that is the whole point:
+        # decode is KV-bandwidth bound past small batches (BASELINE.md
+        # decode roofline) and the cache shrinks by the group factor.
+        # f32 accumulation like ops.attention.dense_attention; NEG_INF
+        # (not -inf) keeps fully-masked softmax rows NaN-free.
         ks = jax.lax.dynamic_update_slice(
-            cache["k"], to_heads(k), (0, 0, cache["len"], 0)
+            cache["k"], to_heads(k, kv), (0, 0, cache["len"], 0)
         )
         vs = jax.lax.dynamic_update_slice(
-            cache["v"], to_heads(v), (0, 0, cache["len"], 0)
+            cache["v"], to_heads(v, kv), (0, 0, cache["len"], 0)
         )
-        qh = to_heads(q)
+        qh = to_heads(q, cfg.num_heads).reshape(b, kv, group, s, dh)
         scores = jnp.einsum(
-            "bhqd,bhkd->bhqk", qh, ks, preferred_element_type=jnp.float32
+            "bkgqd,bkTd->bkgqT", qh, ks, preferred_element_type=jnp.float32
         ) / np.sqrt(dh)
         q_pos = cache["len"] + jnp.arange(s)  # (s,)
         key_pos = jnp.arange(ks.shape[2])  # (S_max,)
         allowed = key_pos[None, :] <= q_pos[:, None]  # (s, S_max)
-        scores = jnp.where(allowed[None, None, :, :], scores, A.NEG_INF)
+        scores = jnp.where(allowed[None, None, None, :, :], scores, A.NEG_INF)
         weights = jax.nn.softmax(scores, -1)
         attn = jnp.einsum(
-            "bhqk,bhkd->bhqd", weights, vs.astype(jnp.float32)
-        ).astype(qh.dtype)
-        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+            "bkgqT,bkTd->bkgqd", weights, vs.astype(jnp.float32)
+        ).astype(cfg.compute_dtype)
+        attn = (
+            attn.reshape(b, cfg.num_heads, s, dh)
+            .transpose(0, 2, 1, 3)
+            .reshape(b, s, cfg.d_model)
+        )
         cache = {"k": ks, "v": vs, "len": cache["len"] + s}
     attn = nn.Dense(
         cfg.d_model, dtype=cfg.compute_dtype, name="proj",
@@ -162,8 +219,9 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, attend, train: bool = False, cache=None):
         """``cache=None`` — training/prefill path. With a cache dict
-        ``{'k','v','len'}`` (K/V laid out (B, H, S_max, dh), ``len`` the
-        filled prefix length), runs cached decode and returns
+        ``{'k','v','len'}`` (K/V laid out (B, KV_heads, S_max, dh) —
+        num_heads for MHA, num_kv_heads under GQA; ``len`` the filled
+        prefix length), runs cached decode and returns
         ``(x, new_cache)``."""
         cfg = self.cfg
         x, cache = attention_sublayer(cfg, x, attend, train=train, cache=cache)

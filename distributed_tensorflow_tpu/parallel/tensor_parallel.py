@@ -107,6 +107,13 @@ class TpBlock(nn.Module):
         tp = lax.axis_size(self.tp_axis)
         if cfg.num_heads % tp:
             raise ValueError(f"num_heads {cfg.num_heads} not divisible by tp={tp}")
+        if cfg.kv_heads != cfg.num_heads:
+            raise ValueError(
+                "TpBlock shards query heads across the model axis and keeps "
+                "separate per-shard q/k/v kernels — GQA (num_kv_heads < "
+                "num_heads) is not supported under tensor parallelism; use "
+                "num_kv_heads=None here"
+            )
         local_heads = cfg.num_heads // tp
         dh = cfg.d_model // cfg.num_heads
 

@@ -67,7 +67,14 @@ def transformer_train_flops(
     b = int(batch_size)
     d = int(cfg.d_model)
     tokens = b * s
-    n_matmul = cfg.num_layers * (4 * d * d + 2 * d * cfg.d_ff) + d * cfg.vocab_size
+    # GQA (num_kv_heads < num_heads) shrinks the k/v projections: q and o
+    # stay d x d, k/v are d x (kv_heads * head_dim) each.
+    kv = int(cfg.kv_heads)
+    kv_width = (d // cfg.num_heads) * kv
+    n_matmul = (
+        cfg.num_layers * (2 * d * d + 2 * d * kv_width + 2 * d * cfg.d_ff)
+        + d * cfg.vocab_size
+    )
     dense = 2 * tokens * n_matmul
     attn = 4 * b * s * s * d * cfg.num_layers
     if causal:

@@ -77,6 +77,11 @@ def main(argv=None):
     parser.add_argument("--vocab_size", type=int, default=256)
     parser.add_argument("--d_model", type=int, default=128)
     parser.add_argument("--num_heads", type=int, default=4)
+    parser.add_argument(
+        "--num_kv_heads", type=int, default=0,
+        help="grouped-query attention: K/V heads shared by query groups "
+             "(0 = multi-head; shrinks the KV cache and kv projections)",
+    )
     parser.add_argument("--num_layers", type=int, default=4)
     parser.add_argument("--d_ff", type=int, default=512)
     parser.add_argument("--learning_rate", type=float, default=3e-3)
@@ -185,6 +190,7 @@ def main(argv=None):
         vocab_size=args.vocab_size,
         d_model=args.d_model,
         num_heads=args.num_heads,
+        num_kv_heads=args.num_kv_heads or None,
         num_layers=args.num_layers,
         d_ff=args.d_ff,
         max_seq_len=args.seq_len,
@@ -516,6 +522,7 @@ def main(argv=None):
                     "vocab_size": cfg.vocab_size,
                     "d_model": cfg.d_model,
                     "num_heads": cfg.num_heads,
+                    "num_kv_heads": cfg.num_kv_heads or 0,
                     "num_layers": cfg.num_layers,
                     "d_ff": cfg.d_ff,
                     "max_seq_len": cfg.max_seq_len,
