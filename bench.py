@@ -479,10 +479,13 @@ def bench_lm_decode() -> list[dict]:
         # GQA flagship variant at the KV-bound batch (B=32, where the MHA
         # point sits at ~72-77% of a KV-dominated roofline): 4 kv heads
         # shared by groups of 4 query heads cut the per-step KV read 4x —
-        # the modern-LM KV design as a measured decode lever (r4).
+        # the modern-LM KV design as a measured decode lever (r4). The
+        # shape derives from the "_403m" entry so the comparison stays
+        # apples-to-apples if the flagship is ever retuned.
+        dm_, h_, nl_, dff_ = dict(shapes)["_403m"]
         cfg = TransformerConfig(
-            vocab_size=256, d_model=2048, num_heads=16, num_kv_heads=4,
-            num_layers=8, d_ff=8192, max_seq_len=P + n_long,
+            vocab_size=256, d_model=dm_, num_heads=h_, num_kv_heads=h_ // 4,
+            num_layers=nl_, d_ff=dff_, max_seq_len=P + n_long,
             compute_dtype=jnp.bfloat16,
         )
         p, n_params = init_params(cfg)

@@ -75,9 +75,10 @@ def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callab
     tells :func:`attention_sublayer` which layout to feed it.
 
     ``prefer_packed`` opts the flash path into the layout-native
-    packed-qkv kernels — the attend fn then takes the fused (B, S,
-    3·d_model) qkv projection output directly, so no q/k/v slice copies or
-    head transposes materialize at the Pallas custom-call boundary
+    packed-qkv kernels — the attend fn then takes the fused
+    (B, S, (H + 2·KV)·head_dim) qkv projection output directly (equal
+    thirds for MHA), so no q/k/v slice copies or head transposes
+    materialize at the Pallas custom-call boundary
     (~10 ms/step on the flagship, XPlane r4). Only callers that route
     through :func:`attention_sublayer` may pass it (TransformerLM, the MoE
     block, the pipeline stages); direct (q, k, v) consumers like TpBlock
@@ -89,20 +90,15 @@ def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callab
     if cfg.attention == "blockwise":
         return lambda q, k, v: A.blockwise_attention(q, k, v, causal=True)
     if cfg.attention == "flash":
-        if prefer_packed and cfg.kv_heads == cfg.num_heads:
-            # The packed kernel's equal-thirds column maps assume MHA; GQA
-            # configs take the BSHD layout (kv heads expanded by repeat in
-            # the sublayer) on the same kernels.
+        if prefer_packed:
+            # GQA-aware: the kernel's kv column index maps share kv heads
+            # across query groups directly — no expanded K/V materializes.
             def fn(qkv):
-                return A.flash_attention_qkv(qkv, cfg.num_heads, causal=True)
+                return A.flash_attention_qkv(
+                    qkv, cfg.num_heads, cfg.num_kv_heads, causal=True
+                )
 
             fn.input_layout = "packed_qkv"
-            return fn
-        if prefer_packed:
-            def fn(q, k, v):
-                return A.flash_attention_bshd(q, k, v, causal=True)
-
-            fn.input_layout = "bshd"
             return fn
         return lambda q, k, v: A.flash_attention(q, k, v, causal=True)
     raise ValueError(f"unknown attention implementation: {cfg.attention!r}")
@@ -119,8 +115,9 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
     b, s, _ = h.shape
     dh = cfg.d_model // cfg.num_heads
     kv = cfg.kv_heads
-    if cfg.num_heads % kv:
+    if not (1 <= kv <= cfg.num_heads) or cfg.num_heads % kv:
         raise ValueError(
+            f"num_kv_heads must be in [1, num_heads] and divide it: "
             f"num_heads {cfg.num_heads} not divisible by num_kv_heads {kv}"
         )
     group = cfg.num_heads // kv
@@ -148,12 +145,17 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
         # the (B,H,S,D) head transposes ever materialize at the kernel
         # boundary (measured ~10 ms/step of boundary passes on the
         # flagship, XPlane r4 — ops/attention.py packed-qkv section).
-        # (_attention_fn only hands out this layout for MHA: the packed
-        # kernel's equal-thirds column maps assume KV == H.)
+        # GQA included: the kernel's kv column index maps share kv heads
+        # across query groups, so the narrower [q|k|v] projection passes
+        # through unexpanded.
         attn = attend(qkv)
     elif cache is None and layout == "bshd":
-        # (B, S, H, dh) is a FREE reshape of the split slices (kv heads
-        # expand by repeat under GQA); no head transposes materialize.
+        # Extension point for EXTERNAL attend callables tagged
+        # input_layout="bshd" (the public flash_attention_bshd layout) —
+        # no in-repo _attention_fn path hands this out since the packed
+        # kernels went GQA-native. (B, S, H, dh) is a FREE reshape of the
+        # split slices (kv heads expand by repeat under GQA); no head
+        # transposes materialize.
         q, k, v = split_qkv()
         qh = q.reshape(b, s, cfg.num_heads, dh)
         kh = expand_kv(k.reshape(b, s, kv, dh))

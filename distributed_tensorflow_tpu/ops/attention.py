@@ -1205,30 +1205,52 @@ flash_attention_bshd.defvjp(_flash_bshd_fwd, _flash_bshd_bwd)
 # ---------------------------------------------------------------------------
 
 
-def _unpack_qkv(qkv, h):
-    b, sq, three_d = qkv.shape
-    dm = three_d // 3
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    shd = lambda t: t.reshape(b, sq, h, dm // h)
-    return shd(q), shd(k), shd(v)
+def _unpack_qkv(qkv, h, kv=None):
+    """Split a packed [q (H·dh) | k (KV·dh) | v (KV·dh)] projection into
+    (B, S, heads, dh) tensors, EXPANDING kv heads to H by repeat under GQA
+    (the 4D BSHD tiers want equal head counts)."""
+    kv = h if kv is None else kv
+    b, sq, width = qkv.shape
+    dh = width // (h + 2 * kv)
+    q, k, v = jnp.split(qkv, [h * dh, (h + kv) * dh], axis=-1)
+    q = q.reshape(b, sq, h, dh)
+    k = k.reshape(b, sq, kv, dh)
+    v = v.reshape(b, sq, kv, dh)
+    if kv != h:
+        k = jnp.repeat(k, h // kv, axis=2)
+        v = jnp.repeat(v, h // kv, axis=2)
+    return q, k, v
 
 
 def _flash_forward_qkv(
-    qkv, h, causal, block_q, block_kv, scale, interpret, with_lse: bool = False
+    qkv, h, kv, causal, block_q, block_kv, scale, interpret, with_lse: bool = False
 ):
-    """qkv: (B, S, 3·d_model), columns [q | k | v], heads contiguous within
-    each section. Returns out (B, S, d_model) (+ lse (B·H, S, 1))."""
+    """qkv: (B, S, (H + 2·KV)·dh), columns [q | k | v], heads contiguous
+    within each section (KV == H is plain MHA; under GQA each group of
+    H/KV query heads reads its shared kv-head column block — the index
+    maps do the sharing, no expansion materializes). Returns out
+    (B, S, H·dh) (+ lse (B·H, S, 1))."""
     if not HAVE_PALLAS:
         raise RuntimeError(
             "jax.experimental.pallas unavailable — use blockwise_attention instead"
         )
-    b, sq, three_d = qkv.shape
-    dm = three_d // 3
-    d = dm // h
+    b, sq, width = qkv.shape
+    if kv < 1 or h % kv:
+        raise ValueError(
+            f"num_heads {h} must be a positive multiple of num_kv_heads {kv}"
+        )
+    if width % (h + 2 * kv):
+        raise ValueError(
+            f"packed qkv width {width} is not (num_heads + 2*num_kv_heads) "
+            f"= {h + 2 * kv} head columns"
+        )
+    d = width // (h + 2 * kv)  # head dim
+    dm = h * d
+    group = h // kv
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if not interpret and d % 128:
-        q, k, v = _unpack_qkv(qkv, h)
+        q, k, v = _unpack_qkv(qkv, h, kv)
         res = _flash_forward_bshd(
             q, k, v, causal, block_q, block_kv, scale, interpret, with_lse=with_lse
         )
@@ -1255,11 +1277,11 @@ def _flash_forward_qkv(
 
     def k_index(bh, i, j):
         blk = j if base_kv is None else base_kv(bh, i, j)[1]
-        return (bh // h, blk, h + bh % h)
+        return (bh // h, blk, h + (bh % h) // group)
 
     def v_index(bh, i, j):
         blk = j if base_kv is None else base_kv(bh, i, j)[1]
-        return (bh // h, blk, 2 * h + bh % h)
+        return (bh // h, blk, h + kv + (bh % h) // group)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -1290,26 +1312,37 @@ def _flash_forward_qkv(
 
 
 def _flash_backward_qkv(
-    qkv, h, out, lse, g, causal, block_q, block_kv, scale, interpret
+    qkv, h, kv, out, lse, g, causal, block_q, block_kv, scale, interpret
 ):
-    b, sq, three_d = qkv.shape
-    dm = three_d // 3
-    d = dm // h
+    b, sq, width = qkv.shape
+    d = width // (h + 2 * kv)
+    dm = h * d
+    group = h // kv
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     fits_fused = sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_SCRATCH_LIMIT
+
+    def regroup_kv(dt4):
+        """(B, S, H, d) per-q-head kv grads -> (B, S, KV·d): the transpose
+        of the GQA head-sharing (repeat's chain rule is the group sum)."""
+        if group == 1:
+            return dt4.reshape(b, sq, dm)
+        return dt4.reshape(b, sq, kv, group, d).sum(axis=3).reshape(b, sq, kv * d)
+
     if (not interpret and d % 128) or not fits_fused:
-        # Odd head dims or segmented/two-pass shapes: unpack once and take
-        # the BSHD backward (which handles segmentation and fallbacks); the
-        # packed fast path exists for shapes that fit ONE fused call —
-        # q-segmenting a packed array would slice k/v along with q.
-        q, k, v = _unpack_qkv(qkv, h)
+        # Odd head dims or segmented/two-pass shapes: unpack once (kv heads
+        # expanded) and take the BSHD backward (which handles segmentation
+        # and fallbacks); the packed fast path exists for shapes that fit
+        # ONE fused call — q-segmenting a packed array would slice k/v
+        # along with q.
+        q, k, v = _unpack_qkv(qkv, h, kv)
         dq, dk, dv = _flash_backward_bshd(
             q, k, v, out.reshape(b, sq, h, d), lse, g.reshape(b, sq, h, d),
             causal, block_q, block_kv, scale, interpret,
         )
-        flat = lambda t: t.reshape(b, sq, dm)
-        return jnp.concatenate([flat(dq), flat(dk), flat(dv)], axis=-1)
+        return jnp.concatenate(
+            [dq.reshape(b, sq, dm), regroup_kv(dk), regroup_kv(dv)], axis=-1
+        )
     s = (1.0 / math.sqrt(d)) if scale is None else scale
     block_q = _fit_block(block_q, sq, interpret)
     block_kv = _fit_block(block_kv, sq, interpret)
@@ -1326,16 +1359,21 @@ def _flash_backward_qkv(
         return (bh, blk, 0)
 
     def k_index(bh, kj, i):
-        return (bh // h, kj, h + bh % h)
+        return (bh // h, kj, h + (bh % h) // group)
 
     def v_index(bh, kj, i):
-        return (bh // h, kj, 2 * h + bh % h)
+        return (bh // h, kj, h + kv + (bh % h) // group)
 
     def out_index(bh, kj, i):
         # Read only during the kj==0 sweep (in-kernel delta); pinned after.
         return (bh // h, jnp.where(kj == 0, i, 0), bh % h)
 
-    dq, dk, dv = pl.pallas_call(
+    # dk/dv are emitted PER Q HEAD (the kernel's per-kj scratch accumulates
+    # one q head's contributions; different q heads of a group land in
+    # adjacent column blocks) and group-summed in XLA below — writing them
+    # directly into shared kv columns would overwrite across the grid's bh
+    # axis, where Pallas output blocks cannot accumulate.
+    dq, dk_exp, dv_exp = pl.pallas_call(
         functools.partial(
             _flash_bwd_fused_kernel,
             num_q=num_q, num_kv=num_kv, causal=causal, s=s, q_pos_offset=0,
@@ -1367,13 +1405,15 @@ def _flash_backward_qkv(
         ],
         interpret=interpret,
     )(qkv, qkv, qkv, g, lse, out)
-    return jnp.concatenate([dq, dk, dv], axis=-1)
+    return jnp.concatenate([dq, regroup_kv(dk_exp.reshape(b, sq, h, d)),
+                            regroup_kv(dv_exp.reshape(b, sq, h, d))], axis=-1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7))
 def flash_attention_qkv(
     qkv,
     num_heads: int,
+    num_kv_heads: int | None = None,
     causal: bool = False,
     block_q: int = 1024,
     block_kv: int = 1024,
@@ -1381,29 +1421,37 @@ def flash_attention_qkv(
     interpret: bool | None = None,
 ):
     """Flash SELF-attention on the packed qkv projection output: ``qkv`` is
-    (B, S, 3·d_model) with columns [q | k | v] (``jnp.split`` thirds, heads
-    contiguous within each third — exactly what a fused Dense(3·d_model)
-    produces). Returns (B, S, d_model). Same kernels, blocks, causal
-    semantics and fallbacks as :func:`flash_attention`; the gradient
-    arrives as one packed (B, S, 3·d_model) cotangent that feeds the qkv
-    matmul backward directly."""
+    (B, S, (H + 2·KV)·head_dim) with columns [q | k | v], heads contiguous
+    within each section — exactly what a fused Dense produces (KV == H is
+    plain MHA and the classic 3·d_model thirds). Returns (B, S, H·head_dim).
+    Under GQA (``num_kv_heads`` < ``num_heads``) the kv column index maps do
+    the head sharing — no expanded K/V ever materializes, in either
+    direction (the backward emits per-q-head dk/dv and group-sums, the
+    transpose of the sharing). Same kernels, blocks, causal semantics and
+    fallbacks as :func:`flash_attention`; the gradient arrives as one
+    packed cotangent that feeds the qkv matmul backward directly."""
+    kv = num_heads if num_kv_heads is None else num_kv_heads
     return _flash_forward_qkv(
-        qkv, num_heads, causal, block_q, block_kv, scale, interpret
+        qkv, num_heads, kv, causal, block_q, block_kv, scale, interpret
     )
 
 
-def _flash_qkv_fwd(qkv, h, causal, block_q, block_kv, scale, interpret):
+def _flash_qkv_fwd(qkv, h, num_kv_heads, causal, block_q, block_kv, scale, interpret):
+    kv = h if num_kv_heads is None else num_kv_heads
     out, lse = _flash_forward_qkv(
-        qkv, h, causal, block_q, block_kv, scale, interpret, with_lse=True
+        qkv, h, kv, causal, block_q, block_kv, scale, interpret, with_lse=True
     )
     return out, (qkv, out, lse)
 
 
-def _flash_qkv_bwd(h, causal, block_q, block_kv, scale, interpret, residuals, g):
+def _flash_qkv_bwd(
+    h, num_kv_heads, causal, block_q, block_kv, scale, interpret, residuals, g
+):
+    kv = h if num_kv_heads is None else num_kv_heads
     qkv, out, lse = residuals
     return (
         _flash_backward_qkv(
-            qkv, h, out, lse, g, causal, block_q, block_kv, scale, interpret
+            qkv, h, kv, out, lse, g, causal, block_q, block_kv, scale, interpret
         ),
     )
 

@@ -148,3 +148,44 @@ def test_bad_kv_heads_rejected():
     m = TransformerLM(cfg)
     with pytest.raises(ValueError, match="divisible"):
         m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_packed_gqa_kernel_matches_expanded_bshd(causal):
+    """The packed kernel's GQA index maps (kv column sharing + per-q-head
+    dk/dv with group sum) vs the same kernels on explicitly expanded K/V:
+    outputs and dq bitwise, dk/dv to the group-sum's reassociation."""
+    from distributed_tensorflow_tpu.ops import attention as A
+
+    b, s, dh = 2, 64, 16
+    g = H // KV
+    r = np.random.default_rng(11)
+    qkv = jnp.asarray(r.standard_normal((b, s, (H + 2 * KV) * dh)), jnp.float32)
+    cot = jnp.asarray(r.standard_normal((b, s, H * dh)), jnp.float32)
+
+    def loss_packed(qkv):
+        out = A.flash_attention_qkv(
+            qkv, H, KV, causal=causal, block_q=16, block_kv=16
+        )
+        return jnp.sum(out * cot)
+
+    def loss_ref(qkv):
+        q, k, v = jnp.split(qkv, [H * dh, (H + KV) * dh], axis=-1)
+        qh = q.reshape(b, s, H, dh)
+        kh = jnp.repeat(k.reshape(b, s, KV, dh), g, axis=2)
+        vh = jnp.repeat(v.reshape(b, s, KV, dh), g, axis=2)
+        out = A.flash_attention_bshd(qh, kh, vh, causal=causal, block_q=16, block_kv=16)
+        return jnp.sum(out.reshape(b, s, H * dh) * cot)
+
+    v1, g1 = jax.value_and_grad(loss_packed)(qkv)
+    v2, g2 = jax.value_and_grad(loss_ref)(qkv)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+    # dq section bitwise (identical kernel work); dkv section to the group
+    # sum's float reassociation (repeat's autodiff sums in its own order).
+    np.testing.assert_array_equal(
+        np.asarray(g1[..., : H * dh]), np.asarray(g2[..., : H * dh])
+    )
+    np.testing.assert_allclose(
+        np.asarray(g1[..., H * dh :]), np.asarray(g2[..., H * dh :]),
+        rtol=1e-5, atol=1e-5,
+    )
