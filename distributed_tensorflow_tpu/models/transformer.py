@@ -57,6 +57,12 @@ class TransformerConfig:
     # cached decode; TpBlock (head-sharded tensor parallelism) requires
     # MHA and says so.
     num_kv_heads: int | None = None
+    # Sliding-window attention (None = full causal): each token attends the
+    # previous ``attention_window`` positions only (self included — the
+    # Mistral convention). On TPU the flash kernels turn this into
+    # O(S·window) compute AND kv DMA via two-sided block skipping/clamping;
+    # the decode path masks the cache the same way.
+    attention_window: int | None = None
     # Rematerialise each block on the backward pass (jax.checkpoint): saves
     # only block boundaries instead of every intermediate — activation memory
     # drops from O(L·S·(d_ff+4·d_model)) to O(L·S·d_model) + one block's
@@ -85,22 +91,23 @@ def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callab
     keep the default 3-arg BHSD callable."""
     if callable(cfg.attention):
         return cfg.attention
+    w = getattr(cfg, "attention_window", None)
     if cfg.attention == "dense":
-        return lambda q, k, v: A.dense_attention(q, k, v, causal=True)
+        return lambda q, k, v: A.dense_attention(q, k, v, causal=True, window=w)
     if cfg.attention == "blockwise":
-        return lambda q, k, v: A.blockwise_attention(q, k, v, causal=True)
+        return lambda q, k, v: A.blockwise_attention(q, k, v, causal=True, window=w)
     if cfg.attention == "flash":
         if prefer_packed:
             # GQA-aware: the kernel's kv column index maps share kv heads
             # across query groups directly — no expanded K/V materializes.
             def fn(qkv):
                 return A.flash_attention_qkv(
-                    qkv, cfg.num_heads, cfg.num_kv_heads, causal=True
+                    qkv, cfg.num_heads, cfg.num_kv_heads, causal=True, window=w
                 )
 
             fn.input_layout = "packed_qkv"
             return fn
-        return lambda q, k, v: A.flash_attention(q, k, v, causal=True)
+        return lambda q, k, v: A.flash_attention(q, k, v, causal=True, window=w)
     raise ValueError(f"unknown attention implementation: {cfg.attention!r}")
 
 
@@ -195,6 +202,8 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
         q_pos = cache["len"] + jnp.arange(s)  # (s,)
         key_pos = jnp.arange(ks.shape[2])  # (S_max,)
         allowed = key_pos[None, :] <= q_pos[:, None]  # (s, S_max)
+        if getattr(cfg, "attention_window", None) is not None:
+            allowed &= key_pos[None, :] > q_pos[:, None] - cfg.attention_window
         scores = jnp.where(allowed[None, None, None, :, :], scores, A.NEG_INF)
         weights = jax.nn.softmax(scores, -1)
         attn = jnp.einsum(

@@ -53,17 +53,31 @@ def _scale(q, scale):
     return (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
 
 
-def dense_attention(q, k, v, causal: bool = False, scale: float | None = None):
+def dense_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    scale: float | None = None,
+    window: int | None = None,
+):
     """O(S²)-memory reference: softmax(q·kᵀ/√d [+ causal mask]) · v.
 
     q: (B, H, Sq, D); k, v: (B, H, Skv, D). Returns (B, H, Sq, D) in q's dtype.
+    ``window`` (requires ``causal``): sliding-window attention — query at
+    global position p attends keys in [p - window + 1, p] (self always
+    included; the Mistral convention).
     """
     s = _scale(q, scale)
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * s
     if causal:
         sq, skv = q.shape[2], k.shape[2]
         # Align the ends: query i attends to keys ≤ i + (skv - sq).
         mask = jnp.tril(jnp.ones((sq, skv), jnp.bool_), k=skv - sq)
+        if window is not None:
+            mask &= jnp.triu(jnp.ones((sq, skv), jnp.bool_), k=skv - sq - window + 1)
         logits = jnp.where(mask, logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     if causal:
@@ -111,6 +125,7 @@ def blockwise_attention(
     scale: float | None = None,
     q_offset: int | jax.Array | None = None,
     kv_offset: int | jax.Array = 0,
+    window: int | None = None,
 ):
     """Memory-efficient attention: ``lax.scan`` over kv blocks with the online
     softmax; never materializes (Sq, Skv). Differentiable (autodiff through
@@ -124,6 +139,8 @@ def blockwise_attention(
     """
     b, h, sq, d = q.shape
     skv = k.shape[2]
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     if q_offset is None:
         q_offset = skv - sq
     s = _scale(q, scale)
@@ -145,6 +162,8 @@ def blockwise_attention(
         )
         valid = (k_pos - kv_offset) < skv  # padding mask
         mask = valid if not causal else (k_pos <= q_pos) & valid
+        if causal and window is not None:
+            mask &= k_pos > q_pos - window
         carry = _online_block_update(carry, q, k_blk, v_blk, mask, s)
         return carry, None
 
@@ -182,6 +201,7 @@ def _flash_kernel(
     causal: bool,
     s: float,
     q_pos_offset: int,
+    window: int | None = None,
 ):
     """One (batch·head, q-block, kv-block) grid cell.
 
@@ -227,7 +247,10 @@ def _flash_kernel(
                 + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
             )
             k_pos = j * block_kv + lax.broadcasted_iota(jnp.int32, (1, block_kv), 1)
-            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+            mask = k_pos <= q_pos
+            if window is not None:
+                mask &= k_pos > q_pos - window
+            logits = jnp.where(mask, logits, NEG_INF)
         m = m_ref[:, :1]
         l = l_ref[:, :1]
         m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
@@ -246,10 +269,17 @@ def _flash_kernel(
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
     if causal:
-        # Skip kv blocks entirely beyond the last query position of this tile.
+        # Skip kv blocks entirely beyond the last query position of this
+        # tile — and, with a sliding window, entirely before the FIRST
+        # query's window start (that lower bound is what turns the cost
+        # from O(S²) to O(S·window)).
         last_q = q_pos_offset + (qi + 1) * bq - 1
+        needed = j * block_kv <= last_q
+        if window is not None:
+            first_q = q_pos_offset + qi * bq
+            needed &= (j + 1) * block_kv - 1 >= first_q - (window - 1)
 
-        @pl.when(j * block_kv <= last_q)
+        @pl.when(needed)
         def _():
             compute()
     else:
@@ -311,23 +341,40 @@ def _fit_block(requested: int, seq: int, interpret: bool = False) -> int:
     return seq
 
 
-def _causal_kv_index(q_pos_offset: int, block_q: int, block_kv: int, num_kv: int):
+def _causal_kv_index(
+    q_pos_offset: int,
+    block_q: int,
+    block_kv: int,
+    num_kv: int,
+    window: int | None = None,
+):
     """Block-sparse kv fetch map shared by the forward and dq kernels:
     clamping the index beyond this q-tile's last needed kv block keeps it
     constant across the skipped tail, so Pallas elides the HBM→VMEM DMA (it
-    only re-fetches when the mapped index changes between grid steps)."""
+    only re-fetches when the mapped index changes between grid steps). With
+    a sliding ``window`` the clamp is two-sided — blocks wholly before the
+    tile's earliest window start are elided too, making kv DMA O(S·window)."""
 
     def kv_index(bh, i, j):
         last_block = jnp.clip(
             (q_pos_offset + (i + 1) * block_q - 1) // block_kv, 0, num_kv - 1
         )
-        return (bh, jnp.minimum(j, last_block), 0)
+        blk = jnp.minimum(j, last_block)
+        if window is not None:
+            first_block = jnp.clip(
+                (q_pos_offset + i * block_q - (window - 1)) // block_kv,
+                0,
+                num_kv - 1,
+            )
+            blk = jnp.maximum(blk, first_block)
+        return (bh, blk, 0)
 
     return kv_index
 
 
 def _flash_forward(
-    q, k, v, causal, block_q, block_kv, scale, interpret, with_lse: bool = False
+    q, k, v, causal, block_q, block_kv, scale, interpret,
+    with_lse: bool = False, window: int | None = None,
 ):
     if not HAVE_PALLAS:
         raise RuntimeError(
@@ -351,9 +398,10 @@ def _flash_forward(
         causal=causal,
         s=s,
         q_pos_offset=skv - sq,  # end-aligned causal, matching dense_attention
+        window=window,
     )
     if causal:
-        kv_index = _causal_kv_index(skv - sq, block_q, block_kv, num_kv)
+        kv_index = _causal_kv_index(skv - sq, block_q, block_kv, num_kv, window)
     else:
         kv_index = lambda bh, i, j: (bh, j, 0)
     out, lse = pl.pallas_call(
@@ -400,6 +448,7 @@ def _flash_forward(
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
     *, block_kv: int, num_kv: int, causal: bool, s: float, q_pos_offset: int,
+    window: int | None = None,
 ):
     qi = pl.program_id(1)
     j = pl.program_id(2)
@@ -431,7 +480,10 @@ def _flash_bwd_dq_kernel(
                 jnp.int32, (bq, 1), 0
             )
             k_pos = j * block_kv + lax.broadcasted_iota(jnp.int32, (1, block_kv), 1)
-            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+            mask = k_pos <= q_pos
+            if window is not None:
+                mask &= k_pos > q_pos - window
+            logits = jnp.where(mask, logits, NEG_INF)
         # Fully-masked rows have lse == NEG_INF (finite), so exp(logits -
         # lse) would be exp(0) = 1, not 0 — zero them explicitly.
         p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(logits - lse))
@@ -447,8 +499,12 @@ def _flash_bwd_dq_kernel(
 
     if causal:
         last_q = q_pos_offset + (qi + 1) * bq - 1
+        needed = j * block_kv <= last_q
+        if window is not None:
+            first_q = q_pos_offset + qi * bq
+            needed &= (j + 1) * block_kv - 1 >= first_q - (window - 1)
 
-        @pl.when(j * block_kv <= last_q)
+        @pl.when(needed)
         def _():
             compute()
     else:
@@ -463,6 +519,7 @@ def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
     *, block_q: int, num_q: int, causal: bool, s: float, q_pos_offset: int,
+    window: int | None = None,
 ):
     kj = pl.program_id(1)
     i = pl.program_id(2)
@@ -495,7 +552,10 @@ def _flash_bwd_dkv_kernel(
                 jnp.int32, (bq, 1), 0
             )
             k_pos = kj * bkv + lax.broadcasted_iota(jnp.int32, (1, bkv), 1)
-            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+            mask = k_pos <= q_pos
+            if window is not None:
+                mask &= k_pos > q_pos - window
+            logits = jnp.where(mask, logits, NEG_INF)
         p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(logits - lse))
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -513,8 +573,16 @@ def _flash_bwd_dkv_kernel(
 
     if causal:
         # Skip q tiles that end before this kv block starts (no query in the
-        # tile can see these keys).
-        @pl.when(q_pos_offset + (i + 1) * block_q - 1 >= kj * bkv)
+        # tile can see these keys) — and, windowed, tiles that START after
+        # the last query that can still see this block.
+        needed = q_pos_offset + (i + 1) * block_q - 1 >= kj * bkv
+        if window is not None:
+            needed &= (
+                q_pos_offset + i * block_q
+                <= kj * bkv + bkv - 1 + (window - 1)
+            )
+
+        @pl.when(needed)
         def _():
             compute()
     else:
@@ -530,7 +598,7 @@ def _flash_bwd_fused_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, out_ref,
     dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc, delta_acc,
     *, num_q: int, num_kv: int, causal: bool, s: float,
-    q_pos_offset: int,
+    q_pos_offset: int, window: int | None = None,
 ):
     """ONE-pass backward: grid (bh, kj, i) — kv outer so dk/dv accumulate in
     per-kj scratch exactly like :func:`_flash_bwd_dkv_kernel`, while dq
@@ -598,7 +666,10 @@ def _flash_bwd_fused_kernel(
                 jnp.int32, (bq, 1), 0
             )
             k_pos = kj * bkv + lax.broadcasted_iota(jnp.int32, (1, bkv), 1)
-            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+            mask = k_pos <= q_pos
+            if window is not None:
+                mask &= k_pos > q_pos - window
+            logits = jnp.where(mask, logits, NEG_INF)
         p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(logits - lse))
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -620,7 +691,13 @@ def _flash_bwd_fused_kernel(
         )  # dS·k: (bq, D)
 
     if causal:
-        @pl.when(q_pos_offset + (i + 1) * bq - 1 >= kj * bkv)
+        needed = q_pos_offset + (i + 1) * bq - 1 >= kj * bkv
+        if window is not None:
+            needed &= (
+                q_pos_offset + i * bq <= kj * bkv + bkv - 1 + (window - 1)
+            )
+
+        @pl.when(needed)
         def _():
             compute()
     else:
@@ -664,17 +741,34 @@ def _dq_scratch_bytes_per_row(d: int) -> int:
     return -(-d // 128) * 128 * 4 + _STAT_LANES * 4
 
 
-def _causal_q_index(q_pos_offset: int, block_q: int, block_kv: int, num_q: int):
+def _causal_q_index(
+    q_pos_offset: int,
+    block_q: int,
+    block_kv: int,
+    num_q: int,
+    window: int | None = None,
+):
     """q-side twin of :func:`_causal_kv_index` for kv-outer grids: q tiles
     strictly before kv block ``kj`` are skipped, and clamping the mapped
     index over the skipped prefix keeps it constant so Pallas elides the
-    HBM→VMEM DMA."""
+    HBM→VMEM DMA. With a sliding ``window`` the clamp is two-sided — q
+    tiles past the last query that can still see block ``kj`` are elided
+    too."""
 
     def q_index(bh, kj, i):
         first_block = jnp.clip(
             (kj * block_kv - q_pos_offset) // block_q, 0, num_q - 1
         )
-        return (bh, jnp.maximum(i, first_block), 0)
+        blk = jnp.maximum(i, first_block)
+        if window is not None:
+            last_block = jnp.clip(
+                (kj * block_kv + block_kv - 1 + (window - 1) - q_pos_offset)
+                // block_q,
+                0,
+                num_q - 1,
+            )
+            blk = jnp.minimum(blk, last_block)
+        return (bh, blk, 0)
 
     return q_index
 
@@ -698,7 +792,7 @@ def _fused_segment_rows(sq: int, d: int, block_q: int) -> int | None:
 
 def _flash_backward_fused(
     q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
-    q_pos_offset: int | None = None,
+    q_pos_offset: int | None = None, window: int | None = None,
 ):
     """One fused-kernel call; ``q_pos_offset`` overrides the end-aligned
     default when the q tensor is a SEGMENT of a longer sequence (the
@@ -721,13 +815,29 @@ def _flash_backward_fused(
     lsef = lse.reshape(b * h, sq, 1)
 
     if causal:
-        q_index = _causal_q_index(q_pos_offset, block_q, block_kv, num_q)
+        base_q_map = _causal_q_index(q_pos_offset, block_q, block_kv, num_q, window)
+
+        def q_index(bh, kj, i):
+            # The kj==0 sweep computes the in-kernel delta for EVERY q tile,
+            # so the q/do fetch must be the REAL tile there — the windowed
+            # upper clamp (which elides out-of-window tiles at kj > 0) would
+            # otherwise feed delta the wrong rows.
+            return (bh, jnp.where(kj == 0, i, base_q_map(bh, kj, i)[1]), 0)
+
         # kv blocks wholly after this call's LAST q position (a q SEGMENT of
         # a longer sequence sees only a prefix of kv) are compute-skipped —
         # clamping their mapped index keeps it constant so the k/v DMAs are
-        # elided, not just the math.
+        # elided, not just the math. Windowed, the clamp gains a LOWER end:
+        # blocks before the segment's earliest window start are elided too,
+        # keeping segmented-backward kv traffic O(S·window).
         last_kv = max(0, min(num_kv - 1, (q_pos_offset + sq - 1) // block_kv))
-        kv_index = lambda bh, kj, i: (bh, jnp.minimum(kj, last_kv), 0)
+        first_kv = (
+            0 if window is None
+            else max(0, min(num_kv - 1, (q_pos_offset - (window - 1)) // block_kv))
+        )
+        kv_index = lambda bh, kj, i: (
+            bh, jnp.maximum(jnp.minimum(kj, last_kv), first_kv), 0
+        )
     else:
         q_index = lambda bh, kj, i: (bh, i, 0)
         kv_index = lambda bh, kj, i: (bh, kj, 0)
@@ -740,7 +850,7 @@ def _flash_backward_fused(
         functools.partial(
             _flash_bwd_fused_kernel,
             num_q=num_q, num_kv=num_kv, causal=causal, s=s,
-            q_pos_offset=q_pos_offset,
+            q_pos_offset=q_pos_offset, window=window,
         ),
         grid=(b * h, num_kv, num_q),
         in_specs=[
@@ -777,7 +887,10 @@ def _flash_backward_fused(
     )
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret):
+def _flash_backward(
+    q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
+    window: int | None = None,
+):
     b, h, sq, d = q.shape
     skv = k.shape[2]
     s = _scale(q, scale)
@@ -785,7 +898,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
         interpret = jax.default_backend() != "tpu"
     if sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_SCRATCH_LIMIT:
         return _flash_backward_fused(
-            q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
+            q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
+            window=window,
         )
     # Longer sequences: run the fused kernel per q-SEGMENT (each segment's
     # dq scratch fits VMEM). Segment dqs are disjoint row ranges
@@ -816,6 +930,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
                 scale,
                 interpret,
                 q_pos_offset=offset0 + a,
+                window=window,
             )
             dqs.append(dq_s)
             dk_tot = dk_s if dk_tot is None else dk_tot + dk_s
@@ -837,7 +952,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
     deltaf = delta.reshape(b * h, sq, 1)
 
     if causal:
-        kv_index = _causal_kv_index(q_pos_offset, block_q, block_kv, num_kv)
+        kv_index = _causal_kv_index(q_pos_offset, block_q, block_kv, num_kv, window)
     else:
         kv_index = lambda bh, i, j: (bh, j, 0)
 
@@ -845,7 +960,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
         functools.partial(
             _flash_bwd_dq_kernel,
             block_kv=block_kv, num_kv=num_kv, causal=causal, s=s,
-            q_pos_offset=q_pos_offset,
+            q_pos_offset=q_pos_offset, window=window,
         ),
         grid=(b * h, num_q, num_kv),
         in_specs=[
@@ -863,7 +978,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
     )(qf, kf, vf, gf, lsef, deltaf)
 
     if causal:
-        q_index = _causal_q_index(q_pos_offset, block_q, block_kv, num_q)
+        q_index = _causal_q_index(q_pos_offset, block_q, block_kv, num_q, window)
     else:
         q_index = lambda bh, kj, i: (bh, i, 0)
 
@@ -871,7 +986,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_kv, scale, inte
         functools.partial(
             _flash_bwd_dkv_kernel,
             block_q=block_q, num_q=num_q, causal=causal, s=s,
-            q_pos_offset=q_pos_offset,
+            q_pos_offset=q_pos_offset, window=window,
         ),
         grid=(b * h, num_kv, num_q),
         in_specs=[
@@ -942,7 +1057,8 @@ def _bshd_maps(h: int, base_q=None, base_kv=None):
 
 
 def _flash_forward_bshd(
-    q, k, v, causal, block_q, block_kv, scale, interpret, with_lse: bool = False
+    q, k, v, causal, block_q, block_kv, scale, interpret,
+    with_lse: bool = False, window: int | None = None,
 ):
     """q, k, v: (B, S, H, dh) — the layout the qkv projection produces.
     Returns out in the same layout (and lse as (B*H, Sq, 1) when asked)."""
@@ -960,7 +1076,7 @@ def _flash_forward_bshd(
         bhsd = lambda t: t.transpose(0, 2, 1, 3)
         res = _flash_forward(
             bhsd(q), bhsd(k), bhsd(v), causal, block_q, block_kv, scale,
-            interpret, with_lse=with_lse,
+            interpret, with_lse=with_lse, window=window,
         )
         if with_lse:
             out, lse = res
@@ -980,9 +1096,11 @@ def _flash_forward_bshd(
         causal=causal,
         s=s,
         q_pos_offset=skv - sq,
+        window=window,
     )
     base_kv = (
-        _causal_kv_index(skv - sq, block_q, block_kv, num_kv) if causal else None
+        _causal_kv_index(skv - sq, block_q, block_kv, num_kv, window)
+        if causal else None
     )
     q_index, kv_index = _bshd_maps(h, base_kv=base_kv)
     out, lse = pl.pallas_call(
@@ -1016,7 +1134,7 @@ def _flash_forward_bshd(
 
 def _flash_backward_fused_bshd(
     q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
-    q_pos_offset: int | None = None,
+    q_pos_offset: int | None = None, window: int | None = None,
 ):
     """Fused one-pass backward reading/writing (B, S, H, dh) directly.
     ``lse`` is the forward's (B*H, Sq, 1) statistic."""
@@ -1036,17 +1154,27 @@ def _flash_backward_fused_bshd(
     outf = out.reshape(b, sq, h * d)
 
     base_q = (
-        _causal_q_index(q_pos_offset, block_q, block_kv, num_q) if causal else None
+        _causal_q_index(q_pos_offset, block_q, block_kv, num_q, window)
+        if causal else None
     )
     if causal:
         last_kv = max(0, min(num_kv - 1, (q_pos_offset + sq - 1) // block_kv))
-        base_kv = lambda bh, kj, i: (bh, jnp.minimum(kj, last_kv), 0)
+        first_kv = (
+            0 if window is None
+            else max(0, min(num_kv - 1, (q_pos_offset - (window - 1)) // block_kv))
+        )
+        base_kv = lambda bh, kj, i: (
+            bh, jnp.maximum(jnp.minimum(kj, last_kv), first_kv), 0
+        )
     else:
         base_kv = None
     # Fused grid is (bh, kj, i): q-side blocks key on i (3rd grid axis),
     # kv-side on kj (2nd) — mirror _flash_backward_fused's maps.
     def q_index(bh, kj, i):
         blk = i if base_q is None else base_q(bh, kj, i)[1]
+        # kj==0 computes the in-kernel delta for EVERY q tile: fetch the
+        # real tile there (the windowed upper clamp applies at kj > 0 only).
+        blk = jnp.where(kj == 0, i, blk)
         return (bh // h, blk, bh % h)
 
     def stat_index(bh, kj, i):
@@ -1065,7 +1193,7 @@ def _flash_backward_fused_bshd(
         functools.partial(
             _flash_bwd_fused_kernel,
             num_q=num_q, num_kv=num_kv, causal=causal, s=s,
-            q_pos_offset=q_pos_offset,
+            q_pos_offset=q_pos_offset, window=window,
         ),
         grid=(b * h, num_kv, num_q),
         in_specs=[
@@ -1103,7 +1231,8 @@ def _flash_backward_fused_bshd(
 
 
 def _flash_backward_bshd(
-    q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
+    q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
+    window: int | None = None,
 ):
     b, sq, h, d = q.shape
     if interpret is None:
@@ -1117,6 +1246,7 @@ def _flash_backward_bshd(
         dq, dk, dv = _flash_backward(
             bhsd(q), bhsd(k), bhsd(v), bhsd(out), lse.reshape(b, h, sq),
             bhsd(g), causal, block_q, block_kv, scale, interpret,
+            window=window,
         )
         return bhsd(dq), bhsd(dk), bhsd(dv)
 
@@ -1124,7 +1254,8 @@ def _flash_backward_bshd(
         return via_bhsd()
     if sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_SCRATCH_LIMIT:
         return _flash_backward_fused_bshd(
-            q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
+            q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
+            window=window,
         )
     seg = _fused_segment_rows(sq, d, _fit_block(block_q, sq, interpret))
     if seg is not None:
@@ -1147,6 +1278,7 @@ def _flash_backward_bshd(
                 scale,
                 interpret,
                 q_pos_offset=offset0 + a,
+                window=window,
             )
             dqs.append(dq_s)
             dk_tot = dk_s if dk_tot is None else dk_tot + dk_s
@@ -1156,7 +1288,7 @@ def _flash_backward_bshd(
     return via_bhsd()
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_bshd(
     q,
     k,
@@ -1166,6 +1298,7 @@ def flash_attention_bshd(
     block_kv: int = 1024,
     scale: float | None = None,
     interpret: bool | None = None,
+    window: int | None = None,
 ):
     """:func:`flash_attention` on the ACTIVATION layout: q, k, v and the
     result are (B, S, H, head_dim) — a free reshape of the qkv projection's
@@ -1175,20 +1308,28 @@ def flash_attention_bshd(
     end-alignment, segmentation and fallbacks are identical to
     :func:`flash_attention`; head dims not divisible by 128 transparently
     take the transpose path."""
-    return _flash_forward_bshd(q, k, v, causal, block_q, block_kv, scale, interpret)
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
+    return _flash_forward_bshd(
+        q, k, v, causal, block_q, block_kv, scale, interpret, window=window
+    )
 
 
-def _flash_bshd_fwd(q, k, v, causal, block_q, block_kv, scale, interpret):
+def _flash_bshd_fwd(q, k, v, causal, block_q, block_kv, scale, interpret, window):
     out, lse = _flash_forward_bshd(
-        q, k, v, causal, block_q, block_kv, scale, interpret, with_lse=True
+        q, k, v, causal, block_q, block_kv, scale, interpret, with_lse=True,
+        window=window,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bshd_bwd(causal, block_q, block_kv, scale, interpret, residuals, g):
+def _flash_bshd_bwd(
+    causal, block_q, block_kv, scale, interpret, window, residuals, g
+):
     q, k, v, out, lse = residuals
     return _flash_backward_bshd(
-        q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
+        q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
+        window=window,
     )
 
 
@@ -1223,7 +1364,8 @@ def _unpack_qkv(qkv, h, kv=None):
 
 
 def _flash_forward_qkv(
-    qkv, h, kv, causal, block_q, block_kv, scale, interpret, with_lse: bool = False
+    qkv, h, kv, causal, block_q, block_kv, scale, interpret,
+    with_lse: bool = False, window: int | None = None,
 ):
     """qkv: (B, S, (H + 2·KV)·dh), columns [q | k | v], heads contiguous
     within each section (KV == H is plain MHA; under GQA each group of
@@ -1252,7 +1394,8 @@ def _flash_forward_qkv(
     if not interpret and d % 128:
         q, k, v = _unpack_qkv(qkv, h, kv)
         res = _flash_forward_bshd(
-            q, k, v, causal, block_q, block_kv, scale, interpret, with_lse=with_lse
+            q, k, v, causal, block_q, block_kv, scale, interpret,
+            with_lse=with_lse, window=window,
         )
         if with_lse:
             out, lse = res
@@ -1269,8 +1412,11 @@ def _flash_forward_qkv(
         causal=causal,
         s=s,
         q_pos_offset=0,
+        window=window,
     )
-    base_kv = _causal_kv_index(0, block_q, block_kv, num_kv) if causal else None
+    base_kv = (
+        _causal_kv_index(0, block_q, block_kv, num_kv, window) if causal else None
+    )
 
     def q_index(bh, i, j):
         return (bh // h, i, bh % h)
@@ -1312,7 +1458,8 @@ def _flash_forward_qkv(
 
 
 def _flash_backward_qkv(
-    qkv, h, kv, out, lse, g, causal, block_q, block_kv, scale, interpret
+    qkv, h, kv, out, lse, g, causal, block_q, block_kv, scale, interpret,
+    window: int | None = None,
 ):
     b, sq, width = qkv.shape
     d = width // (h + 2 * kv)
@@ -1338,7 +1485,7 @@ def _flash_backward_qkv(
         q, k, v = _unpack_qkv(qkv, h, kv)
         dq, dk, dv = _flash_backward_bshd(
             q, k, v, out.reshape(b, sq, h, d), lse, g.reshape(b, sq, h, d),
-            causal, block_q, block_kv, scale, interpret,
+            causal, block_q, block_kv, scale, interpret, window=window,
         )
         return jnp.concatenate(
             [dq.reshape(b, sq, dm), regroup_kv(dk), regroup_kv(dv)], axis=-1
@@ -1348,10 +1495,15 @@ def _flash_backward_qkv(
     block_kv = _fit_block(block_kv, sq, interpret)
     num_q, num_kv = sq // block_q, sq // block_kv
 
-    base_q = _causal_q_index(0, block_q, block_kv, num_q) if causal else None
+    base_q = (
+        _causal_q_index(0, block_q, block_kv, num_q, window) if causal else None
+    )
 
     def q_index(bh, kj, i):
         blk = i if base_q is None else base_q(bh, kj, i)[1]
+        # kj==0 computes the in-kernel delta for EVERY q tile: fetch the
+        # real tile there (the windowed upper clamp applies at kj > 0 only).
+        blk = jnp.where(kj == 0, i, blk)
         return (bh // h, blk, bh % h)
 
     def stat_index(bh, kj, i):
@@ -1377,6 +1529,7 @@ def _flash_backward_qkv(
         functools.partial(
             _flash_bwd_fused_kernel,
             num_q=num_q, num_kv=num_kv, causal=causal, s=s, q_pos_offset=0,
+            window=window,
         ),
         grid=(b * h, num_kv, num_q),
         in_specs=[
@@ -1409,7 +1562,7 @@ def _flash_backward_qkv(
                             regroup_kv(dv_exp.reshape(b, sq, h, d))], axis=-1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
 def flash_attention_qkv(
     qkv,
     num_heads: int,
@@ -1419,6 +1572,7 @@ def flash_attention_qkv(
     block_kv: int = 1024,
     scale: float | None = None,
     interpret: bool | None = None,
+    window: int | None = None,
 ):
     """Flash SELF-attention on the packed qkv projection output: ``qkv`` is
     (B, S, (H + 2·KV)·head_dim) with columns [q | k | v], heads contiguous
@@ -1430,28 +1584,36 @@ def flash_attention_qkv(
     transpose of the sharing). Same kernels, blocks, causal semantics and
     fallbacks as :func:`flash_attention`; the gradient arrives as one
     packed cotangent that feeds the qkv matmul backward directly."""
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     kv = num_heads if num_kv_heads is None else num_kv_heads
     return _flash_forward_qkv(
-        qkv, num_heads, kv, causal, block_q, block_kv, scale, interpret
+        qkv, num_heads, kv, causal, block_q, block_kv, scale, interpret,
+        window=window,
     )
 
 
-def _flash_qkv_fwd(qkv, h, num_kv_heads, causal, block_q, block_kv, scale, interpret):
+def _flash_qkv_fwd(
+    qkv, h, num_kv_heads, causal, block_q, block_kv, scale, interpret, window
+):
     kv = h if num_kv_heads is None else num_kv_heads
     out, lse = _flash_forward_qkv(
-        qkv, h, kv, causal, block_q, block_kv, scale, interpret, with_lse=True
+        qkv, h, kv, causal, block_q, block_kv, scale, interpret, with_lse=True,
+        window=window,
     )
     return out, (qkv, out, lse)
 
 
 def _flash_qkv_bwd(
-    h, num_kv_heads, causal, block_q, block_kv, scale, interpret, residuals, g
+    h, num_kv_heads, causal, block_q, block_kv, scale, interpret, window,
+    residuals, g,
 ):
     kv = h if num_kv_heads is None else num_kv_heads
     qkv, out, lse = residuals
     return (
         _flash_backward_qkv(
-            qkv, h, kv, out, lse, g, causal, block_q, block_kv, scale, interpret
+            qkv, h, kv, out, lse, g, causal, block_q, block_kv, scale,
+            interpret, window=window,
         ),
     )
 
@@ -1459,7 +1621,7 @@ def _flash_qkv_bwd(
 flash_attention_qkv.defvjp(_flash_qkv_fwd, _flash_qkv_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(
     q,
     k,
@@ -1469,6 +1631,7 @@ def flash_attention(
     block_kv: int = 1024,
     scale: float | None = None,
     interpret: bool | None = None,
+    window: int | None = None,
 ):
     """Pallas flash-attention (TPU; interpret-mode elsewhere): forward with
     online softmax in VMEM scratch; backward is the fused one-pass kernel
@@ -1484,20 +1647,26 @@ def flash_attention(
     ~2.3 MB of tiles+scratch; the fused backward adds the dq scratch and
     resident (block, block) f32 intermediates, still inside a v5e core's
     ~16 MB."""
-    return _flash_forward(q, k, v, causal, block_q, block_kv, scale, interpret)
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
+    return _flash_forward(
+        q, k, v, causal, block_q, block_kv, scale, interpret, window=window
+    )
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_kv, scale, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_kv, scale, interpret, window):
     out, lse = _flash_forward(
-        q, k, v, causal, block_q, block_kv, scale, interpret, with_lse=True
+        q, k, v, causal, block_q, block_kv, scale, interpret, with_lse=True,
+        window=window,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_kv, scale, interpret, residuals, g):
+def _flash_bwd(causal, block_q, block_kv, scale, interpret, window, residuals, g):
     q, k, v, out, lse = residuals
     return _flash_backward(
-        q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret
+        q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
+        window=window,
     )
 
 

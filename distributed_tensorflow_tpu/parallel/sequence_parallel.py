@@ -33,6 +33,12 @@ def make_sp_model(cfg: TransformerConfig, seq_axis: str = "model") -> Transforme
     """The sequence-parallel variant of a TransformerLM config: same params,
     attention replaced by a causal ring over ``seq_axis``. Param trees are
     interchangeable with the single-device model (attention has no state)."""
+    if getattr(cfg, "attention_window", None) is not None:
+        raise ValueError(
+            "attention_window is not supported by the ring-attention "
+            "sequence-parallel path (the ring streams full kv shards); "
+            "unset it here or train windowed models single-chip/data-parallel"
+        )
     ring = lambda q, k, v: ring_attention(q, k, v, axis_name=seq_axis, causal=True)
     return TransformerLM(
         TransformerConfig(**{**cfg.__dict__, "attention": ring})

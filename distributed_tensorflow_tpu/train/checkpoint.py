@@ -289,6 +289,7 @@ def load_lm_bundle(path: str, fallback_shapes: dict | None = None):
         num_heads=dim("num_heads", 4),
         # 0/absent = MHA (pre-GQA bundles carry no num_kv_heads key).
         num_kv_heads=dim("num_kv_heads", 0) or None,
+        attention_window=dim("attention_window", 0) or None,
         num_layers=dim("num_layers", 4),
         d_ff=dim("d_ff", 512),
         max_seq_len=dim("max_seq_len", 128),

@@ -82,6 +82,12 @@ def main(argv=None):
         help="grouped-query attention: K/V heads shared by query groups "
              "(0 = multi-head; shrinks the KV cache and kv projections)",
     )
+    parser.add_argument(
+        "--attention_window", type=int, default=0,
+        help="sliding-window causal attention: each token attends the "
+             "previous N positions only (0 = full causal; the flash "
+             "kernels skip out-of-window blocks, O(S*window) cost)",
+    )
     parser.add_argument("--num_layers", type=int, default=4)
     parser.add_argument("--d_ff", type=int, default=512)
     parser.add_argument("--learning_rate", type=float, default=3e-3)
@@ -191,6 +197,7 @@ def main(argv=None):
         d_model=args.d_model,
         num_heads=args.num_heads,
         num_kv_heads=args.num_kv_heads or None,
+        attention_window=args.attention_window or None,
         num_layers=args.num_layers,
         d_ff=args.d_ff,
         max_seq_len=args.seq_len,
@@ -523,6 +530,7 @@ def main(argv=None):
                     "d_model": cfg.d_model,
                     "num_heads": cfg.num_heads,
                     "num_kv_heads": cfg.num_kv_heads or 0,
+                    "attention_window": cfg.attention_window or 0,
                     "num_layers": cfg.num_layers,
                     "d_ff": cfg.d_ff,
                     "max_seq_len": cfg.max_seq_len,
