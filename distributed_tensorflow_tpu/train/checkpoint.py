@@ -290,6 +290,9 @@ def load_lm_bundle(path: str, fallback_shapes: dict | None = None):
         # 0/absent = MHA (pre-GQA bundles carry no num_kv_heads key).
         num_kv_heads=dim("num_kv_heads", 0) or None,
         attention_window=dim("attention_window", 0) or None,
+        # 1/absent = biased Dense layers (pre-r5 bundles carry no use_bias
+        # key and were always trained with biases on the CLI path).
+        use_bias=bool(dim("use_bias", 1)),
         num_layers=dim("num_layers", 4),
         d_ff=dim("d_ff", 512),
         max_seq_len=dim("max_seq_len", 128),

@@ -60,7 +60,10 @@ def transformer_train_flops(
         Embedding lookup is a gather — 0 matmul FLOPs.
       * attention scores+values: per layer fwd 4·B·S²·d dense, halved for
         causal (the blockwise/flash kernels actually skip the masked half,
-        and masked work isn't "model FLOPs" either way).
+        and masked work isn't "model FLOPs" either way). With a sliding
+        ``cfg.attention_window`` the causal count is the BANDED area —
+        position i attends min(i+1, window) keys — so a windowed run's MFU
+        is not credited the full triangle it never computes.
     Remat recompute is deliberately NOT counted — MFU measures useful work.
     """
     s = int(cfg.max_seq_len if seq_len is None else seq_len)
@@ -76,9 +79,16 @@ def transformer_train_flops(
         + d * cfg.vocab_size
     )
     dense = 2 * tokens * n_matmul
-    attn = 4 * b * s * s * d * cfg.num_layers
-    if causal:
-        attn //= 2
+    window = getattr(cfg, "attention_window", None)
+    if causal and window is not None and window < s:
+        # Exact attended (q, k) pair count of the band: the first `window`
+        # rows ramp 1..window, the rest attend `window` keys each.
+        pairs = window * (window + 1) // 2 + (s - window) * window
+        attn = 4 * b * pairs * d * cfg.num_layers
+    else:
+        attn = 4 * b * s * s * d * cfg.num_layers
+        if causal:
+            attn //= 2
     return 3 * (dense + attn)
 
 

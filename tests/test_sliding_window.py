@@ -192,3 +192,28 @@ def test_window_rejects_nonpositive_and_ring_path():
     )
     with pytest.raises(ValueError, match="ring"):
         sp.make_sp_model(cfg)
+
+
+def test_windowed_flops_accounting_banded():
+    """MFU accounting: a windowed config is credited the banded attended
+    area, not the full causal triangle (ADVICE r4 — a windowed run's MFU
+    would otherwise be inflated by the work the kernels skip)."""
+    from distributed_tensorflow_tpu.utils.flops import transformer_train_flops
+
+    base = dict(
+        vocab_size=64, d_model=64, num_heads=4, num_layers=2, d_ff=128,
+        max_seq_len=256,
+    )
+    full = transformer_train_flops(TransformerConfig(**base), batch_size=2)
+    win = transformer_train_flops(
+        TransformerConfig(**base, attention_window=32), batch_size=2
+    )
+    s, w, b, d, L = 256, 32, 2, 64, 2
+    # Difference is purely attention: full triangle s*s/2 vs the band.
+    band_pairs = w * (w + 1) // 2 + (s - w) * w
+    expected_delta = 3 * 4 * b * d * L * (s * s // 2 - band_pairs)
+    assert full - win == expected_delta
+    # window >= s degenerates to the full-causal count.
+    assert transformer_train_flops(
+        TransformerConfig(**base, attention_window=256), batch_size=2
+    ) == full

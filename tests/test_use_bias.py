@@ -149,3 +149,66 @@ def test_tp_pp_moe_3d_builders_run_bias_free():
     )
     _, _, _, m = td_step(td_p, td_o, g3, toks3, jax.random.PRNGKey(4))
     assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+def test_bundle_roundtrip_preserves_use_bias(tmp_path):
+    """ADVICE r4: a bias-free bundle must restore bias-free — use_bias rides
+    the bundle config metadata like num_kv_heads/attention_window, so
+    load_lm_bundle's template matches the saved state tree."""
+    from distributed_tensorflow_tpu.train.checkpoint import (
+        export_inference_bundle,
+        load_lm_bundle,
+    )
+
+    cfg = _cfg()
+    m = TransformerLM(cfg)
+    p = jax.device_get(
+        m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    )
+    path = str(tmp_path / "lm.msgpack")
+    export_inference_bundle(
+        path,
+        p,
+        metadata={
+            "model": "TransformerLM",
+            "parallelism": "dp",
+            "config": {
+                "vocab_size": cfg.vocab_size,
+                "d_model": cfg.d_model,
+                "num_heads": cfg.num_heads,
+                "num_kv_heads": 0,
+                "attention_window": 0,
+                "use_bias": 0,
+                "num_layers": cfg.num_layers,
+                "d_ff": cfg.d_ff,
+                "max_seq_len": cfg.max_seq_len,
+            },
+        },
+    )
+    cfg2, params2, _ = load_lm_bundle(path)
+    assert cfg2.use_bias is False
+    assert _no_bias_leaves(params2) == []
+    # Pre-r5 bundles (no use_bias key) default to biased.
+    export_inference_bundle(
+        path,
+        jax.device_get(
+            TransformerLM(_cfg(use_bias=True)).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+        ),
+        metadata={
+            "model": "TransformerLM",
+            "parallelism": "dp",
+            "config": {
+                "vocab_size": cfg.vocab_size,
+                "d_model": cfg.d_model,
+                "num_heads": cfg.num_heads,
+                "num_layers": cfg.num_layers,
+                "d_ff": cfg.d_ff,
+                "max_seq_len": cfg.max_seq_len,
+            },
+        },
+    )
+    cfg3, params3, _ = load_lm_bundle(path)
+    assert cfg3.use_bias is True
+    assert _no_bias_leaves(params3) != []

@@ -88,6 +88,13 @@ def main(argv=None):
              "previous N positions only (0 = full causal; the flash "
              "kernels skip out-of-window blocks, O(S*window) cost)",
     )
+    parser.add_argument(
+        "--use_bias", type=int, default=1, choices=(0, 1),
+        help="Dense-layer biases (1 = biased, the historical default; 0 = "
+             "bias-free, the modern-LM convention the bench flagship uses — "
+             "worth ~2%% of a step: XLA emits each bias gradient as a "
+             "separate unfused whole-activation reduce)",
+    )
     parser.add_argument("--num_layers", type=int, default=4)
     parser.add_argument("--d_ff", type=int, default=512)
     parser.add_argument("--learning_rate", type=float, default=3e-3)
@@ -198,6 +205,7 @@ def main(argv=None):
         num_heads=args.num_heads,
         num_kv_heads=args.num_kv_heads or None,
         attention_window=args.attention_window or None,
+        use_bias=bool(args.use_bias),
         num_layers=args.num_layers,
         d_ff=args.d_ff,
         max_seq_len=args.seq_len,
@@ -531,6 +539,7 @@ def main(argv=None):
                     "num_heads": cfg.num_heads,
                     "num_kv_heads": cfg.num_kv_heads or 0,
                     "attention_window": cfg.attention_window or 0,
+                    "use_bias": int(cfg.use_bias),
                     "num_layers": cfg.num_layers,
                     "d_ff": cfg.d_ff,
                     "max_seq_len": cfg.max_seq_len,
