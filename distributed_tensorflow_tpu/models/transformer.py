@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_tensorflow_tpu.ops import attention as A
+from distributed_tensorflow_tpu.ops.rope import apply_rope, rope_tables
 
 
 @dataclass(frozen=True)
@@ -63,6 +64,14 @@ class TransformerConfig:
     # O(S·window) compute AND kv DMA via two-sided block skipping/clamping;
     # the decode path masks the cache the same way.
     attention_window: int | None = None
+    # Position encoding: 'learned' (additive max_seq_len x d_model table,
+    # the historical default) or 'rope' (rotary embeddings, ops/rope.py):
+    # q/k head vectors rotate by position-dependent angles BEFORE the
+    # attention kernels — no position table params, relative offsets in the
+    # dot product, fused-elementwise cost on TPU. Completes the
+    # GQA + sliding-window + RoPE modern-attention trio.
+    position: str = "learned"  # 'learned' | 'rope'
+    rope_theta: float = 10000.0
     # Rematerialise each block on the backward pass (jax.checkpoint): saves
     # only block boundaries instead of every intermediate — activation memory
     # drops from O(L·S·(d_ff+4·d_model)) to O(L·S·d_model) + one block's
@@ -111,13 +120,19 @@ def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callab
     raise ValueError(f"unknown attention implementation: {cfg.attention!r}")
 
 
-def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
+def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
+                       positions=None):
     """Pre-norm self-attention + residual, shared by :class:`Block` and the
     MoE block (``parallel/expert_parallel.py``). MUST be called from inside
     an ``@nn.compact`` module body — layers are declared with fixed names
     (``ln1``/``qkv``/``proj``) on the CALLING module, so extracting this
     helper changed no parameter tree. Returns ``(x, cache)`` (cache None on
-    the plain path)."""
+    the plain path).
+
+    ``positions`` (B, S) global token positions — only consumed when
+    ``cfg.position == 'rope'`` (the q/k head rotation needs them; sequence
+    shards pass their global positions, same contract as ``pos_embed``).
+    None defaults to ``arange(S)`` offset by the cache's filled length."""
     h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x)
     b, s, _ = h.shape
     dh = cfg.d_model // cfg.num_heads
@@ -133,6 +148,15 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
         cfg.d_model + 2 * kv * dh, dtype=cfg.compute_dtype, name="qkv",
         use_bias=cfg.use_bias,
     )(h)
+
+    rope = getattr(cfg, "position", "learned") == "rope"
+    if rope:
+        # One cos/sin table per sublayer call; XLA CSEs the identical
+        # tables across layers. (1, S, half) or (B, S, half), f32.
+        cos, sin = rope_tables(
+            dh, s, cfg.rope_theta, positions=positions,
+            start=cache["len"] if cache is not None else 0,
+        )
 
     def split_qkv():
         return jnp.split(qkv, [cfg.d_model, cfg.d_model + kv * dh], axis=-1)
@@ -155,6 +179,19 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
         # GQA included: the kernel's kv column index maps share kv heads
         # across query groups, so the narrower [q|k|v] projection passes
         # through unexpanded.
+        if rope:
+            # Rotate the q/k column sections, pass v through: all fused
+            # elementwise on the projection output, re-packed for the
+            # kernel (XLA folds the rotate+concat into the matmul
+            # epilogue feeding the custom call — measured MFU-neutral on
+            # the flagship, BASELINE.md r5).
+            q, k, v = split_qkv()
+            q = apply_rope(q.reshape(b, s, cfg.num_heads, dh), cos, sin)
+            k = apply_rope(k.reshape(b, s, kv, dh), cos, sin)
+            qkv = jnp.concatenate(
+                [q.reshape(b, s, cfg.d_model), k.reshape(b, s, kv * dh), v],
+                axis=-1,
+            )
         attn = attend(qkv)
     elif cache is None and layout == "bshd":
         # Extension point for EXTERNAL attend callables tagged
@@ -165,22 +202,38 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
         # transposes materialize.
         q, k, v = split_qkv()
         qh = q.reshape(b, s, cfg.num_heads, dh)
-        kh = expand_kv(k.reshape(b, s, kv, dh))
+        kh = k.reshape(b, s, kv, dh)
+        if rope:
+            qh = apply_rope(qh, cos, sin)
+            kh = apply_rope(kh, cos, sin)  # pre-expand: kv heads rotate once
+        kh = expand_kv(kh)
         vh = expand_kv(v.reshape(b, s, kv, dh))
         attn = attend(qh, kh, vh).reshape(b, s, cfg.d_model)
     elif cache is None:
         q, k, v = split_qkv()
-        # (B, S, n·dh) -> (B, n, S, dh)
-        to_heads = lambda t, n: t.reshape(b, s, n, dh).transpose(0, 2, 1, 3)
+        qh = q.reshape(b, s, cfg.num_heads, dh)
+        kh = k.reshape(b, s, kv, dh)
+        if rope:
+            qh = apply_rope(qh, cos, sin)
+            kh = apply_rope(kh, cos, sin)
+        # (B, S, n, dh) -> (B, n, S, dh)
         attn = attend(
-            to_heads(q, cfg.num_heads),
-            expand_kv(k.reshape(b, s, kv, dh)).transpose(0, 2, 1, 3),
+            qh.transpose(0, 2, 1, 3),
+            expand_kv(kh).transpose(0, 2, 1, 3),
             expand_kv(v.reshape(b, s, kv, dh)).transpose(0, 2, 1, 3),
         )
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
     else:
         q, k, v = split_qkv()
-        to_heads = lambda t, n: t.reshape(b, s, n, dh).transpose(0, 2, 1, 3)
+        q4 = q.reshape(b, s, cfg.num_heads, dh)
+        k4 = k.reshape(b, s, kv, dh)
+        if rope:
+            # Rotate at ABSOLUTE positions (cache['len'] + arange(s) via
+            # the cos/sin tables above); the cache stores post-rotation
+            # keys, so earlier entries never need re-rotating.
+            q4 = apply_rope(q4, cos, sin)
+            k4 = apply_rope(k4, cos, sin)
+        to_heads = lambda t4: t4.transpose(0, 2, 1, 3)
         # Cached decode (s tokens: 1 for the sampling loop, the whole
         # prompt for prefill): append K/V at offset `len`, causally
         # attend over prefix + self. The cache stores the UNEXPANDED
@@ -190,12 +243,12 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None):
         # f32 accumulation like ops.attention.dense_attention; NEG_INF
         # (not -inf) keeps fully-masked softmax rows NaN-free.
         ks = jax.lax.dynamic_update_slice(
-            cache["k"], to_heads(k, kv), (0, 0, cache["len"], 0)
+            cache["k"], to_heads(k4), (0, 0, cache["len"], 0)
         )
         vs = jax.lax.dynamic_update_slice(
-            cache["v"], to_heads(v, kv), (0, 0, cache["len"], 0)
+            cache["v"], to_heads(v.reshape(b, s, kv, dh)), (0, 0, cache["len"], 0)
         )
-        qh = to_heads(q, cfg.num_heads).reshape(b, kv, group, s, dh)
+        qh = to_heads(q4).reshape(b, kv, group, s, dh)
         scores = jnp.einsum(
             "bkgqd,bkTd->bkgqT", qh, ks, preferred_element_type=jnp.float32
         ) / np.sqrt(dh)
@@ -228,14 +281,18 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, attend, train: bool = False, cache=None):
+    def __call__(self, x, attend, train: bool = False, cache=None,
+                 positions=None):
         """``cache=None`` — training/prefill path. With a cache dict
         ``{'k','v','len'}`` (K/V laid out (B, KV_heads, S_max, dh) —
         num_heads for MHA, num_kv_heads under GQA; ``len`` the filled
         prefix length), runs cached decode and returns
-        ``(x, new_cache)``."""
+        ``(x, new_cache)``. ``positions`` feeds the RoPE rotation only
+        (see :func:`attention_sublayer`)."""
         cfg = self.cfg
-        x, cache = attention_sublayer(cfg, x, attend, train=train, cache=cache)
+        x, cache = attention_sublayer(
+            cfg, x, attend, train=train, cache=cache, positions=positions
+        )
 
         h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(x)
         h = nn.Dense(
@@ -266,41 +323,56 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, positions=None, train: bool = False, cache=None):
         cfg = self.cfg
         b, s = tokens.shape
-        pos_embed = nn.Embed(
-            cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
-        )
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="tok_embed")(
             tokens
         )
-        if positions is None:
-            # Cached decode continues at the filled prefix length; plain
-            # forward starts at 0. The lookup runs on the UNBATCHED (s,)
-            # positions and broadcasts: every batch row embeds the same
-            # positions, and the batched (b, s) gather made the backward a
-            # b·s-update scatter-add (1.75 ms/step on the flagship, XPlane
-            # r4) where an s-update scatter + the broadcast's reduce does
-            # the same job.
-            start = cache["len"] if cache is not None else 0
-            x = x + pos_embed(start + jnp.arange(s, dtype=jnp.int32))[None]
+        if cfg.position == "rope":
+            # No position table at all: positions enter as the q/k rotation
+            # inside every attention sublayer (ops/rope.py). The blocks
+            # receive the caller's global positions (sequence shards) or
+            # default to cache-offset arange inside the sublayer.
+            pass
         else:
-            x = x + pos_embed(positions)
+            pos_embed = nn.Embed(
+                cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype,
+                name="pos_embed",
+            )
+            if positions is None:
+                # Cached decode continues at the filled prefix length; plain
+                # forward starts at 0. The lookup runs on the UNBATCHED (s,)
+                # positions and broadcasts: every batch row embeds the same
+                # positions, and the batched (b, s) gather made the backward
+                # a b·s-update scatter-add (1.75 ms/step on the flagship,
+                # XPlane r4) where an s-update scatter + the broadcast's
+                # reduce does the same job.
+                start = cache["len"] if cache is not None else 0
+                x = x + pos_embed(start + jnp.arange(s, dtype=jnp.int32))[None]
+            else:
+                x = x + pos_embed(positions)
+        rope_positions = positions if cfg.position == "rope" else None
         attend = _attention_fn(cfg, prefer_packed=cache is None)
         if cache is None:
             # static_argnums count self at 0: attend (callable) and train
             # (bool) are compile-time constants. Param tree is unchanged —
-            # remat is a transform, not a module.
+            # remat is a transform, not a module; positions is a traced
+            # (or None) operand, passed by keyword.
             block_cls = (
                 nn.remat(Block, static_argnums=(2, 3)) if cfg.remat else Block
             )
             for i in range(cfg.num_layers):
-                x = block_cls(cfg, name=f"block_{i}")(x, attend, train)
+                x = block_cls(cfg, name=f"block_{i}")(
+                    x, attend, train, positions=rope_positions
+                )
         else:
             # Cache layout: {'layers': [{'k','v'}, ...], 'len': scalar} — one
             # shared filled-length for all layers (they advance in lockstep).
             new_layers = []
             for i in range(cfg.num_layers):
                 layer = dict(cache["layers"][i], len=cache["len"])
-                x, layer = Block(cfg, name=f"block_{i}")(x, attend, train=train, cache=layer)
+                x, layer = Block(cfg, name=f"block_{i}")(
+                    x, attend, train=train, cache=layer,
+                    positions=rope_positions,
+                )
                 new_layers.append({"k": layer["k"], "v": layer["v"]})
             cache = {"layers": new_layers, "len": cache["len"] + s}
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)

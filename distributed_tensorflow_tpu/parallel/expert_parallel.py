@@ -172,10 +172,12 @@ class MoeBlock(nn.Module):
     ep_axis: str = "model"
 
     @nn.compact
-    def __call__(self, x, attend, train: bool = False):
+    def __call__(self, x, attend, train: bool = False, positions=None):
         cfg = self.cfg
         d = cfg.compute_dtype
-        x, _ = attention_sublayer(cfg, x, attend, train=train)
+        x, _ = attention_sublayer(
+            cfg, x, attend, train=train, positions=positions
+        )
         b, s, _unused = x.shape
 
         h = nn.LayerNorm(dtype=d, name="ln2")(x)
@@ -212,9 +214,11 @@ class MoeTransformerLM(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="tok_embed")(
             tokens
         )
-        x = x + nn.Embed(
-            cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
-        )(positions)
+        rope = getattr(cfg, "position", "learned") == "rope"
+        if not rope:
+            x = x + nn.Embed(
+                cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
+            )(positions)
         attend = _attention_fn(cfg, prefer_packed=True)
         aux_total = jnp.zeros((), jnp.float32)
         # cfg.remat: recompute each block on backward. The all_to_all token
@@ -230,7 +234,7 @@ class MoeTransformerLM(nn.Module):
                 capacity_factor=self.capacity_factor,
                 ep_axis=self.ep_axis,
                 name=f"block_{i}",
-            )(x, attend, train)
+            )(x, attend, train, positions=positions if rope else None)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(

@@ -196,9 +196,14 @@ def build_pp_lm_train_step(
         # Replicated embedding of ALL microbatches (only stage 0's ingest
         # path keeps it live — see the where() below).
         x = embed_mod.apply({"params": params["tok_embed"]}, tokens)
-        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
-        x = x + pos_mod.apply({"params": params["pos_embed"]}, positions)
+        rope = getattr(cfg, "position", "learned") == "rope"
+        if not rope:
+            positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+            x = x + pos_mod.apply({"params": params["pos_embed"]}, positions)
         micro = x.reshape(M, bm, t, cfg.d_model)
+        # Under RoPE every microbatch spans the full sequence, so blocks
+        # rotate by the same arange(t) positions — the sublayer's default;
+        # no positions need threading through the schedule.
 
         my_stage = jax.tree_util.tree_map(
             lambda v: jnp.squeeze(v, 0), params["stages"]

@@ -44,6 +44,7 @@ from distributed_tensorflow_tpu.models.transformer import (
     _attention_fn,
     next_token_loss,
 )
+from distributed_tensorflow_tpu.ops.rope import apply_rope, rope_tables
 
 __all__ = [
     "TpTransformerLM",
@@ -101,7 +102,7 @@ class TpBlock(nn.Module):
     tp_axis: str = "model"
 
     @nn.compact
-    def __call__(self, x, attend, train: bool = False):
+    def __call__(self, x, attend, train: bool = False, positions=None):
         cfg = self.cfg
         d = cfg.compute_dtype
         tp = lax.axis_size(self.tp_axis)
@@ -126,8 +127,21 @@ class TpBlock(nn.Module):
         q = nn.Dense(cfg.d_model // tp, dtype=d, name="q", use_bias=bias)(h)
         k = nn.Dense(cfg.d_model // tp, dtype=d, name="k", use_bias=bias)(h)
         v = nn.Dense(cfg.d_model // tp, dtype=d, name="v", use_bias=bias)(h)
-        to_heads = lambda t: t.reshape(b, s, local_heads, dh).transpose(0, 2, 1, 3)
-        attn = attend(to_heads(q), to_heads(k), to_heads(v))
+        q4 = q.reshape(b, s, local_heads, dh)
+        k4 = k.reshape(b, s, local_heads, dh)
+        if getattr(cfg, "position", "learned") == "rope":
+            # RoPE rotates every head by the SAME position angles, so the
+            # local head shard rotates exactly as it would unsharded — tp
+            # parity is preserved without any collective.
+            cos, sin = rope_tables(dh, s, cfg.rope_theta, positions=positions)
+            q4 = apply_rope(q4, cos, sin)
+            k4 = apply_rope(k4, cos, sin)
+        to_heads = lambda t4: t4.transpose(0, 2, 1, 3)
+        attn = attend(
+            to_heads(q4),
+            to_heads(k4),
+            to_heads(v.reshape(b, s, local_heads, dh)),
+        )
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, local_heads * dh)
         # Row-parallel output projection: partial sums -> THE tp collective.
         # (proj/mlp_out biases, when enabled, are added AFTER the psum so
@@ -174,9 +188,11 @@ class TpTransformerLM(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="tok_embed")(
             tokens
         )
-        x = x + nn.Embed(
-            cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
-        )(positions)
+        rope = getattr(cfg, "position", "learned") == "rope"
+        if not rope:
+            x = x + nn.Embed(
+                cfg.max_seq_len, cfg.d_model, dtype=cfg.compute_dtype, name="pos_embed"
+            )(positions)
         # Heads are kernel-independent, so the plain model's attention
         # selection (dense/blockwise/flash/callable) applies unchanged to the
         # local head shard.
@@ -186,7 +202,9 @@ class TpTransformerLM(nn.Module):
         # every shard, so recomputation is SPMD-safe).
         block_cls = nn.remat(TpBlock, static_argnums=(2, 3)) if cfg.remat else TpBlock
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, tp_axis=self.tp_axis, name=f"block_{i}")(x, attend, train)
+            x = block_cls(cfg, tp_axis=self.tp_axis, name=f"block_{i}")(
+                x, attend, train, positions=positions if rope else None
+            )
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(
             cfg.vocab_size, dtype=cfg.compute_dtype, name="lm_head",

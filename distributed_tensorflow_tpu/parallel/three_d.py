@@ -147,9 +147,14 @@ def build_3d_lm_train_step(
         bm = b // M
 
         x = embed_mod.apply({"params": params["tok_embed"]}, tokens)
-        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
-        x = x + pos_mod.apply({"params": params["pos_embed"]}, positions)
+        rope = getattr(cfg, "position", "learned") == "rope"
+        if not rope:
+            positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+            x = x + pos_mod.apply({"params": params["pos_embed"]}, positions)
         micro = x.reshape(M, bm, t, cfg.d_model)
+        # Under RoPE every microbatch spans the full sequence: TpBlock's
+        # positions default (arange(t)) is exactly right, nothing threads
+        # through the schedule.
 
         my_stage = jax.tree_util.tree_map(
             lambda v: jnp.squeeze(v, 0), params["stages"]

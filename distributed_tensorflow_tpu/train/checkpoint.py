@@ -293,6 +293,12 @@ def load_lm_bundle(path: str, fallback_shapes: dict | None = None):
         # 1/absent = biased Dense layers (pre-r5 bundles carry no use_bias
         # key and were always trained with biases on the CLI path).
         use_bias=bool(dim("use_bias", 1)),
+        # 0/absent = learned position table (pre-RoPE bundles). theta is a
+        # FLOAT (dim() would truncate it) — a non-default rotation base must
+        # survive the round trip or inference silently rotates q/k by the
+        # wrong angles.
+        position="rope" if dim("rope", 0) else "learned",
+        rope_theta=float(shape_meta.get("rope_theta", fb.get("rope_theta", 10000.0))),
         num_layers=dim("num_layers", 4),
         d_ff=dim("d_ff", 512),
         max_seq_len=dim("max_seq_len", 128),

@@ -1,0 +1,283 @@
+"""Rotary position embeddings (position='rope') across the whole stack.
+
+The algebraic heart (q(m)·k(n) depends only on m−n) is pinned directly on
+ops/rope.py, then the model-level guarantees: every attention tier agrees,
+cached decode reproduces the full forward at absolute positions (incl. GQA
+and sliding window), the param tree drops the position table, sequence
+parallelism matches the single-device step with rotation at GLOBAL shard
+positions, tensor parallelism keeps its tp-parity, and bundles round-trip
+the flag.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.models.decoding import build_generate_fn, init_cache
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from distributed_tensorflow_tpu.ops.rope import apply_rope, rope_cos_sin
+from distributed_tensorflow_tpu.parallel import data_parallel as dp
+from distributed_tensorflow_tpu.parallel import sequence_parallel as sp
+from distributed_tensorflow_tpu.parallel import tensor_parallel as tp
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=32, d_model=32, num_heads=4, num_layers=2, d_ff=64,
+        max_seq_len=32, compute_dtype=jnp.float32, position="rope",
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _tokens(b, s, seed=0, vocab=32):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, vocab, (b, s)), jnp.int32
+    )
+
+
+# ---------------------------------------------------------------------------
+# ops/rope.py algebra
+# ---------------------------------------------------------------------------
+
+
+def test_rope_preserves_norm_and_is_identity_at_zero():
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.standard_normal((2, 8, 3, 16)), jnp.float32)
+    cos, sin = rope_cos_sin(jnp.arange(8), 16)
+    y = apply_rope(x, cos[None], sin[None])
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(y), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+    cos0, sin0 = rope_cos_sin(jnp.zeros((4,), jnp.int32), 16)
+    y0 = apply_rope(x[:, :4], cos0[None], sin0[None])
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(x[:, :4]), rtol=1e-6)
+
+
+def test_rope_dot_depends_only_on_relative_offset():
+    """The RoFormer property: <R(m)q, R(n)k> is a function of m − n alone."""
+    r = np.random.default_rng(1)
+    q = jnp.asarray(r.standard_normal((1, 1, 1, 32)), jnp.float32)
+    k = jnp.asarray(r.standard_normal((1, 1, 1, 32)), jnp.float32)
+
+    def dot(m, n):
+        cq, sq_ = rope_cos_sin(jnp.asarray([m]), 32)
+        ck, sk = rope_cos_sin(jnp.asarray([n]), 32)
+        qr = apply_rope(q, cq[None], sq_[None])
+        kr = apply_rope(k, ck[None], sk[None])
+        return float(jnp.sum(qr * kr))
+
+    # Same offset, different absolute positions.
+    np.testing.assert_allclose(dot(5, 2), dot(105, 102), rtol=1e-4)
+    np.testing.assert_allclose(dot(17, 17), dot(900, 900), rtol=1e-4)
+    # Different offsets genuinely differ.
+    assert abs(dot(5, 2) - dot(5, 4)) > 1e-4
+
+
+def test_rope_requires_even_head_dim():
+    with pytest.raises(ValueError, match="even"):
+        rope_cos_sin(jnp.arange(4), 7)
+
+
+# ---------------------------------------------------------------------------
+# Model tiers
+# ---------------------------------------------------------------------------
+
+
+def test_rope_tree_has_no_pos_table_and_impls_agree():
+    toks = _tokens(2, 32)
+    p = TransformerLM(_cfg(attention="dense")).init(jax.random.PRNGKey(0), toks)[
+        "params"
+    ]
+    assert "pos_embed" not in p
+    # learned keeps the table (control).
+    p_learned = TransformerLM(_cfg(attention="dense", position="learned")).init(
+        jax.random.PRNGKey(0), toks
+    )["params"]
+    assert "pos_embed" in p_learned
+    outs = {
+        a: TransformerLM(_cfg(attention=a)).apply({"params": p}, toks)
+        for a in ("dense", "blockwise", "flash")
+    }
+    for a in ("blockwise", "flash"):
+        np.testing.assert_allclose(
+            np.asarray(outs[a]), np.asarray(outs["dense"]), rtol=2e-4, atol=2e-4
+        )
+    # RoPE changes the function (not a no-op relative to learned-at-init).
+    out_learned = TransformerLM(_cfg(attention="dense", position="learned")).apply(
+        {"params": p_learned}, toks
+    )
+    assert not np.allclose(np.asarray(outs["dense"]), np.asarray(out_learned))
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [dict(), dict(num_kv_heads=2), dict(attention_window=8),
+     dict(num_kv_heads=2, attention_window=8)],
+    ids=["mha", "gqa", "window", "gqa+window"],
+)
+def test_rope_decode_teacher_forcing_parity(extra):
+    """Cached decode (rotation at ABSOLUTE cache positions, post-rotation
+    keys stored) must reproduce the full forward, composing with GQA and
+    sliding window."""
+    cfg = _cfg(attention="dense", **extra)
+    toks = _tokens(2, 32, seed=2)
+    m = TransformerLM(cfg)
+    p = m.init(jax.random.PRNGKey(0), toks)["params"]
+    full = m.apply({"params": p}, toks)
+    cache = init_cache(cfg, 2, 32)
+    logits, cache = m.apply({"params": p}, toks[:, :5], cache=cache)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, :5]), rtol=2e-4, atol=2e-4
+    )
+    for t in range(5, 12):
+        step_logits, cache = m.apply({"params": p}, toks[:, t : t + 1], cache=cache)
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]), np.asarray(full[:, t]),
+            rtol=2e-4, atol=2e-4,
+        )
+
+
+def test_rope_grads_finite_with_remat_and_generate_runs():
+    cfg = _cfg(attention="flash", remat=True, num_kv_heads=2)
+    toks = _tokens(2, 32, seed=3)
+    m = TransformerLM(cfg)
+    p = m.init(jax.random.PRNGKey(0), toks)["params"]
+    g = jax.grad(lambda pr: jnp.sum(m.apply({"params": pr}, toks, train=True) ** 2))(p)
+    assert all(
+        np.all(np.isfinite(np.asarray(leaf))) for leaf in jax.tree_util.tree_leaves(g)
+    )
+    gen = build_generate_fn(cfg, 4)
+    out = gen(p, toks[:, :4], jax.random.PRNGKey(1))
+    assert out.shape == (2, 8)
+
+
+def test_rope_extrapolates_past_max_seq_len():
+    """No position table → the forward runs at sequence lengths the config
+    never declared (the learned path can't: its table is max_seq_len rows)."""
+    cfg = _cfg(attention="dense")
+    m = TransformerLM(cfg)
+    p = m.init(jax.random.PRNGKey(0), _tokens(1, 8))["params"]
+    out = m.apply({"params": p}, _tokens(1, 2 * cfg.max_seq_len, seed=4))
+    assert out.shape == (1, 64, 32)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+# ---------------------------------------------------------------------------
+# Parallelism
+# ---------------------------------------------------------------------------
+
+
+def test_rope_sp_step_matches_single_device_step():
+    """Ring/sequence parallelism: each shard rotates q/k at its GLOBAL
+    positions, so the sharded step must reproduce the unsharded one."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    cfg = _cfg(attention="blockwise")
+    mesh = make_mesh(num_devices=8, model_parallel=4)  # data=2, seq=4
+    tx = optax.sgd(0.1)
+    params = TransformerLM(cfg).init(jax.random.PRNGKey(0), _tokens(1, 32))["params"]
+    opt_state = tx.init(params)
+    b, s = 4, 32
+    tokens = _tokens(b, s, seed=3)
+
+    step_fn = sp.build_lm_train_step(cfg, tx, mesh, donate=False)
+    p2, _, _, metrics = step_fn(
+        dp.replicate(params, mesh),
+        dp.replicate(opt_state, mesh),
+        dp.replicate(jnp.zeros((), jnp.int32), mesh),
+        sp.shard_lm_batch(tokens, mesh),
+        jax.random.PRNGKey(7),
+    )
+
+    def ref_loss(p):
+        logits = TransformerLM(cfg).apply({"params": p}, tokens)
+        w = jnp.ones((b, s)).at[:, -1].set(0.0)
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        targets = jnp.concatenate([tokens[:, 1:], tokens[:, :1]], axis=1)
+        nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+        return (nll * w).sum() / w.sum()
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    updates, _ = tx.update(grads_ref, opt_state, params)
+    p_ref = jax.tree_util.tree_map(lambda a, u: a + u, params, updates)
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref), rtol=1e-5)
+    for a, b_ in zip(
+        jax.tree_util.tree_leaves(jax.device_get(p2)),
+        jax.tree_util.tree_leaves(p_ref),
+    ):
+        np.testing.assert_allclose(a, np.asarray(b_), rtol=5e-4, atol=5e-4)
+
+
+def test_rope_tp2_matches_tp1():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    cfg = _cfg(vocab_size=64)
+    host = tp.init_tp_params(cfg, seed=0)
+    assert "pos_embed" not in host
+
+    def run(mesh):
+        tx = optax.sgd(0.1)
+        step = tp.build_tp_lm_train_step(cfg, tx, mesh, host, donate=False)
+        params = tp.shard_params(host, mesh)
+        opt = tp.shard_params(jax.device_get(tx.init(host)), mesh)
+        g = jax.device_put(
+            jnp.zeros((), jnp.int32),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+        )
+        losses = []
+        for i in range(3):
+            tokens = _tokens(8, 16, seed=1 + i, vocab=64)
+            params, opt, g, m = step(params, opt, g, tokens, jax.random.PRNGKey(0))
+            losses.append(float(jax.device_get(m["loss"])))
+        return jax.device_get(params), losses
+
+    p1, l1 = run(make_mesh())
+    p2, l2 = run(make_mesh(model_parallel=2))
+    np.testing.assert_allclose(l1, l2, rtol=2e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5), p1, p2
+    )
+
+
+def test_rope_bundle_roundtrip(tmp_path):
+    from distributed_tensorflow_tpu.train.checkpoint import (
+        export_inference_bundle,
+        load_lm_bundle,
+    )
+
+    cfg = _cfg(attention="dense")
+    p = jax.device_get(
+        TransformerLM(cfg).init(jax.random.PRNGKey(0), _tokens(1, 8))["params"]
+    )
+    path = str(tmp_path / "lm.msgpack")
+    export_inference_bundle(
+        path,
+        p,
+        metadata={
+            "model": "TransformerLM",
+            "parallelism": "dp",
+            "config": {
+                "vocab_size": cfg.vocab_size,
+                "d_model": cfg.d_model,
+                "num_heads": cfg.num_heads,
+                "rope": 1,
+                "rope_theta": 500000.0,
+                "num_layers": cfg.num_layers,
+                "d_ff": cfg.d_ff,
+                "max_seq_len": cfg.max_seq_len,
+            },
+        },
+    )
+    cfg2, params2, _ = load_lm_bundle(path)
+    assert cfg2.position == "rope"
+    assert cfg2.rope_theta == 500000.0  # non-default base survives (float)
+    assert "pos_embed" not in params2

@@ -89,6 +89,18 @@ def main(argv=None):
              "kernels skip out-of-window blocks, O(S*window) cost)",
     )
     parser.add_argument(
+        "--position", default="learned", choices=("learned", "rope"),
+        help="position encoding: learned additive table (historical "
+             "default) or rotary embeddings (RoPE — no position table, "
+             "relative offsets in the q/k dot product, sequence-length "
+             "extrapolation)",
+    )
+    parser.add_argument(
+        "--rope_theta", type=float, default=10000.0,
+        help="RoPE rotation base (only with --position rope; larger bases "
+             "slow the angular frequencies for longer contexts)",
+    )
+    parser.add_argument(
         "--use_bias", type=int, default=1, choices=(0, 1),
         help="Dense-layer biases (1 = biased, the historical default; 0 = "
              "bias-free, the modern-LM convention the bench flagship uses — "
@@ -206,6 +218,8 @@ def main(argv=None):
         num_kv_heads=args.num_kv_heads or None,
         attention_window=args.attention_window or None,
         use_bias=bool(args.use_bias),
+        position=args.position,
+        rope_theta=args.rope_theta,
         num_layers=args.num_layers,
         d_ff=args.d_ff,
         max_seq_len=args.seq_len,
@@ -540,6 +554,9 @@ def main(argv=None):
                     "num_kv_heads": cfg.num_kv_heads or 0,
                     "attention_window": cfg.attention_window or 0,
                     "use_bias": int(cfg.use_bias),
+                    # 0 = learned (pre-r5 bundles), 1 = rope.
+                    "rope": int(cfg.position == "rope"),
+                    "rope_theta": float(cfg.rope_theta),
                     "num_layers": cfg.num_layers,
                     "d_ff": cfg.d_ff,
                     "max_seq_len": cfg.max_seq_len,
