@@ -18,24 +18,71 @@ import jax.numpy as jnp
 
 from distributed_tensorflow_tpu.models.transformer import TransformerConfig, TransformerLM
 
-__all__ = ["init_cache", "build_generate_fn"]
+__all__ = ["init_cache", "build_generate_fn", "sample_logits"]
+
+_NEG_INF = -1e30  # matches ops.attention.NEG_INF: masked, not NaN-prone
+
+
+def sample_logits(logits, key, temperature: float = 0.0,
+                  top_k: int | None = None, top_p: float | None = None):
+    """One sampling step: ``(B, V) logits → (B,) int32 tokens``.
+
+    ``temperature <= 0`` is greedy argmax (the filters are irrelevant — the
+    max always survives both). Otherwise the logits are tempered, then
+    ``top_k`` keeps the k highest, then ``top_p`` (nucleus) keeps the
+    smallest descending-probability prefix whose cumulative mass reaches
+    top_p (the argmax always survives, so the distribution is never empty).
+    Static shapes throughout (top_k/sort — no data-dependent control flow),
+    so the whole thing jits into the decode scan."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+    logits = (logits / temperature).astype(jnp.float32)
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep tokens whose EXCLUSIVE prefix mass is < top_p: the first
+        # token always qualifies, and the kept set is the smallest prefix
+        # with cumulative mass >= top_p.
+        n_keep = jnp.sum((cum - probs) < top_p, axis=-1, keepdims=True)
+        thresh = jnp.take_along_axis(desc, n_keep - 1, axis=-1)
+        logits = jnp.where(logits < thresh, _NEG_INF, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
     """Static-shape per-layer KV buffers + one shared filled-prefix length.
     Under GQA the buffers hold the UNEXPANDED ``kv_heads`` — the cache (and
     its per-step HBM read, the decode bound past small batches) shrinks by
-    the query-group factor."""
+    the query-group factor. With ``cfg.kv_cache_dtype == 'int8'`` the
+    buffers are int8 with per-row f32 scales (another ~2x off the cache
+    read at the KV bound, composing with GQA)."""
     dh = cfg.d_model // cfg.num_heads
     kv = cfg.kv_heads
+    quant = getattr(cfg, "kv_cache_dtype", None)
+    if quant not in (None, "int8"):
+        raise ValueError(f"kv_cache_dtype must be None or 'int8', got {quant!r}")
+    dtype = jnp.int8 if quant == "int8" else cfg.compute_dtype
+
+    def layer():
+        buf = {
+            "k": jnp.zeros((batch, kv, max_len, dh), dtype),
+            "v": jnp.zeros((batch, kv, max_len, dh), dtype),
+        }
+        if quant == "int8":
+            buf["k_scale"] = jnp.zeros((batch, kv, max_len), jnp.float32)
+            buf["v_scale"] = jnp.zeros((batch, kv, max_len), jnp.float32)
+        return buf
+
     return {
-        "layers": [
-            {
-                "k": jnp.zeros((batch, kv, max_len, dh), cfg.compute_dtype),
-                "v": jnp.zeros((batch, kv, max_len, dh), cfg.compute_dtype),
-            }
-            for _ in range(cfg.num_layers)
-        ],
+        "layers": [layer() for _ in range(cfg.num_layers)],
         "len": jnp.zeros((), jnp.int32),
     }
 
@@ -46,9 +93,13 @@ def build_generate_fn(
     temperature: float = 0.0,
     cache_len: int | None = None,
     cast_params: bool = True,
+    top_k: int | None = None,
+    top_p: float | None = None,
 ):
     """Returns jitted ``generate(params, prompt (B, P) int32, rng) ->
-    tokens (B, P + max_new_tokens)``. ``temperature == 0`` is greedy.
+    tokens (B, P + max_new_tokens)``. ``temperature == 0`` is greedy;
+    otherwise :func:`sample_logits` applies ``top_k`` then ``top_p``
+    filtering before the categorical draw.
     P must be ≥ 1 (conditional generation; the model has no BOS token).
     ``cache_len`` overrides the KV-cache length (default: exactly
     ``P + max_new_tokens``) — benchmarks comparing different generation
@@ -96,9 +147,9 @@ def build_generate_fn(
         last_logits = logits[:, -1]
 
         def sample(logits, key):
-            if temperature <= 0.0:
-                return jnp.argmax(logits, -1).astype(jnp.int32)
-            return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
+            return sample_logits(
+                logits, key, temperature=temperature, top_k=top_k, top_p=top_p
+            )
 
         def dec(carry, key):
             cache, logits = carry

@@ -72,6 +72,16 @@ class TransformerConfig:
     # GQA + sliding-window + RoPE modern-attention trio.
     position: str = "learned"  # 'learned' | 'rope'
     rope_theta: float = 10000.0
+    # KV-cache storage dtype for decode (None = compute_dtype): 'int8'
+    # stores per-row-quantized keys/values (symmetric absmax over head_dim,
+    # f32 scales laid out (B, KV, S) — S minor, so no lane-padding tax).
+    # Decode is KV-cache-bandwidth bound past small batches (BASELINE.md
+    # decode roofline: tokens/s scales with cache bytes read), so halving
+    # cache bytes vs bf16 is a direct throughput lever that COMPOSES with
+    # GQA's group factor. Dequantization happens in-register (the scale
+    # factors out of the dot product over head_dim — applied to the score/
+    # weight matrices, never re-materializing a dequantized cache).
+    kv_cache_dtype: str | None = None  # None | 'int8'
     # Rematerialise each block on the backward pass (jax.checkpoint): saves
     # only block boundaries instead of every intermediate — activation memory
     # drops from O(L·S·(d_ff+4·d_model)) to O(L·S·d_model) + one block's
@@ -79,9 +89,36 @@ class TransformerConfig:
     # trade on TPU, where HBM (not MXU) is the bottleneck.
     remat: bool = False
 
+    def __post_init__(self):
+        # Every string-enum field that SELECTS behavior is validated here:
+        # a typo ('Rope', 'rotary') must not silently pick the other path.
+        # (attention also accepts callables; kv_cache_dtype None = compute
+        # dtype.)
+        if self.position not in ("learned", "rope"):
+            raise ValueError(
+                f"position must be 'learned' or 'rope', got {self.position!r}"
+            )
+        if self.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be None or 'int8', got {self.kv_cache_dtype!r}"
+            )
+
     @property
     def kv_heads(self) -> int:
         return self.num_heads if self.num_kv_heads is None else self.num_kv_heads
+
+
+def quantize_kv_rows(x):
+    """Symmetric absmax int8 quantization over the last (head_dim) axis:
+    ``x`` (..., dh) → (int8 values (..., dh), f32 scales (...)). The scale
+    is per ROW (one per cached key/value vector), so it factors out of any
+    dot product over dh exactly — consumers apply it to the score/weight
+    matrices instead of dequantizing the cache."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    return q, scale
 
 
 def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callable:
@@ -242,16 +279,40 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
         # decode roofline) and the cache shrinks by the group factor.
         # f32 accumulation like ops.attention.dense_attention; NEG_INF
         # (not -inf) keeps fully-masked softmax rows NaN-free.
+        quant = getattr(cfg, "kv_cache_dtype", None)
+        if quant not in (None, "int8"):
+            raise ValueError(f"kv_cache_dtype must be None or 'int8', got {quant!r}")
+        kh, vh = to_heads(k4), to_heads(v.reshape(b, s, kv, dh))
+        if quant == "int8":
+            # Per-row symmetric quantization on WRITE; the scales ride the
+            # cache as (B, KV, S) f32 (S minor — no lane-padding tax). The
+            # dot products below consume the int8 cache directly and apply
+            # the scales to the score/weight matrices — the row scale
+            # factors out of the dh contraction exactly, so no dequantized
+            # (B, KV, S, dh) tensor ever re-materializes in HBM.
+            kh, k_sc = quantize_kv_rows(kh)
+            vh, v_sc = quantize_kv_rows(vh)
+            k_scale = jax.lax.dynamic_update_slice(
+                cache["k_scale"], k_sc, (0, 0, cache["len"])
+            )
+            v_scale = jax.lax.dynamic_update_slice(
+                cache["v_scale"], v_sc, (0, 0, cache["len"])
+            )
         ks = jax.lax.dynamic_update_slice(
-            cache["k"], to_heads(k4), (0, 0, cache["len"], 0)
+            cache["k"], kh, (0, 0, cache["len"], 0)
         )
         vs = jax.lax.dynamic_update_slice(
-            cache["v"], to_heads(v.reshape(b, s, kv, dh)), (0, 0, cache["len"], 0)
+            cache["v"], vh, (0, 0, cache["len"], 0)
         )
         qh = to_heads(q4).reshape(b, kv, group, s, dh)
         scores = jnp.einsum(
-            "bkgqd,bkTd->bkgqT", qh, ks, preferred_element_type=jnp.float32
+            "bkgqd,bkTd->bkgqT",
+            qh,
+            ks.astype(cfg.compute_dtype) if quant == "int8" else ks,
+            preferred_element_type=jnp.float32,
         ) / np.sqrt(dh)
+        if quant == "int8":
+            scores = scores * k_scale[:, :, None, None, :]
         q_pos = cache["len"] + jnp.arange(s)  # (s,)
         key_pos = jnp.arange(ks.shape[2])  # (S_max,)
         allowed = key_pos[None, :] <= q_pos[:, None]  # (s, S_max)
@@ -259,6 +320,8 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
             allowed &= key_pos[None, :] > q_pos[:, None] - cfg.attention_window
         scores = jnp.where(allowed[None, None, None, :, :], scores, A.NEG_INF)
         weights = jax.nn.softmax(scores, -1)
+        if quant == "int8":
+            weights = weights * v_scale[:, :, None, None, :]
         attn = jnp.einsum(
             "bkgqT,bkTd->bkgqd", weights, vs.astype(jnp.float32)
         ).astype(cfg.compute_dtype)
@@ -268,6 +331,9 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
             .reshape(b, s, cfg.d_model)
         )
         cache = {"k": ks, "v": vs, "len": cache["len"] + s}
+        if quant == "int8":
+            cache["k_scale"] = k_scale
+            cache["v_scale"] = v_scale
     attn = nn.Dense(
         cfg.d_model, dtype=cfg.compute_dtype, name="proj",
         use_bias=cfg.use_bias,
@@ -373,7 +439,9 @@ class TransformerLM(nn.Module):
                     x, attend, train=train, cache=layer,
                     positions=rope_positions,
                 )
-                new_layers.append({"k": layer["k"], "v": layer["v"]})
+                # Preserve every per-layer buffer (k/v plus the int8
+                # cache's k_scale/v_scale); 'len' is shared, not per-layer.
+                new_layers.append({k_: v_ for k_, v_ in layer.items() if k_ != "len"})
             cache = {"layers": new_layers, "len": cache["len"] + s}
         x = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(

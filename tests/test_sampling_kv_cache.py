@@ -1,0 +1,197 @@
+"""Decode samplers (top-k / top-p) and the int8-quantized KV cache.
+
+Samplers: distribution-truncation properties on fixed logits (all draws stay
+inside the kept set), greedy equivalences at the degenerate settings.
+int8 cache: cached decode must track the full-precision forward within
+quantization tolerance across MHA/GQA/window/rope, the cache buffers must
+really be int8 with (B, KV, S) f32 scales, and generation end-to-end runs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.decoding import (
+    build_generate_fn,
+    init_cache,
+    sample_logits,
+)
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    quantize_kv_rows,
+)
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=32, d_model=32, num_heads=4, num_layers=2, d_ff=64,
+        max_seq_len=32, compute_dtype=jnp.float32, attention="dense",
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _tokens(b, s, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, 32, (b, s)), jnp.int32
+    )
+
+
+# ---------------------------------------------------------------------------
+# Samplers
+# ---------------------------------------------------------------------------
+
+_LOGITS = jnp.asarray(
+    np.random.default_rng(0).standard_normal((3, 64)) * 2.0, jnp.float32
+)
+
+
+def test_temperature_zero_is_greedy():
+    out = sample_logits(_LOGITS, jax.random.PRNGKey(0), temperature=0.0,
+                        top_k=5, top_p=0.5)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.argmax(np.asarray(_LOGITS), -1)
+    )
+
+
+def test_top_k_one_is_greedy_at_any_temperature():
+    for seed in range(5):
+        out = sample_logits(
+            _LOGITS, jax.random.PRNGKey(seed), temperature=3.0, top_k=1
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), np.argmax(np.asarray(_LOGITS), -1)
+        )
+
+
+def test_top_k_draws_stay_inside_the_k_set():
+    k = 5
+    allowed = np.argsort(np.asarray(_LOGITS), -1)[:, -k:]
+    draw = jax.jit(
+        lambda key: sample_logits(_LOGITS, key, temperature=2.0, top_k=k)
+    )
+    for seed in range(200):
+        out = np.asarray(draw(jax.random.PRNGKey(seed)))
+        for row in range(out.shape[0]):
+            assert out[row] in allowed[row], (seed, row, out[row])
+
+
+def test_top_p_draws_stay_inside_the_nucleus():
+    p = 0.6
+    probs = np.asarray(jax.nn.softmax(_LOGITS / 2.0, -1))
+    order = np.argsort(-probs, -1)
+    allowed = []
+    for row in range(probs.shape[0]):
+        cum = np.cumsum(probs[row][order[row]])
+        n_keep = int(np.sum((cum - probs[row][order[row]]) < p))
+        allowed.append(set(order[row][:n_keep].tolist()))
+    draw = jax.jit(
+        lambda key: sample_logits(_LOGITS, key, temperature=2.0, top_p=p)
+    )
+    for seed in range(200):
+        out = np.asarray(draw(jax.random.PRNGKey(seed)))
+        for row in range(out.shape[0]):
+            assert out[row] in allowed[row], (seed, row, out[row])
+
+
+def test_tiny_top_p_keeps_only_the_argmax():
+    for seed in range(5):
+        out = sample_logits(
+            _LOGITS, jax.random.PRNGKey(seed), temperature=2.0, top_p=1e-6
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), np.argmax(np.asarray(_LOGITS), -1)
+        )
+
+
+def test_sampler_validation():
+    with pytest.raises(ValueError, match="top_k"):
+        sample_logits(_LOGITS, jax.random.PRNGKey(0), temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        sample_logits(_LOGITS, jax.random.PRNGKey(0), temperature=1.0, top_p=1.5)
+
+
+def test_generate_fn_accepts_samplers():
+    cfg = _cfg()
+    p = TransformerLM(cfg).init(jax.random.PRNGKey(0), _tokens(1, 8))["params"]
+    gen = build_generate_fn(cfg, 6, temperature=1.0, top_k=8, top_p=0.9)
+    out = gen(p, _tokens(2, 4, seed=1), jax.random.PRNGKey(2))
+    assert out.shape == (2, 10)
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < cfg.vocab_size))
+
+
+# ---------------------------------------------------------------------------
+# int8 KV cache
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_kv_rows_roundtrip_error_bound():
+    r = np.random.default_rng(1)
+    x = jnp.asarray(r.standard_normal((2, 3, 16, 24)) * 5.0, jnp.float32)
+    q, scale = quantize_kv_rows(x)
+    assert q.dtype == jnp.int8
+    assert scale.shape == (2, 3, 16)
+    deq = np.asarray(q, np.float32) * np.asarray(scale)[..., None]
+    # Symmetric absmax: error per element <= scale/2 = absmax/254.
+    err = np.abs(deq - np.asarray(x))
+    bound = np.asarray(scale)[..., None] * 0.5 + 1e-6
+    assert np.all(err <= bound)
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [dict(), dict(num_kv_heads=2), dict(attention_window=8),
+     dict(num_kv_heads=2, attention_window=8, position="rope")],
+    ids=["mha", "gqa", "window", "gqa+window+rope"],
+)
+def test_int8_cache_tracks_full_forward(extra):
+    """Teacher-forcing through the int8 cache stays within quantization
+    tolerance of the exact full forward — the quality guard for the 2x
+    cache-read saving."""
+    cfg = _cfg(kv_cache_dtype="int8", **extra)
+    cfg_exact = _cfg(**extra)
+    toks = _tokens(2, 32, seed=2)
+    m = TransformerLM(cfg_exact)
+    p = m.init(jax.random.PRNGKey(0), toks)["params"]
+    full = m.apply({"params": p}, toks)
+
+    mq = TransformerLM(cfg)
+    cache = init_cache(cfg, 2, 32)
+    assert cache["layers"][0]["k"].dtype == jnp.int8
+    assert cache["layers"][0]["k_scale"].shape == (2, cfg.kv_heads, 32)
+    logits, cache = mq.apply({"params": p}, toks[:, :5], cache=cache)
+    scale = float(np.abs(np.asarray(full)).max())
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, :5]),
+        atol=0.05 * scale, rtol=0.05,
+    )
+    for t in range(5, 12):
+        step_logits, cache = mq.apply({"params": p}, toks[:, t : t + 1], cache=cache)
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]), np.asarray(full[:, t]),
+            atol=0.05 * scale, rtol=0.05,
+        )
+    assert cache["layers"][0]["k"].dtype == jnp.int8  # survived the updates
+
+
+def test_int8_generate_end_to_end():
+    cfg = _cfg(kv_cache_dtype="int8", num_kv_heads=2)
+    p = TransformerLM(_cfg(num_kv_heads=2)).init(
+        jax.random.PRNGKey(0), _tokens(1, 8)
+    )["params"]
+    gen = build_generate_fn(cfg, 6, temperature=1.0, top_k=8)
+    out = gen(p, _tokens(2, 4, seed=3), jax.random.PRNGKey(1))
+    assert out.shape == (2, 10)
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < cfg.vocab_size))
+
+
+def test_bad_kv_cache_dtype_rejected():
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        init_cache(_cfg(kv_cache_dtype="int4"), 1, 8)
+
+
+def test_bad_position_rejected_at_config():
+    with pytest.raises(ValueError, match="position"):
+        _cfg(position="rotary")

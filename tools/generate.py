@@ -33,6 +33,21 @@ def main(argv=None):
     )
     parser.add_argument("--max_new_tokens", type=int, default=16)
     parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument(
+        "--top_k", type=int, default=0,
+        help="sample from the k highest-probability tokens only "
+             "(0 = no filter; needs --temperature > 0)",
+    )
+    parser.add_argument(
+        "--top_p", type=float, default=0.0,
+        help="nucleus sampling: smallest token set with cumulative "
+             "probability >= p (0 = no filter; needs --temperature > 0)",
+    )
+    parser.add_argument(
+        "--kv_cache_dtype", default="", choices=("", "int8"),
+        help="KV-cache storage dtype ('' = compute dtype; int8 halves the "
+             "per-step cache read at the decode bandwidth bound)",
+    )
     parser.add_argument("--seq_len", type=int, default=128)
     parser.add_argument("--vocab_size", type=int, default=256)
     parser.add_argument("--d_model", type=int, default=128)
@@ -94,7 +109,17 @@ def main(argv=None):
             2, cfg.vocab_size, (1, 8), dtype=np.int32
         )
 
-    gen = build_generate_fn(cfg, args.max_new_tokens, temperature=args.temperature)
+    if args.kv_cache_dtype:
+        from dataclasses import replace
+
+        cfg = replace(cfg, kv_cache_dtype=args.kv_cache_dtype)
+    gen = build_generate_fn(
+        cfg,
+        args.max_new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k or None,
+        top_p=args.top_p or None,
+    )
     out = np.asarray(gen(params, jnp.asarray(prompt), jax.random.PRNGKey(args.seed)))
     if args.text:
         from distributed_tensorflow_tpu.data.text import decode_tokens
