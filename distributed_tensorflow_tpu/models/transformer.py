@@ -54,9 +54,9 @@ class TransformerConfig:
     # the KV cache shrinks by the group factor (decode is KV-bandwidth
     # bound past small batches — BASELINE.md decode roofline) and the kv
     # projection matmuls shrink with it. num_heads must be divisible by
-    # num_kv_heads. Supported by the plain/MoE/pipeline model paths and
-    # cached decode; TpBlock (head-sharded tensor parallelism) requires
-    # MHA and says so.
+    # num_kv_heads. Supported everywhere: plain/MoE/pipeline model paths,
+    # cached decode, and TpBlock (kv heads shard WITH their query groups —
+    # needs num_kv_heads % tp == 0).
     num_kv_heads: int | None = None
     # Sliding-window attention (None = full causal): each token attends the
     # previous ``attention_window`` positions only (self included — the

@@ -45,31 +45,75 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     scale: float | None = None,
+    window: int | None = None,
 ):
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Must run inside ``shard_map``/``pmap``. ``q``/``k``/``v`` are the local
     shards, shape (B, H, S_local, D); shard i holds global positions
     [i·S_local, (i+1)·S_local). Returns the local (B, H, S_local, D) output.
+
+    ``window`` (requires ``causal``): sliding-window attention with the same
+    Mistral semantics as the single-device tiers — and the ring TRUNCATES:
+    query positions in shard i only see keys back to shard
+    ``i − ceil((window−1)/S_local)``, so the scan runs
+    ``min(P, ceil((window−1)/S_local) + 1)`` hops instead of P, the kv
+    stream rotated toward DESCENDING source shards. Ring communication and
+    compute drop from O(S) to O(window) per device — the property that
+    makes window+SP the long-context configuration rather than two features
+    that cancel. Hops that would wrap past shard 0 carry nothing causal and
+    skip their block update under ``lax.cond`` (the ppermute itself stays
+    unconditional — collectives must run on every shard).
     """
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     s = _scale(q, scale)
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     q_pos = my_idx * s_local + lax.broadcasted_iota(jnp.int32, (s_local, 1), 0)
-    # Shift kv one hop "left" each step: after t hops we hold the shard that
-    # originated on device (my_idx + t) mod P.
-    perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+
+    if window is None:
+        # Shift kv one hop "left" each step: after t hops we hold the shard
+        # that originated on device (my_idx + t) mod P.
+        perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+        n_hops = axis_size
+        src_of = lambda t: lax.rem(my_idx + t, axis_size)
+    else:
+        # Windowed: rotate the OTHER way so hop t delivers shard
+        # my_idx − t — the window only ever looks backward, and the first
+        # out-of-window shard ends the (statically truncated) scan.
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        back = 0 if window == 1 else -(-(window - 1) // s_local)
+        n_hops = min(axis_size, back + 1)
+        src_of = lambda t: lax.rem(my_idx - t + axis_size, axis_size)
 
     def step(carry, t):
         acc, m, l, k_blk, v_blk = carry
-        src = lax.rem(my_idx + t, axis_size)
+        src = src_of(t)
         k_pos = src * s_local + lax.broadcasted_iota(jnp.int32, (1, s_local), 1)
-        mask = jnp.ones((s_local, s_local), jnp.bool_) if not causal else (k_pos <= q_pos)
-        acc, m, l = _online_block_update((acc, m, l), q, k_blk, v_blk, mask, s)
-        # Unconditional permute (the last hop returns shards home): collectives
-        # under lax.cond don't lower cleanly in SPMD, and one extra hop is
-        # cheaper than a branch.
+        if not causal:
+            mask = jnp.ones((s_local, s_local), jnp.bool_)
+        else:
+            mask = k_pos <= q_pos
+            if window is not None:
+                mask &= k_pos > q_pos - window
+        if window is None:
+            acc, m, l = _online_block_update((acc, m, l), q, k_blk, v_blk, mask, s)
+        else:
+            # Shards before shard 0 don't exist: a hop that wrapped past the
+            # sequence start (t > my_idx) is entirely masked — skip the two
+            # dots, keep the ppermute below unconditional.
+            acc, m, l = lax.cond(
+                t <= my_idx,
+                lambda c: _online_block_update(c, q, k_blk, v_blk, mask, s),
+                lambda c: c,
+                (acc, m, l),
+            )
+        # Unconditional permute (full ring: the last hop returns shards
+        # home; windowed: the final rotation is discarded with the carry):
+        # collectives under lax.cond don't lower cleanly in SPMD, and one
+        # extra hop is cheaper than a branch.
         k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm)
         return (acc, m, l, k_blk, v_blk), None
 
@@ -80,5 +124,5 @@ def ring_attention(
         k,
         v,
     )
-    (acc, _, l, _, _), _ = lax.scan(step, init, jnp.arange(axis_size))
+    (acc, _, l, _, _), _ = lax.scan(step, init, jnp.arange(n_hops))
     return _finalize(acc, l, q.dtype)

@@ -32,14 +32,16 @@ Batch = dict[str, jnp.ndarray]
 def make_sp_model(cfg: TransformerConfig, seq_axis: str = "model") -> TransformerLM:
     """The sequence-parallel variant of a TransformerLM config: same params,
     attention replaced by a causal ring over ``seq_axis``. Param trees are
-    interchangeable with the single-device model (attention has no state)."""
-    if getattr(cfg, "attention_window", None) is not None:
-        raise ValueError(
-            "attention_window is not supported by the ring-attention "
-            "sequence-parallel path (the ring streams full kv shards); "
-            "unset it here or train windowed models single-chip/data-parallel"
-        )
-    ring = lambda q, k, v: ring_attention(q, k, v, axis_name=seq_axis, causal=True)
+    interchangeable with the single-device model (attention has no state).
+
+    ``cfg.attention_window`` composes: the ring truncates to the hops the
+    window can reach (O(window) communication+compute per device instead of
+    O(S) — ``ring_attention``'s windowed path), which is exactly the
+    combination a long-context multi-chip run wants."""
+    w = getattr(cfg, "attention_window", None)
+    ring = lambda q, k, v: ring_attention(
+        q, k, v, axis_name=seq_axis, causal=True, window=w
+    )
     return TransformerLM(
         TransformerConfig(**{**cfg.__dict__, "attention": ring})
     )

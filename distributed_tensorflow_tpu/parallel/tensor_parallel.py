@@ -11,10 +11,14 @@ ICI — same shard_map-with-visible-collectives philosophy as
 
 Sharding rules (the Megatron recipe):
 
-    q/k/v kernels   (D, D)   column-parallel  P(None, 'model')  → local heads
-    attn out proj   (D, D)   row-parallel     P('model', None)  → psum
-    mlp_in kernel   (D, F)   column-parallel  P(None, 'model')
-    mlp_out kernel  (F, D)   row-parallel     P('model', None)  → psum
+    q kernel        (D, D)      column-parallel  P(None, 'model') → local heads
+    k/v kernels     (D, KV·dh)  column-parallel  P(None, 'model') → local kv
+                    (GQA: kv heads shard WITH their query groups — whole
+                    groups stay shard-local, so attention itself needs no
+                    communication; requires num_kv_heads % tp == 0)
+    attn out proj   (D, D)      row-parallel     P('model', None) → psum
+    mlp_in kernel   (D, F)      column-parallel  P(None, 'model')
+    mlp_out kernel  (F, D)      row-parallel     P('model', None) → psum
     embeddings, layer norms, lm head, row-parallel biases: replicated
 
 Gradients: the model axis needs no gradient collective at all — the backward
@@ -108,14 +112,31 @@ class TpBlock(nn.Module):
         tp = lax.axis_size(self.tp_axis)
         if cfg.num_heads % tp:
             raise ValueError(f"num_heads {cfg.num_heads} not divisible by tp={tp}")
-        if cfg.kv_heads != cfg.num_heads:
+        kv_total = cfg.kv_heads
+        if not (1 <= kv_total <= cfg.num_heads) or cfg.num_heads % kv_total:
+            # Same malformed-GQA guard as attention_sublayer — TpBlock
+            # bypasses it, and group = H // KV below would silently
+            # mis-shape (group 0 or truncated) instead of erroring.
             raise ValueError(
-                "TpBlock shards query heads across the model axis and keeps "
-                "separate per-shard q/k/v kernels — GQA (num_kv_heads < "
-                "num_heads) is not supported under tensor parallelism; use "
-                "num_kv_heads=None here"
+                f"num_kv_heads must be in [1, num_heads] and divide it: "
+                f"num_heads {cfg.num_heads} not divisible by num_kv_heads "
+                f"{kv_total}"
+            )
+        if kv_total % tp:
+            # GQA shards kv heads WITH their query groups: shard i owns q
+            # heads [i·H/tp, (i+1)·H/tp) and kv heads [i·KV/tp, (i+1)·KV/tp)
+            # — h // group lands in exactly that range, so every group is
+            # shard-local and attention needs no kv communication. That
+            # only tiles when tp divides num_kv_heads.
+            raise ValueError(
+                f"num_kv_heads {kv_total} not divisible by tp={tp}: tensor "
+                "parallelism keeps whole query groups per shard, so the kv "
+                "heads must tile over the model axis (pick num_kv_heads a "
+                "multiple of tp, or shrink tp)"
             )
         local_heads = cfg.num_heads // tp
+        local_kv = kv_total // tp
+        group = cfg.num_heads // kv_total
         dh = cfg.d_model // cfg.num_heads
 
         h = _copy_to_tp(nn.LayerNorm(dtype=d, name="ln1")(x), self.tp_axis)
@@ -123,12 +144,14 @@ class TpBlock(nn.Module):
         # Column-parallel projections: local kernels (D, D/tp) produce this
         # shard's heads directly — no communication in the forward here.
         # (features are the LOCAL width: flax validates stored-param shapes.)
+        # Under GQA the k/v kernels are (D, KV·dh/tp) — the same narrower
+        # projection the plain model's fused qkv Dense gets.
         bias = cfg.use_bias
         q = nn.Dense(cfg.d_model // tp, dtype=d, name="q", use_bias=bias)(h)
-        k = nn.Dense(cfg.d_model // tp, dtype=d, name="k", use_bias=bias)(h)
-        v = nn.Dense(cfg.d_model // tp, dtype=d, name="v", use_bias=bias)(h)
+        k = nn.Dense(local_kv * dh, dtype=d, name="k", use_bias=bias)(h)
+        v = nn.Dense(local_kv * dh, dtype=d, name="v", use_bias=bias)(h)
         q4 = q.reshape(b, s, local_heads, dh)
-        k4 = k.reshape(b, s, local_heads, dh)
+        k4 = k.reshape(b, s, local_kv, dh)
         if getattr(cfg, "position", "learned") == "rope":
             # RoPE rotates every head by the SAME position angles, so the
             # local head shard rotates exactly as it would unsharded — tp
@@ -136,12 +159,15 @@ class TpBlock(nn.Module):
             cos, sin = rope_tables(dh, s, cfg.rope_theta, positions=positions)
             q4 = apply_rope(q4, cos, sin)
             k4 = apply_rope(k4, cos, sin)
+        if group > 1:
+            # Local head sharing: each shard's query groups read their own
+            # kv heads (whole groups are shard-local by construction).
+            k4 = jnp.repeat(k4, group, axis=2)
+            v4 = jnp.repeat(v.reshape(b, s, local_kv, dh), group, axis=2)
+        else:
+            v4 = v.reshape(b, s, local_kv, dh)
         to_heads = lambda t4: t4.transpose(0, 2, 1, 3)
-        attn = attend(
-            to_heads(q4),
-            to_heads(k4),
-            to_heads(v.reshape(b, s, local_heads, dh)),
-        )
+        attn = attend(to_heads(q4), to_heads(k4), to_heads(v4))
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, local_heads * dh)
         # Row-parallel output projection: partial sums -> THE tp collective.
         # (proj/mlp_out biases, when enabled, are added AFTER the psum so

@@ -282,12 +282,13 @@ def build_sp_tp_lm_train_step(
         tp_param_specs,
     )
 
-    if getattr(cfg, "attention_window", None) is not None:
-        raise ValueError(
-            "attention_window is not supported by the ring-attention sp_tp "
-            "path (the ring streams full kv shards); unset it here"
-        )
-    ring = lambda q, k, v: ring_attention(q, k, v, axis_name="pipe", causal=True)
+    # attention_window composes here the same way as plain SP: the ring
+    # truncates to the hops the window can reach (ring_attention's windowed
+    # path — O(window) communication per device).
+    w = getattr(cfg, "attention_window", None)
+    ring = lambda q, k, v: ring_attention(
+        q, k, v, axis_name="pipe", causal=True, window=w
+    )
     model = TpTransformerLM(TransformerConfig(**{**cfg.__dict__, "attention": ring}))
     return sp.build_lm_train_step(
         cfg,

@@ -121,26 +121,10 @@ def test_gqa_generate_runs():
     assert out.shape == (2, 10)
 
 
-def test_gqa_under_tp_raises():
-    if len(jax.devices()) < 8:
-        pytest.skip("needs 8 devices")
-    import optax
-
-    from distributed_tensorflow_tpu.parallel import tensor_parallel as tpmod
-    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
-
-    mesh = make_mesh(num_devices=8, model_parallel=2)
-    cfg = _cfg()
-    host = tpmod.init_tp_params(_cfg(num_kv_heads=None), seed=0)
-    step = tpmod.build_tp_lm_train_step(cfg, optax.sgd(0.1), mesh, host, donate=False)
-    from distributed_tensorflow_tpu.parallel import data_parallel as dp
-
-    p = tpmod.shard_params(host, mesh)
-    o = tpmod.shard_params(jax.device_get(optax.sgd(0.1).init(host)), mesh)
-    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
-    toks = jnp.zeros((8, 16), jnp.int32)
-    with pytest.raises(ValueError, match="GQA"):
-        step(p, o, g, toks, jax.random.PRNGKey(0))
+# GQA composes with tensor parallelism since r5 (kv heads shard WITH their
+# query groups); the tp2==tp1 parity, shard-locality, and indivisible-kv
+# rejection tests live with the rest of the r5 composition coverage in
+# tests/test_window_ring.py.
 
 
 def test_bad_kv_heads_rejected():

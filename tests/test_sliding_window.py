@@ -176,22 +176,14 @@ def test_windowed_model_trains_and_decodes():
         )
 
 
-def test_window_rejects_nonpositive_and_ring_path():
+def test_window_rejects_nonpositive():
     q = jnp.zeros((1, 1, 16, 8), jnp.float32)
     with pytest.raises(ValueError, match="window >= 1"):
         A.flash_attention(q, q, q, causal=True, window=0)
     with pytest.raises(ValueError, match="window >= 1"):
         A.dense_attention(q, q, q, causal=True, window=-4)
-    # Ring-attention sequence parallelism streams FULL kv shards — a
-    # windowed config must fail loudly there, not silently go full-causal.
-    from distributed_tensorflow_tpu.parallel import sequence_parallel as sp
-
-    cfg = TransformerConfig(
-        vocab_size=32, d_model=32, num_heads=2, num_layers=1, d_ff=64,
-        max_seq_len=32, attention_window=8,
-    )
-    with pytest.raises(ValueError, match="ring"):
-        sp.make_sp_model(cfg)
+    # (windowed ring/sequence parallelism is supported since r5 — see
+    # tests/test_window_ring.py for its parity and truncation tests.)
 
 
 def test_windowed_flops_accounting_banded():
