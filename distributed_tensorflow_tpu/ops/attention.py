@@ -717,23 +717,51 @@ def _flash_bwd_fused_kernel(
 # f32 dq accumulator and the (sq, _STAT_LANES) f32 delta rows; past this
 # many TILED bytes for their sum, the q axis is SEGMENTED into fused calls
 # that fit (or, if no clean segmentation exists, the two-pass kernels take
-# over). 2 MB ≈ sq 2048 at D=128 (1 KB/row: 512 B dq + 512 B delta), the
-# same total whole-seq scratch the r3 dq-only kernel carried — segments
-# halve vs r3 (2048 rows, not 4096), paying a few extra partial-dk/dv adds
-# to fund the in-kernel delta. A 4 MB limit was measured OVER budget: the
-# 16k D=32 remat path's 4096-row segment hit 16.85 MB of scoped VMEM
-# (868 KB past the 16 MB limit) once the delta scratch and the pinned
-# ``out`` operand blocks joined the r3 layout. The limit is tuned JOINTLY
-# with the 1024/1024 default blocks: the resident per-tile f32
-# intermediates (logits/p/dp at (block_q, block_kv)) dominate VMEM at
-# several MB each, and Mosaic's buffer reuse is what makes the whole
-# kernel fit a v5e core's ~16 MB; this gate bounds only the part that
-# GROWS with sq, which is what the caller controls via segmentation. Sized
-# in TILED bytes: Mosaic pads the lane (last) dim to 128, so a D=32 dq
-# scratch occupies 4x its logical size (measured: a 16k D=32
-# whole-sequence call hit 21 MB and failed to compile when this gate
-# counted logical bytes).
-_FUSED_BWD_SCRATCH_LIMIT = 2 * 1024 * 1024
+# over). 4 MB ≈ sq 4096 at D=128 (1 KB/row: 512 B dq + 512 B delta).
+# History: the r4 value was 2 MB, tuned against XLA:TPU's DEFAULT 16 MiB
+# scoped-VMEM budget (a 4 MB gate measured 868 KB over it at the 16k D=32
+# remat shape). r5 raised the compiler budget to 32 MiB
+# (utils/compile_cache.py — measured +3.5 MFU points on the flagship step
+# by itself), re-swept this gate under it, and 4 MB / 4096-row segments
+# won at 8k_d128 (+2.4% fused-bwd kernel time vs 2 MB; 8 MB whole-seq
+# REGRESSED to 58% of peak — deeper segments starve Mosaic's other
+# buffers), with the previously-OOM 16k D=32 and 16k D=128 shapes
+# compile+run verified at this gate. The limit is tuned JOINTLY with the
+# 1024/1024 default blocks: the resident per-tile f32 intermediates
+# (logits/p/dp at (block_q, block_kv)) dominate VMEM at several MB each;
+# this gate bounds only the part that GROWS with sq, which is what the
+# caller controls via segmentation. Sized in TILED bytes: Mosaic pads the
+# lane (last) dim to 128, so a D=32 dq scratch occupies 4x its logical
+# size (measured: a 16k D=32 whole-sequence call hit 21 MB and failed to
+# compile when this gate counted logical bytes).
+#
+# None = AUTO: resolve per call from the EFFECTIVE compiler budget
+# (:func:`_fused_bwd_scratch_limit`) — 4 MB only when the raised scoped-VMEM
+# budget is actually in force, else the 16-MiB-default-safe 2 MB (the r4
+# value; the 4 MB gate measured 868 KB over that default at 16k D=32).
+# Tests (and callers wanting a fixed gate) may set a byte count here.
+_FUSED_BWD_SCRATCH_LIMIT: int | None = None
+
+
+def _scoped_vmem_budget_kib() -> int:
+    """The scoped-VMEM budget libtpu will use: parsed from LIBTPU_INIT_ARGS
+    (set by utils/compile_cache before backend init), else XLA's default."""
+    import os
+    import re as _re
+
+    m = _re.search(
+        r"--xla_tpu_scoped_vmem_limit_kib=(\d+)",
+        os.environ.get("LIBTPU_INIT_ARGS", ""),
+    )
+    return int(m.group(1)) if m else 16384
+
+
+def _fused_bwd_scratch_limit() -> int:
+    if _FUSED_BWD_SCRATCH_LIMIT is not None:
+        return _FUSED_BWD_SCRATCH_LIMIT
+    return (
+        4 * 1024 * 1024 if _scoped_vmem_budget_kib() >= 32768 else 2 * 1024 * 1024
+    )
 
 
 def _dq_scratch_bytes_per_row(d: int) -> int:
@@ -778,7 +806,7 @@ def _fused_segment_rows(sq: int, d: int, block_q: int) -> int | None:
     ``_FUSED_BWD_SCRATCH_LIMIT``: a multiple of ``block_q`` that divides ``sq``
     evenly. None when no such segmentation exists (callers fall back to the
     two-pass kernels)."""
-    max_rows = _FUSED_BWD_SCRATCH_LIMIT // _dq_scratch_bytes_per_row(d)
+    max_rows = _fused_bwd_scratch_limit() // _dq_scratch_bytes_per_row(d)
     if block_q > max_rows:
         return None
     for n_seg in range(-(-sq // max_rows), sq + 1):  # smallest count first
@@ -896,7 +924,7 @@ def _flash_backward(
     s = _scale(q, scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_SCRATCH_LIMIT:
+    if sq * _dq_scratch_bytes_per_row(d) <= _fused_bwd_scratch_limit():
         return _flash_backward_fused(
             q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
             window=window,
@@ -1252,7 +1280,7 @@ def _flash_backward_bshd(
 
     if not interpret and d % 128:
         return via_bhsd()
-    if sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_SCRATCH_LIMIT:
+    if sq * _dq_scratch_bytes_per_row(d) <= _fused_bwd_scratch_limit():
         return _flash_backward_fused_bshd(
             q, k, v, out, lse, g, causal, block_q, block_kv, scale, interpret,
             window=window,
@@ -1467,7 +1495,7 @@ def _flash_backward_qkv(
     group = h // kv
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    fits_fused = sq * _dq_scratch_bytes_per_row(d) <= _FUSED_BWD_SCRATCH_LIMIT
+    fits_fused = sq * _dq_scratch_bytes_per_row(d) <= _fused_bwd_scratch_limit()
 
     def regroup_kv(dt4):
         """(B, S, H, d) per-q-head kv grads -> (B, S, KV·d): the transpose

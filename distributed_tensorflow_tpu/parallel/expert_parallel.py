@@ -37,6 +37,7 @@ from distributed_tensorflow_tpu.models.transformer import (
     attention_sublayer,
     next_token_loss,
 )
+from distributed_tensorflow_tpu.parallel.data_parallel import fence_grads
 
 __all__ = [
     "MoeMlp",
@@ -326,6 +327,7 @@ def build_moe_lm_train_step(
         grads = jax.tree_util.tree_map_with_path(sync, grads)
         loss = lax.pmean(loss, "data")
         aux = lax.pmean(aux, "data")
+        grads = fence_grads(grads)
         updates, new_opt = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, new_opt, global_step + 1, {"loss": loss, "aux": aux}

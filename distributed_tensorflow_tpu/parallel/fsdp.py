@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_tensorflow_tpu.ops.losses import accuracy, softmax_cross_entropy
+from distributed_tensorflow_tpu.parallel.data_parallel import fence_grads
 
 # Params are sharded over the FLATTENED mesh — both axes act as one FSDP
 # axis, matching data_parallel's batch sharding over ('data','model').
@@ -201,6 +202,7 @@ def _build_step(
         grads = scatter_grad_mean(grads_full)
         metrics = {k: lax.pmean(v, AXES) for k, v in metrics.items()}
         metrics["loss"] = lax.pmean(loss, AXES)
+        grads = fence_grads(grads)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, opt_state, global_step + 1, metrics

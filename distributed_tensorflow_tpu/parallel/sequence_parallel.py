@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu.models.transformer import TransformerConfig, TransformerLM
 from distributed_tensorflow_tpu.parallel.ring_attention import ring_attention
+from distributed_tensorflow_tpu.parallel.data_parallel import fence_grads
 
 Batch = dict[str, jnp.ndarray]
 
@@ -119,6 +120,7 @@ def build_lm_train_step(
         # test_sp_step_matches_single_device_step). pmean averages the
         # near-identical copies — correct value, bitwise-consistent params.
         grads = lax.pmean(grads, both_axes)
+        grads = fence_grads(grads)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         # loss is already a global mean (psum'd inside), identical on all shards.

@@ -49,6 +49,7 @@ from distributed_tensorflow_tpu.models.transformer import (
     next_token_loss,
 )
 from distributed_tensorflow_tpu.ops.rope import apply_rope, rope_tables
+from distributed_tensorflow_tpu.parallel.data_parallel import fence_grads
 
 __all__ = [
     "TpTransformerLM",
@@ -342,6 +343,7 @@ def build_tp_lm_train_step(
         # all shards thanks to _copy_to_tp's backward psum at branch inputs.
         grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, "data"), grads)
         loss = lax.pmean(loss, "data")
+        grads = fence_grads(grads)
         updates, new_opt = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, new_opt, global_step + 1, {"loss": loss}

@@ -55,6 +55,7 @@ from distributed_tensorflow_tpu.parallel.tensor_parallel import (
     _spec_for_path,
     init_tp_params,
 )
+from distributed_tensorflow_tpu.parallel.data_parallel import fence_grads
 
 __all__ = [
     "init_3d_params",
@@ -228,6 +229,7 @@ def build_3d_lm_train_step(
 
         grads = jax.tree_util.tree_map_with_path(sync, grads)
         loss = lax.pmean(loss, "data")
+        grads = fence_grads(grads)
         updates, new_opt = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
         return params, new_opt, global_step + 1, {"loss": loss}

@@ -285,16 +285,35 @@ def test_flash_backward_segmented_matches_whole(monkeypatch, causal):
 def test_fused_segment_rows_choices():
     """Segment chooser: largest block-multiple divisor under the VMEM cap;
     None when the requested block alone exceeds it (two-pass fallback)."""
-    # 2048 rows at D<=128: 512 B/row lane-padded dq + 512 B/row delta.
-    limit_rows = A._FUSED_BWD_SCRATCH_LIMIT // (2 * 128 * 4)
-    assert limit_rows == 2048
-    assert A._fused_segment_rows(2048, 128, 1024) == 2048
-    assert A._fused_segment_rows(8192, 128, 1024) == limit_rows
-    # D=64 pads to 128 lanes, so its cap matches D=128's, not double it.
-    assert A._fused_segment_rows(65536, 64, 1024) == 2048
-    assert A._fused_segment_rows(8192, 128, 8192) is None
-    # No block-multiple divisor under the cap: 6 * 2048 at D=128 splits 6x.
-    assert A._fused_segment_rows(12288, 128, 1024) == 2048
+    # The gate is budget-aware since r5: 4 MB under the raised 32 MiB
+    # scoped-VMEM budget (utils/compile_cache applies it), 2 MB under the
+    # XLA 16 MiB default, explicit override wins.
+    import os
+
+    old_env = os.environ.get("LIBTPU_INIT_ARGS")
+    try:
+        os.environ["LIBTPU_INIT_ARGS"] = "--xla_tpu_scoped_vmem_limit_kib=32768"
+        assert A._fused_bwd_scratch_limit() == 4 * 1024 * 1024
+        os.environ["LIBTPU_INIT_ARGS"] = ""
+        assert A._fused_bwd_scratch_limit() == 2 * 1024 * 1024
+        os.environ["LIBTPU_INIT_ARGS"] = "--xla_tpu_scoped_vmem_limit_kib=32768"
+        # 4096 rows at D<=128: 512 B/row lane-padded dq + 512 B/row delta.
+        limit_rows = A._fused_bwd_scratch_limit() // (2 * 128 * 4)
+        assert limit_rows == 4096
+        assert A._fused_segment_rows(4096, 128, 1024) == 4096
+        assert A._fused_segment_rows(16384, 128, 1024) == limit_rows
+        # D=64 pads to 128 lanes, so its cap matches D=128's, not double it.
+        assert A._fused_segment_rows(65536, 64, 1024) == 4096
+        assert A._fused_segment_rows(8192, 128, 8192) is None
+        # Multi-way split picks the LARGEST valid block-multiple segment.
+        assert A._fused_segment_rows(12288, 128, 1024) == 4096
+        # No block-multiple divisor at all (odd tail): falls back to None.
+        assert A._fused_segment_rows(12288, 128, 5000) is None
+    finally:
+        if old_env is None:
+            os.environ.pop("LIBTPU_INIT_ARGS", None)
+        else:
+            os.environ["LIBTPU_INIT_ARGS"] = old_env
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,117 @@
+"""Adam-fused-dW A/B (r5 #1b): does fencing the optimizer update out of the
+backward matmuls' epilogues recover the ~16 ms/step the r4 trace attributed
+to Adam+dW fusion?
+
+XLA fuses the Adam elementwise update chain into the weight-gradient
+matmuls' epilogues; the r4 XPlane budget measured those fused dW ops
+~16 ms/step above the matmul roofline at the flagship shape. Hypothesis:
+the epilogue fusion hurts the matmul's tiling/occupancy more than it saves
+in HBM traffic. Test: `lax.optimization_barrier` between the gradient tree
+and `tx.update` — one program still, but XLA cannot cross the fence.
+
+Run: python tools/adam_fusion_probe.py   (TPU required)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import bench
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    next_token_loss,
+)
+from distributed_tensorflow_tpu.parallel import data_parallel as dp
+from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_tpu.utils.compile_cache import enable_compilation_cache
+from distributed_tensorflow_tpu.utils.flops import chip_peak_flops, transformer_train_flops
+
+enable_compilation_cache()
+
+sh = bench.LM_SHAPE
+cfg = TransformerConfig(
+    vocab_size=256, d_model=sh["d_model"], num_heads=sh["num_heads"],
+    num_layers=sh["num_layers"], d_ff=sh["d_ff"], max_seq_len=sh["seq"],
+    attention="flash", compute_dtype=jnp.bfloat16, use_bias=False,
+)
+mesh = make_mesh()
+model = TransformerLM(cfg)
+tx = optax.adam(1e-4)
+
+
+def build_step(barrier: bool):
+    def _shard_step(p, o, g, tokens, key):
+        del key
+
+        def compute(pp_):
+            return next_token_loss(model.apply({"params": pp_}, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(compute)(p)
+        grads = lax.pmean(grads, ("data", "model"))
+        loss = lax.pmean(loss, ("data", "model"))
+        if barrier:
+            grads = lax.optimization_barrier(grads)
+        updates, o2 = tx.update(grads, o, p)
+        p = jax.tree_util.tree_map(lambda a, u: a + u, p, updates)
+        return p, o2, g + 1, {"loss": loss}
+
+    shard_fn = jax.shard_map(
+        _shard_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P(("data", "model"), None), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(shard_fn, donate_argnums=(0, 1))
+
+
+def measure(step):
+    rep = jax.sharding.NamedSharding(mesh, P())
+    p = jax.jit(
+        lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"],
+        out_shardings=rep,
+    )(jax.random.PRNGKey(0))
+    o = jax.jit(tx.init, out_shardings=rep)(p)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    batch = sh["batch"]
+    toks = dp.shard_global_batch(
+        {"x": np.random.default_rng(0).integers(0, 256, (batch, sh["seq"])).astype(np.int32)},
+        mesh,
+    )["x"]
+    key = jax.random.PRNGKey(0)
+    for _ in range(3):
+        p, o, g, m = step(p, o, g, toks, key)
+    base = int(jax.device_get(g))
+    t0 = time.perf_counter()
+    for _ in range(15):
+        p, o, g, m = step(p, o, g, toks, key)
+    steps = int(jax.device_get(g)) - base
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    assert jax.default_backend() == "tpu"
+    peak = chip_peak_flops()
+    flops = transformer_train_flops(cfg, sh["batch"])
+    for name, barrier in (("fused (current)", False), ("barrier", True)):
+        # Fresh buffers per variant (donation consumed the previous set).
+        dt = measure(build_step(barrier))
+        print(
+            f"{name:18s} {dt*1e3:7.1f} ms/step  MFU {flops/dt/peak:.4f}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,279 @@
+"""MXU/VPU software-pipelining probe for the flash forward kernel (r5 #1a).
+
+The r4 trace budget attributes ~82%-of-causal-ceiling to the in-context
+flash kernels; the named untried lever is overlapping the VPU softmax of kv
+iteration j with the MXU dots of j+1. Pallas's kv grid axis runs the kernel
+body sequentially, and within one body the chain logits(MXU) → softmax(VPU)
+→ p·v(MXU) is serial. This probe restructures the forward as a one-step
+software pipeline ACROSS grid steps:
+
+  step j: [process logits_{j-1} from VMEM scratch: softmax + p·v_{j-1} dot]
+          [compute logits_j into scratch: q·k_jᵀ dot]
+
+with the v fetch LAGGED one kv block via its index map, and one extra grid
+step to flush. The two halves of the body have no data dependence (only a
+scratch WAR hazard, read-before-write in body order), giving Mosaic's
+scheduler the freedom to overlap the j-dot with the (j-1)-softmax.
+
+Measures current vs pipelined fwd kernel-only (chained-scan difference
+method, bench.py methodology) at the flagship in-context shape and the 8k
+bench shape, with a numerical parity check against the shipped kernel.
+
+Run: python tools/pipeline_probe.py   (TPU required)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import bench
+from distributed_tensorflow_tpu.ops import attention as A
+from distributed_tensorflow_tpu.utils.compile_cache import enable_compilation_cache
+from distributed_tensorflow_tpu.utils.flops import chip_peak_flops
+
+enable_compilation_cache()
+NEG_INF = A.NEG_INF
+_STAT_LANES = A._STAT_LANES
+
+
+def _pipe_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref,
+    acc_ref, m_ref, l_ref, logits_ref,
+    *, block_kv: int, num_kv: int, causal: bool, s: float, q_pos_offset: int,
+):
+    """Grid (bh, q_blocks, num_kv + 1): step j processes the PREVIOUS step's
+    logits (VPU softmax + p·v dot on the lagged v block) and computes THIS
+    step's logits into scratch (q·k dot). Read-then-write on logits_ref in
+    body order resolves the WAR hazard; the two dots are independent."""
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    bq = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+
+    if causal:
+        last_q = q_pos_offset + (qi + 1) * bq - 1
+        last_block = last_q // block_kv  # last kv block this q tile needs
+    else:
+        last_block = num_kv - 1
+
+    # ---- stage B: process logits_{j-1} (VPU) + p·v_{j-1} (MXU). Double-
+    # buffered scratch: B reads slot (j-1)%2 while A writes slot j%2 — no
+    # hazard between the stages at all. v_ref is the LAGGED block.
+    @pl.when((j >= 1) & (j - 1 <= last_block))
+    def _process_prev():
+        logits = logits_ref[(j - 1) % 2]
+        v_blk = v_ref[0]
+        m = m_ref[:, :1]
+        l = l_ref[:, :1]
+        m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        correction = jnp.exp(m - m_safe)
+        p = jnp.exp(logits - m_safe)
+        l_new = l * correction + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_out = m_safe + jnp.where(m_new <= NEG_INF / 2, NEG_INF, 0.0)
+        m_ref[...] = jnp.broadcast_to(m_out, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    # ---- stage A: compute logits_j into scratch (MXU dot + mask).
+    @pl.when((j < num_kv) & (j <= last_block))
+    def _compute_logits():
+        q = (q_ref[0].astype(jnp.float32) * s).astype(q_ref.dtype)
+        k_blk = k_ref[0]
+        logits = jax.lax.dot_general(
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = (
+                q_pos_offset + qi * bq
+                + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+            )
+            k_pos = j * block_kv + lax.broadcasted_iota(
+                jnp.int32, (1, block_kv), 1
+            )
+            logits = jnp.where(k_pos <= q_pos, logits, NEG_INF)
+        logits_ref[j % 2] = logits
+
+    @pl.when(j == num_kv)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(
+            o_ref.dtype
+        )
+        lse_ref[0] = m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30))
+
+
+def pipe_flash_forward(q, k, v, causal=True, block_q=1024, block_kv=1024,
+                       scale=None, out_dtype=None):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    s = (1.0 / np.sqrt(d)) if scale is None else scale
+    block_q = min(block_q, sq)
+    block_kv = min(block_kv, skv)
+    num_kv = skv // block_kv
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, skv, d)
+    vf = v.reshape(b * h, skv, d)
+    q_pos_offset = skv - sq
+
+    def q_index(bh, i, j):
+        return (bh, i, 0)
+
+    def k_index(bh, i, j):
+        # Same causal clamp as the shipped kernel, additionally clamped to
+        # the real range for the flush step.
+        blk = jnp.minimum(j, num_kv - 1)
+        if causal:
+            last = jnp.clip(
+                (q_pos_offset + (i + 1) * block_q - 1) // block_kv, 0, num_kv - 1
+            )
+            blk = jnp.minimum(blk, last)
+        return (bh, blk, 0)
+
+    def v_index(bh, i, j):
+        # LAGGED one step: step j consumes v_{j-1}.
+        blk = jnp.clip(j - 1, 0, num_kv - 1)
+        if causal:
+            last = jnp.clip(
+                (q_pos_offset + (i + 1) * block_q - 1) // block_kv, 0, num_kv - 1
+            )
+            blk = jnp.minimum(blk, last)
+        return (bh, blk, 0)
+
+    kernel = functools.partial(
+        _pipe_fwd_kernel,
+        block_kv=block_kv, num_kv=num_kv, causal=causal, s=s,
+        q_pos_offset=q_pos_offset,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q, num_kv + 1),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_kv, d), k_index),
+            pl.BlockSpec((1, block_kv, d), v_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((2, block_q, block_kv), jnp.float32),
+        ],
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)
+
+
+# ---------------------------------------------------------------------------
+# Measurement
+# ---------------------------------------------------------------------------
+
+drain = lambda x: jax.device_get(x)
+peak = chip_peak_flops()
+
+
+def kernel_only_ms(fn, q, k, v, n_scan=60):
+    zero = jnp.zeros((), jnp.bfloat16)
+
+    def unit(q, k, v, c):
+        val = fn(q + c, k, v).astype(jnp.float32).sum()
+        return val, (val * 1e-37).astype(jnp.bfloat16)
+
+    @functools.partial(jax.jit, static_argnums=3)
+    def run_n(q, k, v, length):
+        def body(c, _):
+            val, c2 = unit(q, k, v, c)
+            return c2, val
+        _, vals = jax.lax.scan(body, zero, None, length=length)
+        return vals.sum()
+
+    def run(length):
+        t0 = time.perf_counter()
+        drain(run_n(q, k, v, length))
+        return time.perf_counter() - t0
+
+    drain(run_n(q, k, v, 4 * n_scan))
+    drain(run_n(q, k, v, n_scan))
+    return bench._per_iter_time(run, 4 * n_scan, n_scan, reps=3)
+
+
+def main():
+    assert jax.default_backend() == "tpu", "TPU required"
+    for tag, (b, h, s, d) in (
+        ("flagship_2k", (12, 16, 2048, 128)),
+        ("8k_d128", (1, 8, 8192, 128)),
+    ):
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+            for _ in range(3)
+        )
+        # Numerics: pipelined == shipped kernel (same f32 softmax math).
+        ref = A.flash_attention(q, k, v, causal=True)
+        got = pipe_flash_forward(q, k, v, causal=True)
+        err = float(
+            jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32)))
+        )
+        print(f"[{tag}] max |pipe - shipped| = {err:.2e}", flush=True)
+        assert err < 1e-2, err
+
+        fwd_flops = 2 * b * h * s * s * d  # causal half of 4BHS²D
+        cur = kernel_only_ms(
+            lambda q, k, v: A.flash_attention(q, k, v, causal=True), q, k, v
+        )
+        cur512 = kernel_only_ms(
+            lambda q, k, v: A.flash_attention(
+                q, k, v, causal=True
+            ),
+            q, k, v,
+        )
+        pipe = kernel_only_ms(
+            lambda q, k, v: pipe_flash_forward(
+                q, k, v, causal=True
+            ),
+            q, k, v,
+        )
+        for name, dt in (("current 1024/1024", cur),
+                         ("current  512/1024", cur512),
+                         ("pipelined 512/1024", pipe)):
+            if dt is None:
+                print(f"[{tag}] {name}: UNMEASURED", flush=True)
+                continue
+            print(
+                f"[{tag}] {name}: {dt*1e3:7.3f} ms  "
+                f"{fwd_flops/dt/1e12:6.1f} TFLOP/s"
+                + (f"  ({fwd_flops/dt/peak*100:.1f}% peak)" if peak else ""),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()
