@@ -38,9 +38,10 @@ dispatch amortization):
     r2/r3's grating metric, which saturated at 1.0 where it could not
     show a regression).
 
-Metrics named in ``FLOORS`` are enforced: any stated floor violated (or a
-floored metric missing) exits nonzero after the record prints, on TPU full
-(non-smoke) runs.
+Metrics named in ``FLOORS`` (value floors) and ``FRAC_FLOORS`` (efficiency
+floors on the ``frac`` fraction-of-ceiling field) are enforced: any stated
+floor violated (or a floored metric/field missing) exits nonzero after the
+record prints, on TPU full (non-smoke) runs.
 
 ``vs_baseline`` context: the reference publishes no numbers
 (BASELINE.md; BASELINE.json "published" is empty), so the denominator is a
@@ -118,6 +119,7 @@ def _per_iter_time(
     if diag is not None:
         diag["long_min_ms"] = round(longs[0] * 1e3, 2)
         diag["long_med_ms"] = round(longs[len(longs) // 2] * 1e3, 2)
+        diag["reps"] = reps
     if t_long - t_short <= 0.1 * t_short:
         import sys
 
@@ -389,7 +391,9 @@ def bench_lm_decode() -> list[dict]:
         )
 
     def measure(cfg, p, B, cast_params=True):
-        """Difference-method tokens/s at batch B; returns (tok/s, ms/step)."""
+        """Difference-method tokens/s at batch B; returns (tok/s, ms/step,
+        long-window diag) — diag carries {min, median, reps} so noisy-tunnel
+        points are interval-valued in the record (VERDICT r4 #4)."""
         prompt = jnp.asarray(
             np.random.default_rng(0).integers(0, cfg.vocab_size, (B, P)), jnp.int32
         )
@@ -409,13 +413,14 @@ def bench_lm_decode() -> list[dict]:
             _drain(fns[n](p, prompt, key)[0, -1])
             return time.perf_counter() - t0
 
-        per_step = _per_iter_time(run, n_long, n_short)
+        diag: dict = {}
+        per_step = _per_iter_time(run, n_long, n_short, diag=diag)
         if per_step is None:
-            return None, None
-        return B / per_step, per_step
+            return None, None, None
+        return B / per_step, per_step, diag
 
     def emit_point(cfg, p, n_params, B, cast_params, metric, model_note=""):
-        toks, per_step = measure(cfg, p, B, cast_params=cast_params)
+        toks, per_step, diag = measure(cfg, p, B, cast_params=cast_params)
         if toks is None:
             return
         detail = (
@@ -434,11 +439,14 @@ def bench_lm_decode() -> list[dict]:
             # see the cast note above) plus every layer's FULL static
             # KV cache (the cached-attention einsum reads all cache_len
             # rows each step; cfg.kv_heads rows per layer — the GQA
-            # point's roofline shrinks with its cache).
+            # point's roofline shrinks with its cache, and the int8
+            # cache's with its dtype: 1 byte/elem + 4 B/row of f32
+            # scale (k_scale/v_scale in decoding.init_cache)).
             # tokens/s <= B / (bytes / bw).
+            dh = cfg.d_model // cfg.num_heads
+            row_bytes = dh + 4 if cfg.kv_cache_dtype == "int8" else dh * 2
             kv_bytes = (
-                2 * cfg.num_layers * B * cfg.kv_heads
-                * (P + n_long) * (cfg.d_model // cfg.num_heads) * 2
+                2 * cfg.num_layers * B * cfg.kv_heads * (P + n_long) * row_bytes
             )
             step_floor = (n_params * 2 + kv_bytes) / bw
             ceil = B / step_floor
@@ -446,10 +454,19 @@ def bench_lm_decode() -> list[dict]:
                 f"; params+KV HBM roofline {ceil:,.0f} tok/s"
                 f" -> {toks/ceil*100:.0f}%"
             )
-        out.append(
-            {"metric": metric, "value": round(toks, 0), "unit": "tokens/s",
-             "detail": detail}
-        )
+        if diag:
+            detail += (
+                f"; long-window min/med {diag.get('long_min_ms')}"
+                f"/{diag.get('long_med_ms')} ms over {diag.get('reps')} reps"
+            )
+        rec = {"metric": metric, "value": round(toks, 0), "unit": "tokens/s",
+               "detail": detail}
+        if bw is not None:
+            # Machine-readable roofline fraction — FRAC_FLOORS gates on it
+            # so the floor tracks achieved efficiency, not raw tok/s (which
+            # would break the day the flagship shape is retuned).
+            rec["frac"] = round(toks / ceil, 3)
+        out.append(rec)
 
     def init_params(cfg):
         model = TransformerLM(cfg)
@@ -493,6 +510,28 @@ def bench_lm_decode() -> list[dict]:
             cfg, p, n_params, 32, True, "lm_decode_tokens_per_sec_gqa4_b32",
             model_note=f" (GQA {cfg.num_heads}q/{cfg.kv_heads}kv)",
         )
+        # int8 KV cache A/B at the KV-bound batch (r5): same weights, the
+        # cache stored int8 + per-row f32 scales. Two points — the MHA
+        # flagship (isolates the cache-dtype lever against the bf16 b32
+        # point above) and the GQA variant (the levers compose: 4x fewer
+        # kv heads x ~2x fewer bytes per row). Each point's roofline
+        # already accounts for its own cache bytes, so "% of roofline"
+        # stays comparable across all four B=32 rows.
+        for tag, kv_heads in (("_403m_int8kv_b32", h_), ("_gqa4_int8kv_b32", h_ // 4)):
+            cfg_q = TransformerConfig(
+                vocab_size=256, d_model=dm_, num_heads=h_,
+                num_kv_heads=kv_heads, num_layers=nl_, d_ff=dff_,
+                max_seq_len=P + n_long, compute_dtype=jnp.bfloat16,
+                kv_cache_dtype="int8",
+            )
+            p_q, n_params_q = init_params(cfg_q)
+            note = " (int8 KV)" if kv_heads == h_ else (
+                f" (GQA {cfg_q.num_heads}q/{cfg_q.kv_heads}kv, int8 KV)"
+            )
+            emit_point(
+                cfg_q, p_q, n_params_q, 32, True,
+                f"lm_decode_tokens_per_sec{tag}", model_note=note,
+            )
     return out
 
 
@@ -530,15 +569,19 @@ def bench_flash_kernel() -> list[dict]:
     peak = chip_peak_flops()
 
     def emit(name: str, dt: float, flops: int) -> None:
-        out.append(
-            {
-                "metric": name,
-                "value": round(dt * 1e3, 2),
-                "unit": "ms",
-                "detail": f"{flops/dt/1e12:.1f} TFLOP/s"
-                + (f" ({flops/dt/peak*100:.1f}% of peak)" if peak else ""),
-            }
-        )
+        rec = {
+            "metric": name,
+            "value": round(dt * 1e3, 2),
+            "unit": "ms",
+            "detail": f"{flops/dt/1e12:.1f} TFLOP/s"
+            + (f" ({flops/dt/peak*100:.1f}% of peak)" if peak else ""),
+        }
+        if peak:
+            # Machine-readable fraction of chip peak — FRAC_FLOORS gates the
+            # d128 fwd+bwd kernel on it (a regression in the kernel itself,
+            # independent of what ms/step the flagship shape happens to be).
+            rec["frac"] = round(flops / dt / peak, 3)
+        out.append(rec)
 
     def _credible(tag: str, dt: float, flops: int) -> bool:
         """Faster than the chip = a corrupted measurement (jitter on the
@@ -729,33 +772,53 @@ def bench_ckpt_403m() -> list[dict]:
     gb = sum(
         x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(state)
     ) / 1e9
+    # Interval-valued (VERDICT r4 #4): the save path rides the axon tunnel,
+    # whose effective bandwidth swings >2x day to day (r4's own record:
+    # 786.5 s in one session, 337.7 s in another — both honest single runs).
+    # >= 3 reps with {min, median} in the detail lets a reader tell tunnel
+    # weather from a real regression. The reported value is the MEDIAN
+    # (min would hide a consistently slow path; mean is spike-sensitive).
+    reps = 1 if SMOKE else max(1, int(os.environ.get("BENCH_CKPT_REPS", "3")))
     tmp = tempfile.mkdtemp(prefix="bench_ckpt_")
     out = []
     try:
-        mngr = CheckpointManager(tmp, save_interval_secs=0)
-        t0 = time.perf_counter()
-        mngr.save(1, state, wait=True)
-        save_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        restored = mngr.restore_latest(state)
-        jax.block_until_ready(restored)
-        restore_s = time.perf_counter() - t0
+        # max_to_keep=1 bounds temp disk to ~one 4.9 GB checkpoint (plus one
+        # in-flight) across the reps; restore_latest always reads the newest
+        # step, so timing semantics are unchanged.
+        mngr = CheckpointManager(tmp, save_interval_secs=0, max_to_keep=1)
+        saves, restores = [], []
+        for i in range(reps):
+            t0 = time.perf_counter()
+            mngr.save(i + 1, state, wait=True)
+            saves.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            restored = mngr.restore_latest(state)
+            jax.block_until_ready(restored)
+            restores.append(time.perf_counter() - t0)
         mngr.close()
+
+        def med(xs):
+            return sorted(xs)[len(xs) // 2]
+
+        def spread(xs):
+            return f"min/med {min(xs):.1f}/{med(xs):.1f} s over {len(xs)} reps"
+
         tag = "403m" if not SMOKE else "smoke"
         out = [
             {
                 "metric": f"ckpt_save_seconds_{tag}",
-                "value": round(save_s, 2),
+                "value": round(med(saves), 2),
                 "unit": "s",
                 "detail": f"Orbax save, {n_params/1e6:.0f}M params + Adam state "
-                f"({gb:.1f} GB f32), device->host via axon tunnel + local disk",
+                f"({gb:.1f} GB f32), device->host via axon tunnel + local disk; "
+                + spread(saves),
             },
             {
                 "metric": f"ckpt_restore_seconds_{tag}",
-                "value": round(restore_s, 2),
+                "value": round(med(restores), 2),
                 "unit": "s",
                 "detail": f"restore_latest of the same tree ({gb:.1f} GB), "
-                "disk -> host -> device via axon tunnel",
+                "disk -> host -> device via axon tunnel; " + spread(restores),
             },
         ]
     finally:
@@ -1008,7 +1071,23 @@ FLOORS = {
     "retrain_e2e_test_accuracy": 0.90,
     "mnist_real_test_accuracy": 0.95,
     "vit_real_test_accuracy": 0.90,
-    "lm_train_mfu": 0.60,
+    # 0.60 -> 0.72 in r5 (VERDICT r4 #3: the old floor gated parity, not
+    # progress — it would have passed a regression erasing all of r3+r4's
+    # kernel work). 0.72 is the r4 achievement (0.725) minus measurement
+    # margin; r5's grad-fence + scoped-VMEM work measures 0.776.
+    "lm_train_mfu": 0.72,
+}
+
+# Efficiency floors on the ``frac`` field (fraction of the metric's own
+# physical ceiling — HBM roofline for decode, chip peak for the kernels).
+# Gating the fraction instead of the raw value keeps the floor meaningful
+# if the flagship shape is ever retuned: tok/s would change, the achieved
+# fraction of roofline should not regress. Values per VERDICT r4 #3/#4:
+# decode has measured 0.97-1.03 of roofline since r3; the d128 fwd+bwd
+# kernel measured 0.570 of peak in r4.
+FRAC_FLOORS = {
+    "lm_decode_tokens_per_sec_403m": 0.85,
+    "flash_attention_8k_d128_fwd_bwd_kernel_only": 0.50,
 }
 
 
@@ -1022,6 +1101,14 @@ def enforce_floors(metrics: list[dict]) -> list[str]:
             problems.append(f"{name}: MISSING (floor {floor})")
         elif m["value"] < floor:
             problems.append(f"{name}: {m['value']} < floor {floor}")
+    for name, floor in FRAC_FLOORS.items():
+        m = by_name.get(name)
+        if m is None or "frac" not in m:
+            # A discarded-for-jitter kernel timing or a crashed decode bench
+            # must not read as a pass (same rule as FLOORS' MISSING case).
+            problems.append(f"{name}: MISSING frac (frac floor {floor})")
+        elif m["frac"] < floor:
+            problems.append(f"{name}: frac {m['frac']} < floor {floor}")
     return problems
 
 

@@ -54,6 +54,17 @@ def _configure_tpu_vmem_budget() -> None:
     existing = os.environ.get("LIBTPU_INIT_ARGS", "")
     if _SCOPED_VMEM_FLAG in existing:
         return  # operator already chose a value — respect it
+    # libtpu snapshots its init args at plugin init: writing the env var
+    # AFTER the backend is up would not change the budget in force, but
+    # ops/attention._scoped_vmem_budget_kib reads this env var — a late
+    # write would make the scratch gate size 4 MB fusions for a budget
+    # the compiler doesn't actually have (a Mosaic scratch overflow at
+    # the 16k D=32 remat shape, per the r5 A/B record). Leave the env
+    # alone so the gate sizes for the real (default) budget.
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        return
     os.environ["LIBTPU_INIT_ARGS"] = (
         f"{existing} {_SCOPED_VMEM_FLAG}={kib_int}".strip()
     )
@@ -67,9 +78,10 @@ def enable_compilation_cache(directory: str | None = None) -> str | None:
     gate compile time). The TPU scoped-VMEM budget it also applies (module
     docstring) rides LIBTPU_INIT_ARGS, which libtpu snapshots at plugin
     init — call this BEFORE the first jax backend touch (every CLI does,
-    right after flag parsing) or the budget silently stays at the XLA
-    default for the process (the attention gate then sizes for that
-    default — ops/attention._fused_bwd_scratch_limit)."""
+    right after flag parsing). Called after backend init it leaves
+    LIBTPU_INIT_ARGS untouched (the budget in force stays at the XLA
+    default AND the attention gate keeps sizing for that default —
+    ops/attention._fused_bwd_scratch_limit)."""
     _configure_tpu_vmem_budget()
     env = os.environ.get("DTF_COMPILATION_CACHE")
     if env == "0":

@@ -307,8 +307,11 @@ def test_fused_segment_rows_choices():
         assert A._fused_segment_rows(8192, 128, 8192) is None
         # Multi-way split picks the LARGEST valid block-multiple segment.
         assert A._fused_segment_rows(12288, 128, 1024) == 4096
-        # No block-multiple divisor at all (odd tail): falls back to None.
-        assert A._fused_segment_rows(12288, 128, 5000) is None
+        # No block-multiple divisor at all: the block FITS the cap but no
+        # divisor of sq under the cap is a multiple of it (3 divides 3072
+        # but not 20480), so the divisor search itself must exhaust -> None
+        # — distinct from the block-exceeds-cap early exit above.
+        assert A._fused_segment_rows(20480, 128, 3072) is None
     finally:
         if old_env is None:
             os.environ.pop("LIBTPU_INIT_ARGS", None)

@@ -68,6 +68,10 @@ def test_floor_gate_flags_regressions_and_missing_metrics():
     import bench
 
     good = [{"metric": k, "value": v + 0.05} for k, v in bench.FLOORS.items()]
+    good += [
+        {"metric": k, "value": 1.0, "frac": v + 0.05}
+        for k, v in bench.FRAC_FLOORS.items()
+    ]
     assert bench.enforce_floors(good) == []
     injected = [dict(m) for m in good]
     injected[0]["value"] = bench.FLOORS[injected[0]["metric"]] - 0.01
@@ -76,6 +80,16 @@ def test_floor_gate_flags_regressions_and_missing_metrics():
     # A floored metric that never made it into the record is a violation
     # too — a crashed accuracy bench must not read as a pass.
     assert len(bench.enforce_floors(good[1:])) == 1
+    # frac floors (r5): a below-floor efficiency fraction trips even when
+    # the raw value looks healthy, and a record missing the frac field
+    # (e.g. a kernel timing discarded for jitter) is a violation, not a pass.
+    frac_bad = [dict(m) for m in good]
+    frac_bad[-1]["frac"] = min(bench.FRAC_FLOORS.values()) - 0.01
+    assert len(bench.enforce_floors(frac_bad)) == 1
+    frac_missing = [dict(m) for m in good]
+    del frac_missing[-1]["frac"]
+    problems = bench.enforce_floors(frac_missing)
+    assert len(problems) == 1 and "MISSING frac" in problems[0]
 
 
 def test_floor_gate_exits_nonzero_end_to_end():

@@ -251,13 +251,13 @@ def main():
         )
         cur512 = kernel_only_ms(
             lambda q, k, v: A.flash_attention(
-                q, k, v, causal=True
+                q, k, v, causal=True, block_q=512, block_kv=1024
             ),
             q, k, v,
         )
         pipe = kernel_only_ms(
             lambda q, k, v: pipe_flash_forward(
-                q, k, v, causal=True
+                q, k, v, causal=True, block_q=512, block_kv=1024
             ),
             q, k, v,
         )
