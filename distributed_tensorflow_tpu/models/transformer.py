@@ -146,9 +146,13 @@ def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callab
         if prefer_packed:
             # GQA-aware: the kernel's kv column index maps share kv heads
             # across query groups directly — no expanded K/V materializes.
-            def fn(qkv):
+            # RoPE-aware: cos/sin tables pass straight through to the
+            # kernels, which rotate q/k tiles in VMEM (ops/attention.py) —
+            # no rotated copies of the projection output exist in HBM.
+            def fn(qkv, rope_cos=None, rope_sin=None, rope_theta=None):
                 return A.flash_attention_qkv(
-                    qkv, cfg.num_heads, cfg.num_kv_heads, causal=True, window=w
+                    qkv, cfg.num_heads, cfg.num_kv_heads, causal=True, window=w,
+                    rope_cos=rope_cos, rope_sin=rope_sin, rope_theta=rope_theta,
                 )
 
             fn.input_layout = "packed_qkv"
@@ -187,9 +191,16 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
     )(h)
 
     rope = getattr(cfg, "position", "learned") == "rope"
+    layout = getattr(attend, "input_layout", "bhsd")
     if rope:
         # One cos/sin table per sublayer call; XLA CSEs the identical
         # tables across layers. (1, S, half) or (B, S, half), f32.
+        # The packed path hands these INTO the kernels as operands
+        # ("tables" mode) — the kernels' other option, computing cos/sin
+        # from in-kernel iotas ("iota" mode, rope_theta=), measured TEN
+        # MFU points slower on the flagship (62.1 vs 72.7%): Mosaic's
+        # per-tile cos/sin transcendentals cost far more than the table
+        # DMA they save (BASELINE.md r5 negative result).
         cos, sin = rope_tables(
             dh, s, cfg.rope_theta, positions=positions,
             start=cache["len"] if cache is not None else 0,
@@ -206,30 +217,24 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
             return t4
         return jnp.repeat(t4, group, axis=2)
 
-    layout = getattr(attend, "input_layout", "bhsd")
     if cache is None and layout == "packed_qkv":
         # Layout-native attention: the attend fn consumes the fused qkv
-        # projection output DIRECTLY — neither the q/k/v split copies nor
+        # projection output DIRECTLY — neither the q/k/v slice copies nor
         # the (B,H,S,D) head transposes ever materialize at the kernel
         # boundary (measured ~10 ms/step of boundary passes on the
         # flagship, XPlane r4 — ops/attention.py packed-qkv section).
         # GQA included: the kernel's kv column index maps share kv heads
         # across query groups, so the narrower [q|k|v] projection passes
-        # through unexpanded.
+        # through unexpanded. Rope happens INSIDE the kernels too (q/k
+        # tiles rotate in VMEM, gradients rotate back in VMEM) — the
+        # outside rotation (split → apply_rope → concat) measured
+        # ~7 ms/layer of materialized boundary passes at the flagship
+        # shape (XLA cannot fuse elementwise work into a Pallas custom
+        # call's operands): 60.7 → 72.7% flagship MFU (BASELINE.md r5).
         if rope:
-            # Rotate the q/k column sections, pass v through: all fused
-            # elementwise on the projection output, re-packed for the
-            # kernel (XLA folds the rotate+concat into the matmul
-            # epilogue feeding the custom call — measured MFU-neutral on
-            # the flagship, BASELINE.md r5).
-            q, k, v = split_qkv()
-            q = apply_rope(q.reshape(b, s, cfg.num_heads, dh), cos, sin)
-            k = apply_rope(k.reshape(b, s, kv, dh), cos, sin)
-            qkv = jnp.concatenate(
-                [q.reshape(b, s, cfg.d_model), k.reshape(b, s, kv * dh), v],
-                axis=-1,
-            )
-        attn = attend(qkv)
+            attn = attend(qkv, rope_cos=cos, rope_sin=sin)
+        else:
+            attn = attend(qkv)
     elif cache is None and layout == "bshd":
         # Extension point for EXTERNAL attend callables tagged
         # input_layout="bshd" (the public flash_attention_bshd layout) —

@@ -186,22 +186,73 @@ def blockwise_attention(
 _STAT_LANES = 128
 
 
+def _rot_combine(x, c, s, inverse: bool):
+    """Split-half rotation arithmetic shared by both table sources: ``x``
+    (rows, d), ``c``/``s`` (rows, d//2) f32. f32 math, cast back to
+    ``x.dtype`` — matching `ops.rope.apply_rope`'s rounding, so the
+    in-kernel path needs no new numerics story. ``inverse`` applies the
+    transpose rotation (angle negated) — the backward's rotate-back for
+    dq/dk. The swap is a static-slice concat (interpret-safe; Mosaic
+    lowers it to vector moves), VPU-only work that never touches HBM."""
+    if inverse:
+        s = -s
+    cf = jnp.concatenate([c, c], axis=-1)
+    sf = jnp.concatenate([-s, s], axis=-1)
+    xf = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    swapped = jnp.concatenate([xf[:, half:], xf[:, :half]], axis=-1)
+    return (xf * cf + swapped * sf).astype(x.dtype)
+
+
+def _rot_tile(x, cos_ref, sin_ref, inverse: bool = False):
+    """In-VMEM RoPE rotation of one (rows, d) tile from TABLE OPERANDS:
+    ``cos_ref``/``sin_ref`` hold (1, rows, d//2) f32 blocks riding the same
+    index map as ``x``'s rows. This is the SHIPPED model path
+    (models/transformer.py always passes tables): 72.7% flagship MFU vs
+    62.1% for the iota mode below. Cost profile: at long S with few heads
+    the per-cell kv-table DMA (f32, ~2x the bf16 kv fetch) drags ~6%
+    behind even the outside rotation (128k envelope, BASELINE.md r5) —
+    a bf16 table variant is the named untried lever."""
+    return _rot_combine(x, cos_ref[0], sin_ref[0], inverse)
+
+
+def _rot_tile_iota(x, row_base, theta: float, inverse: bool = False):
+    """In-VMEM RoPE rotation of one (rows, d) tile with the cos/sin tables
+    COMPUTED IN-KERNEL from the tile's global row positions (``row_base +
+    iota``): zero table operands and zero table DMA, but a MEASURED LOSER
+    on v5e — 62.1 vs 72.7% flagship MFU against the table mode (Mosaic's
+    per-tile cos/sin transcendentals cost far more than the table DMA
+    they save; BASELINE.md r5 negative result). Kept parity-tested as the
+    zero-operand option — the right call only if a future core gets cheap
+    transcendentals. Positions are ``arange`` by construction (packed
+    self-attention), angles f32 like `ops.rope.rope_cos_sin`."""
+    rows, d = x.shape
+    half = d // 2
+    # θ^(-i/half) = exp(-i·ln θ/half), built from an INTEGER lane iota
+    # (Mosaic's tpu.iota is integer-only, and Pallas kernels cannot
+    # capture array constants). ln θ is a static Python float.
+    lane = lax.broadcasted_iota(jnp.int32, (1, half), 1).astype(jnp.float32)
+    inv_freq = jnp.exp(lane * (-math.log(theta) / half))
+    pos = (
+        row_base + lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+    ).astype(jnp.float32)
+    ang = pos * inv_freq
+    return _rot_combine(x, jnp.cos(ang), jnp.sin(ang), inverse)
+
+
 def _flash_kernel(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
-    lse_ref,
-    acc_ref,
-    m_ref,
-    l_ref,
-    *,
+    *refs,
     block_kv: int,
     num_kv: int,
     causal: bool,
     s: float,
     q_pos_offset: int,
     window: int | None = None,
+    rope: str | None = None,
+    rope_theta: float = 10000.0,
 ):
     """One (batch·head, q-block, kv-block) grid cell.
 
@@ -209,8 +260,17 @@ def _flash_kernel(
     TPU — so the online-softmax state (acc/m/l) lives in VMEM scratch and is
     carried across kv iterations; only one (block_q, D) q tile and one
     (block_kv, D) k/v tile are resident per cell. q_pos_offset end-aligns
-    causal masking when Sq != Skv.
+    causal masking when Sq != Skv. ``rope`` rotates the q/k tiles in-VMEM
+    on load — positions enter the kernel, no rotated copies of q/k ever
+    exist in HBM: mode "tables" takes four table operands after v
+    (:func:`_rot_tile`; the shipped model path), mode "iota" computes
+    cos/sin in-kernel from the tile's row positions
+    (:func:`_rot_tile_iota`; zero operands, measured slower on v5e).
     """
+    if rope == "tables":
+        cos_q_ref, sin_q_ref, cos_kv_ref, sin_kv_ref = refs[:4]
+        refs = refs[4:]
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     qi = pl.program_id(1)
     j = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -231,8 +291,20 @@ def _flash_kernel(
         # — 8x more VPU work at 1024-blocks/D=128); bf16 rounding of q·s is
         # the FlashAttention-2 convention and is covered by the kernel-vs-
         # dense parity tests.
-        q = (q_ref[0].astype(jnp.float32) * s).astype(q_ref.dtype)  # (bq, D)
+        q_raw = q_ref[0]  # (bq, D)
         k_blk = k_ref[0]  # (bkv, D)
+        if rope == "tables":
+            # Rotation BEFORE the scale fold, rounded to the operand dtype —
+            # matching what apply_rope-outside-then-kernel produces.
+            q_raw = _rot_tile(q_raw, cos_q_ref, sin_q_ref)
+            k_blk = _rot_tile(k_blk, cos_kv_ref, sin_kv_ref)
+        elif rope == "iota":
+            # Global row positions: this is the self-attention path
+            # (q_pos_offset == 0, blk == grid j whenever compute runs —
+            # the causal clamps only pin SKIPPED cells' DMAs).
+            q_raw = _rot_tile_iota(q_raw, qi * bq, rope_theta)
+            k_blk = _rot_tile_iota(k_blk, j * block_kv, rope_theta)
+        q = (q_raw.astype(jnp.float32) * s).astype(q_ref.dtype)
         v_blk = v_ref[0]
         logits = jax.lax.dot_general(
             q,
@@ -596,9 +668,10 @@ def _flash_bwd_dkv_kernel(
 
 def _flash_bwd_fused_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, out_ref,
-    dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc, delta_acc,
-    *, num_q: int, num_kv: int, causal: bool, s: float,
-    q_pos_offset: int, window: int | None = None,
+    *refs,
+    num_q: int, num_kv: int, causal: bool, s: float,
+    q_pos_offset: int, window: int | None = None, rope: str | None = None,
+    rope_theta: float = 10000.0,
 ):
     """ONE-pass backward: grid (bh, kj, i) — kv outer so dk/dv accumulate in
     per-kj scratch exactly like :func:`_flash_bwd_dkv_kernel`, while dq
@@ -622,7 +695,21 @@ def _flash_bwd_fused_kernel(
 
     The sq·D f32 dq scratch plus the sq-row delta scratch are the cost —
     callers gate on them fitting VMEM (``_FUSED_BWD_SCRATCH_LIMIT``) and
-    fall back to q-segmentation or the two-pass kernels."""
+    fall back to q-segmentation or the two-pass kernels.
+
+    With ``rope`` the q/k tiles rotate on load (matching the forward), and
+    the accumulated dq/dk — gradients w.r.t. the ROTATED q/k — rotate BACK
+    in-kernel (inverse rotation; rotation is orthogonal, its transpose is
+    the inverse) before they are written: dq per q tile at its LAST
+    contributing kv block (the diagonal cell — later kv blocks are
+    causally skipped), dk at the per-kj finalize. Mode "iota" computes
+    cos/sin in-kernel from row positions; mode "tables" takes four table
+    operands after ``out``. No rotated copies or rotate-back passes exist
+    in HBM in either direction."""
+    if rope == "tables":
+        cos_q_ref, sin_q_ref, cos_kv_ref, sin_kv_ref = refs[:4]
+        refs = refs[4:]
+    dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc, delta_acc = refs
     kj = pl.program_id(1)
     i = pl.program_id(2)
     bkv = k_ref.shape[1]
@@ -651,8 +738,15 @@ def _flash_bwd_fused_kernel(
     def compute():
         # q carries the softmax scale (matching the forward kernel bitwise);
         # dk's trailing ·s is absorbed: s·dSᵀ·q == dSᵀ·(q·s).
-        q = (q_ref[0].astype(jnp.float32) * s).astype(q_ref.dtype)  # (bq, D)
+        q_raw = q_ref[0]  # (bq, D)
         k_blk = k_ref[0]  # (bkv, D)
+        if rope == "tables":
+            q_raw = _rot_tile(q_raw, cos_q_ref, sin_q_ref)
+            k_blk = _rot_tile(k_blk, cos_kv_ref, sin_kv_ref)
+        elif rope == "iota":
+            q_raw = _rot_tile_iota(q_raw, i * bq, rope_theta)
+            k_blk = _rot_tile_iota(k_blk, kj * bkv, rope_theta)
+        q = (q_raw.astype(jnp.float32) * s).astype(q_ref.dtype)
         v_blk = v_ref[0]
         do = do_ref[0]
         lse = lse_ref[0]  # (bq, 1)
@@ -703,9 +797,40 @@ def _flash_bwd_fused_kernel(
     else:
         compute()
 
+    if rope:
+        # Tile i's dq rows are complete once its LAST contributing kv block
+        # has run: causally that is the diagonal block holding the tile's
+        # last query (kv blocks past it are skipped; a window only removes
+        # EARLIER blocks, so the upper end is unchanged); non-causal, the
+        # final kv block. Rotate those rows back IN the f32 scratch — the
+        # q-side table block at this cell is exactly tile i's rows.
+        if causal:
+            last_kj = jnp.clip(
+                (q_pos_offset + (i + 1) * bq - 1) // bkv, 0, num_kv - 1
+            )
+        else:
+            last_kj = num_kv - 1
+
+        @pl.when(kj == last_kj)
+        def _rotate_back_dq():
+            rows = pl.dslice(i * bq, bq)
+            if rope == "tables":
+                dq_acc[rows, :] = _rot_tile(
+                    dq_acc[rows, :], cos_q_ref, sin_q_ref, inverse=True
+                )
+            else:
+                dq_acc[rows, :] = _rot_tile_iota(
+                    dq_acc[rows, :], i * bq, rope_theta, inverse=True
+                )
+
     @pl.when(i == num_q - 1)
     def _finalize_kv():
-        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dk_blk = dk_acc[...]
+        if rope == "tables":
+            dk_blk = _rot_tile(dk_blk, cos_kv_ref, sin_kv_ref, inverse=True)
+        elif rope == "iota":
+            dk_blk = _rot_tile_iota(dk_blk, kj * bkv, rope_theta, inverse=True)
+        dk_ref[0] = dk_blk.astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
     @pl.when((kj == num_kv - 1) & (i == num_q - 1))
@@ -1374,10 +1499,13 @@ flash_attention_bshd.defvjp(_flash_bshd_fwd, _flash_bshd_bwd)
 # ---------------------------------------------------------------------------
 
 
-def _unpack_qkv(qkv, h, kv=None):
+def _unpack_qkv(qkv, h, kv=None, rope_cos=None, rope_sin=None):
     """Split a packed [q (H·dh) | k (KV·dh) | v (KV·dh)] projection into
     (B, S, heads, dh) tensors, EXPANDING kv heads to H by repeat under GQA
-    (the 4D BSHD tiers want equal head counts)."""
+    (the 4D BSHD tiers want equal head counts). When rope tables are given
+    (the fallback paths for shapes the in-kernel rotation doesn't cover),
+    q/k rotate HERE — once, before the kv expansion — via
+    :mod:`ops.rope`, preserving the packed-path semantics exactly."""
     kv = h if kv is None else kv
     b, sq, width = qkv.shape
     dh = width // (h + 2 * kv)
@@ -1385,21 +1513,55 @@ def _unpack_qkv(qkv, h, kv=None):
     q = q.reshape(b, sq, h, dh)
     k = k.reshape(b, sq, kv, dh)
     v = v.reshape(b, sq, kv, dh)
+    if rope_cos is not None:
+        from distributed_tensorflow_tpu.ops.rope import apply_rope
+
+        q = apply_rope(q, rope_cos, rope_sin)
+        k = apply_rope(k, rope_cos, rope_sin)
     if kv != h:
         k = jnp.repeat(k, h // kv, axis=2)
         v = jnp.repeat(v, h // kv, axis=2)
     return q, k, v
 
 
+def _check_rope_tables(rope_cos, rope_sin, b, sq, d, rope_theta=None):
+    """Resolve the packed-path rope mode: ``rope_theta`` (contiguous
+    positions, tables computed in-kernel) → "iota"; cos/sin table operands
+    ((1|B, S, d//2) f32, per-batch explicit positions) → "tables"; neither
+    → None. Theta and tables are mutually exclusive."""
+    if (rope_cos is None) != (rope_sin is None):
+        raise ValueError("rope_cos and rope_sin must be passed together")
+    if rope_theta is not None:
+        if rope_cos is not None:
+            raise ValueError(
+                "pass either rope_theta (contiguous positions, in-kernel "
+                "tables) or rope_cos/rope_sin (explicit positions), not both"
+            )
+        return "iota"
+    if rope_cos is None:
+        return None
+    expect_tail = (sq, d // 2)
+    for name, t in (("rope_cos", rope_cos), ("rope_sin", rope_sin)):
+        if t.ndim != 3 or t.shape[0] not in (1, b) or t.shape[1:] != expect_tail:
+            raise ValueError(
+                f"{name} must be (1|{b}, {sq}, {d // 2}), got {t.shape}"
+            )
+    return "tables"
+
+
 def _flash_forward_qkv(
     qkv, h, kv, causal, block_q, block_kv, scale, interpret,
     with_lse: bool = False, window: int | None = None,
+    rope_cos=None, rope_sin=None, rope_theta=None,
 ):
     """qkv: (B, S, (H + 2·KV)·dh), columns [q | k | v], heads contiguous
     within each section (KV == H is plain MHA; under GQA each group of
     H/KV query heads reads its shared kv-head column block — the index
     maps do the sharing, no expansion materializes). Returns out
-    (B, S, H·dh) (+ lse (B·H, S, 1))."""
+    (B, S, H·dh) (+ lse (B·H, S, 1)). ``rope_cos``/``rope_sin``
+    (1|B, S, dh//2) f32 rotate q/k IN-KERNEL (:func:`_rot_tile`) — every
+    head rotates by the same position angles, so the tables are
+    head-independent and ride the row index maps."""
     if not HAVE_PALLAS:
         raise RuntimeError(
             "jax.experimental.pallas unavailable — use blockwise_attention instead"
@@ -1417,10 +1579,15 @@ def _flash_forward_qkv(
     d = width // (h + 2 * kv)  # head dim
     dm = h * d
     group = h // kv
+    rope = _check_rope_tables(rope_cos, rope_sin, b, sq, d, rope_theta)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if not interpret and d % 128:
-        q, k, v = _unpack_qkv(qkv, h, kv)
+        if rope == "iota":
+            from distributed_tensorflow_tpu.ops.rope import rope_tables
+
+            rope_cos, rope_sin = rope_tables(d, sq, rope_theta)
+        q, k, v = _unpack_qkv(qkv, h, kv, rope_cos=rope_cos, rope_sin=rope_sin)
         res = _flash_forward_bshd(
             q, k, v, causal, block_q, block_kv, scale, interpret,
             with_lse=with_lse, window=window,
@@ -1441,6 +1608,8 @@ def _flash_forward_qkv(
         s=s,
         q_pos_offset=0,
         window=window,
+        rope=rope,
+        rope_theta=rope_theta if rope_theta is not None else 10000.0,
     )
     base_kv = (
         _causal_kv_index(0, block_q, block_kv, num_kv, window) if causal else None
@@ -1457,14 +1626,35 @@ def _flash_forward_qkv(
         blk = j if base_kv is None else base_kv(bh, i, j)[1]
         return (bh // h, blk, h + kv + (bh % h) // group)
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), q_index),
+        pl.BlockSpec((1, block_kv, d), k_index),
+        pl.BlockSpec((1, block_kv, d), v_index),
+    ]
+    operands = [qkv, qkv, qkv]
+    if rope == "tables":
+        tb = rope_cos.shape[0]
+
+        def table_q_index(bh, i, j):
+            return (0 if tb == 1 else bh // h, i, 0)
+
+        def table_kv_index(bh, i, j):
+            blk = j if base_kv is None else base_kv(bh, i, j)[1]
+            return (0 if tb == 1 else bh // h, blk, 0)
+
+        half = d // 2
+        in_specs += [
+            pl.BlockSpec((1, block_q, half), table_q_index),
+            pl.BlockSpec((1, block_q, half), table_q_index),
+            pl.BlockSpec((1, block_kv, half), table_kv_index),
+            pl.BlockSpec((1, block_kv, half), table_kv_index),
+        ]
+        operands += [rope_cos, rope_sin, rope_cos, rope_sin]
+
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_kv, d), k_index),
-            pl.BlockSpec((1, block_kv, d), v_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), q_index),
             pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
@@ -1479,7 +1669,7 @@ def _flash_forward_qkv(
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(qkv, qkv, qkv)
+    )(*operands)
     if with_lse:
         return out, lse
     return out
@@ -1487,12 +1677,13 @@ def _flash_forward_qkv(
 
 def _flash_backward_qkv(
     qkv, h, kv, out, lse, g, causal, block_q, block_kv, scale, interpret,
-    window: int | None = None,
+    window: int | None = None, rope_cos=None, rope_sin=None, rope_theta=None,
 ):
     b, sq, width = qkv.shape
     d = width // (h + 2 * kv)
     dm = h * d
     group = h // kv
+    rope = _check_rope_tables(rope_cos, rope_sin, b, sq, d, rope_theta)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     fits_fused = sq * _dq_scratch_bytes_per_row(d) <= _fused_bwd_scratch_limit()
@@ -1509,12 +1700,24 @@ def _flash_backward_qkv(
         # expanded) and take the BSHD backward (which handles segmentation
         # and fallbacks); the packed fast path exists for shapes that fit
         # ONE fused call — q-segmenting a packed array would slice k/v
-        # along with q.
-        q, k, v = _unpack_qkv(qkv, h, kv)
+        # along with q. Rope rotates at unpack and the resulting dq/dk —
+        # gradients w.r.t. the rotated q/k — rotate back below
+        # (apply_rope with negated sin IS the inverse rotation).
+        if rope == "iota":
+            from distributed_tensorflow_tpu.ops.rope import rope_tables
+
+            rope_cos, rope_sin = rope_tables(d, sq, rope_theta)
+            rope = "tables"
+        q, k, v = _unpack_qkv(qkv, h, kv, rope_cos=rope_cos, rope_sin=rope_sin)
         dq, dk, dv = _flash_backward_bshd(
             q, k, v, out.reshape(b, sq, h, d), lse, g.reshape(b, sq, h, d),
             causal, block_q, block_kv, scale, interpret, window=window,
         )
+        if rope:
+            from distributed_tensorflow_tpu.ops.rope import apply_rope
+
+            dq = apply_rope(dq, rope_cos, -rope_sin)
+            dk = apply_rope(dk, rope_cos, -rope_sin)
         return jnp.concatenate(
             [dq.reshape(b, sq, dm), regroup_kv(dk), regroup_kv(dv)], axis=-1
         )
@@ -1548,6 +1751,37 @@ def _flash_backward_qkv(
         # Read only during the kj==0 sweep (in-kernel delta); pinned after.
         return (bh // h, jnp.where(kj == 0, i, 0), bh % h)
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), q_index),
+        pl.BlockSpec((1, block_kv, d), k_index),
+        pl.BlockSpec((1, block_kv, d), v_index),
+        pl.BlockSpec((1, block_q, d), q_index),
+        pl.BlockSpec((1, block_q, 1), stat_index),
+        pl.BlockSpec((1, block_q, d), out_index),
+    ]
+    operands = [qkv, qkv, qkv, g, lse, out]
+    if rope == "tables":
+        tb = rope_cos.shape[0]
+        half = d // 2
+
+        def table_q_index(bh, kj, i):
+            # Same row block as q_index (incl. its clamps/pins) so the table
+            # rows always match the q tile's whenever compute or the dq
+            # rotate-back reads them; only the leading index differs
+            # (tables are head-independent).
+            return (0 if tb == 1 else bh // h, q_index(bh, kj, i)[1], 0)
+
+        def table_kv_index(bh, kj, i):
+            return (0 if tb == 1 else bh // h, kj, 0)
+
+        in_specs += [
+            pl.BlockSpec((1, block_q, half), table_q_index),
+            pl.BlockSpec((1, block_q, half), table_q_index),
+            pl.BlockSpec((1, block_kv, half), table_kv_index),
+            pl.BlockSpec((1, block_kv, half), table_kv_index),
+        ]
+        operands += [rope_cos, rope_sin, rope_cos, rope_sin]
+
     # dk/dv are emitted PER Q HEAD (the kernel's per-kj scratch accumulates
     # one q head's contributions; different q heads of a group land in
     # adjacent column blocks) and group-summed in XLA below — writing them
@@ -1557,17 +1791,11 @@ def _flash_backward_qkv(
         functools.partial(
             _flash_bwd_fused_kernel,
             num_q=num_q, num_kv=num_kv, causal=causal, s=s, q_pos_offset=0,
-            window=window,
+            window=window, rope=rope,
+            rope_theta=rope_theta if rope_theta is not None else 10000.0,
         ),
         grid=(b * h, num_kv, num_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_kv, d), k_index),
-            pl.BlockSpec((1, block_kv, d), v_index),
-            pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_q, 1), stat_index),
-            pl.BlockSpec((1, block_q, d), out_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, sq, d), lambda bh, kj, i: (bh // h, 0, bh % h)),
             pl.BlockSpec((1, block_kv, d), lambda bh, kj, i: (bh // h, kj, bh % h)),
@@ -1585,12 +1813,12 @@ def _flash_backward_qkv(
             pltpu.VMEM((sq, _STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(qkv, qkv, qkv, g, lse, out)
+    )(*operands)
     return jnp.concatenate([dq, regroup_kv(dk_exp.reshape(b, sq, h, d)),
                             regroup_kv(dv_exp.reshape(b, sq, h, d))], axis=-1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 11))
 def flash_attention_qkv(
     qkv,
     num_heads: int,
@@ -1601,6 +1829,9 @@ def flash_attention_qkv(
     scale: float | None = None,
     interpret: bool | None = None,
     window: int | None = None,
+    rope_cos=None,
+    rope_sin=None,
+    rope_theta: float | None = None,
 ):
     """Flash SELF-attention on the packed qkv projection output: ``qkv`` is
     (B, S, (H + 2·KV)·head_dim) with columns [q | k | v], heads contiguous
@@ -1611,39 +1842,59 @@ def flash_attention_qkv(
     direction (the backward emits per-q-head dk/dv and group-sums, the
     transpose of the sharing). Same kernels, blocks, causal semantics and
     fallbacks as :func:`flash_attention`; the gradient arrives as one
-    packed cotangent that feeds the qkv matmul backward directly."""
+    packed cotangent that feeds the qkv matmul backward directly.
+
+    Rotary position embeddings apply IN-KERNEL: q/k tiles rotate in VMEM
+    on load and gradients rotate back in VMEM before they are written —
+    no rotated copies or boundary passes ever exist in HBM (the outside
+    split → `apply_rope` → concat measured ~7 ms/layer at the flagship
+    shape; XLA cannot fuse elementwise work into a custom call's
+    operands — BASELINE.md r5). Two sources: ``rope_cos``/``rope_sin``
+    ((1|B, S, head_dim//2) f32, from `ops.rope.rope_tables`) pass
+    position tables as operands — THE SHIPPED MODEL PATH (72.7% flagship
+    MFU; also how sequence shards pass per-batch explicit positions);
+    ``rope_theta`` (float) instead computes cos/sin in-kernel from
+    contiguous row positions — zero operands but measured 10 MFU points
+    slower on v5e (transcendental cost; BASELINE.md r5 negative result)."""
     if window is not None and (not causal or window < 1):
         raise ValueError("window requires causal=True and window >= 1")
     kv = num_heads if num_kv_heads is None else num_kv_heads
     return _flash_forward_qkv(
         qkv, num_heads, kv, causal, block_q, block_kv, scale, interpret,
-        window=window,
+        window=window, rope_cos=rope_cos, rope_sin=rope_sin,
+        rope_theta=rope_theta,
     )
 
 
 def _flash_qkv_fwd(
-    qkv, h, num_kv_heads, causal, block_q, block_kv, scale, interpret, window
+    qkv, h, num_kv_heads, causal, block_q, block_kv, scale, interpret, window,
+    rope_cos=None, rope_sin=None, rope_theta=None,
 ):
     kv = h if num_kv_heads is None else num_kv_heads
     out, lse = _flash_forward_qkv(
         qkv, h, kv, causal, block_q, block_kv, scale, interpret, with_lse=True,
-        window=window,
+        window=window, rope_cos=rope_cos, rope_sin=rope_sin,
+        rope_theta=rope_theta,
     )
-    return out, (qkv, out, lse)
+    return out, (qkv, out, lse, rope_cos, rope_sin)
 
 
 def _flash_qkv_bwd(
     h, num_kv_heads, causal, block_q, block_kv, scale, interpret, window,
-    residuals, g,
+    rope_theta, residuals, g,
 ):
     kv = h if num_kv_heads is None else num_kv_heads
-    qkv, out, lse = residuals
-    return (
-        _flash_backward_qkv(
-            qkv, h, kv, out, lse, g, causal, block_q, block_kv, scale,
-            interpret, window=window,
-        ),
+    qkv, out, lse, rope_cos, rope_sin = residuals
+    dqkv = _flash_backward_qkv(
+        qkv, h, kv, out, lse, g, causal, block_q, block_kv, scale,
+        interpret, window=window, rope_cos=rope_cos, rope_sin=rope_sin,
+        rope_theta=rope_theta,
     )
+    # Position tables are constants (integer positions), not trained
+    # parameters — zero cotangents, DCE'd by XLA.
+    dcos = None if rope_cos is None else jnp.zeros_like(rope_cos)
+    dsin = None if rope_sin is None else jnp.zeros_like(rope_sin)
+    return (dqkv, dcos, dsin)
 
 
 flash_attention_qkv.defvjp(_flash_qkv_fwd, _flash_qkv_bwd)

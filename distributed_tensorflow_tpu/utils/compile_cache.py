@@ -60,10 +60,16 @@ def _configure_tpu_vmem_budget() -> None:
     # write would make the scratch gate size 4 MB fusions for a budget
     # the compiler doesn't actually have (a Mosaic scratch overflow at
     # the 16k D=32 remat shape, per the r5 A/B record). Leave the env
-    # alone so the gate sizes for the real (default) budget.
-    from jax._src import xla_bridge
-
-    if xla_bridge.backends_are_initialized():
+    # alone so the gate sizes for the real (default) budget. The check
+    # rides a jax-private symbol (no public "is the backend up yet"
+    # exists); if a future jax moves it, treat the state as unknown and
+    # SKIP the write — startup must not crash, and the conservative gate
+    # is the safe one.
+    try:
+        from jax._src.xla_bridge import backends_are_initialized
+    except ImportError:
+        return
+    if backends_are_initialized():
         return
     os.environ["LIBTPU_INIT_ARGS"] = (
         f"{existing} {_SCOPED_VMEM_FLAG}={kib_int}".strip()

@@ -281,3 +281,155 @@ def test_rope_bundle_roundtrip(tmp_path):
     assert cfg2.position == "rope"
     assert cfg2.rope_theta == 500000.0  # non-default base survives (float)
     assert "pos_embed" not in params2
+
+
+# ---------------------------------------------------------------------------
+# In-kernel rope (r5): flash_attention_qkv takes the cos/sin tables and
+# rotates q/k tiles in VMEM (gradients rotate back in VMEM) — the packed
+# training path never materializes rotated copies in HBM. Parity target:
+# rotating OUTSIDE with apply_rope and calling the same kernel.
+# ---------------------------------------------------------------------------
+
+
+def _packed_rope_case(B=2, S=64, H=4, KV=2, dh=16, seed=0):
+    from distributed_tensorflow_tpu.ops import attention as A
+    from distributed_tensorflow_tpu.ops.rope import rope_tables
+
+    width = (H + 2 * KV) * dh
+    qkv = jnp.asarray(
+        np.random.default_rng(seed).standard_normal((B, S, width)), jnp.float32
+    )
+    cos, sin = rope_tables(dh, S, 10000.0)
+
+    def outside(qkv, window=None):
+        q, k, v = jnp.split(qkv, [H * dh, (H + KV) * dh], axis=-1)
+        q = apply_rope(q.reshape(B, S, H, dh), cos, sin).reshape(B, S, H * dh)
+        k = apply_rope(k.reshape(B, S, KV, dh), cos, sin).reshape(B, S, KV * dh)
+        packed = jnp.concatenate([q, k, v], axis=-1)
+        return A.flash_attention_qkv(
+            packed, H, KV, causal=True, window=window,
+            block_q=16, block_kv=16, interpret=True,
+        )
+
+    def inkernel(qkv, window=None):
+        return A.flash_attention_qkv(
+            qkv, H, KV, causal=True, window=window, block_q=16, block_kv=16,
+            interpret=True, rope_cos=cos, rope_sin=sin,
+        )
+
+    return qkv, cos, sin, outside, inkernel
+
+
+@pytest.mark.parametrize("window", [None, 24])
+def test_flash_qkv_inkernel_rope_matches_outside_rotation(window):
+    """Forward AND gradient parity of the in-kernel rotation against
+    rotating the packed projection outside — GQA (4q/2kv) + causal, with
+    and without a sliding window (the flagship's exact kernel family)."""
+    qkv, _, _, outside, inkernel = _packed_rope_case()
+    np.testing.assert_allclose(
+        np.asarray(inkernel(qkv, window)), np.asarray(outside(qkv, window)),
+        rtol=1e-5, atol=1e-5,
+    )
+    g_out = jax.grad(lambda x: outside(x, window).sum())(qkv)
+    g_in = jax.grad(lambda x: inkernel(x, window).sum())(qkv)
+    np.testing.assert_allclose(
+        np.asarray(g_in), np.asarray(g_out), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_flash_qkv_inkernel_rope_batched_tables():
+    """(B, S, half) per-batch position tables (the sequence-parallel shard
+    contract: explicit global positions) — parity against per-batch outside
+    rotation."""
+    from distributed_tensorflow_tpu.ops import attention as A
+    from distributed_tensorflow_tpu.ops.rope import rope_cos_sin
+
+    B, S, H, KV, dh = 2, 32, 2, 2, 16
+    width = (H + 2 * KV) * dh
+    qkv = jnp.asarray(
+        np.random.default_rng(1).standard_normal((B, S, width)), jnp.float32
+    )
+    # Distinct global offsets per batch row (as sequence shards would pass).
+    positions = jnp.stack([jnp.arange(S), 100 + jnp.arange(S)])
+    cos, sin = rope_cos_sin(positions, dh)
+
+    def outside(qkv):
+        q, k, v = jnp.split(qkv, [H * dh, (H + KV) * dh], axis=-1)
+        q = apply_rope(q.reshape(B, S, H, dh), cos, sin).reshape(B, S, H * dh)
+        k = apply_rope(k.reshape(B, S, KV, dh), cos, sin).reshape(B, S, KV * dh)
+        return A.flash_attention_qkv(
+            jnp.concatenate([q, k, v], axis=-1), H, KV, causal=True,
+            block_q=16, block_kv=16, interpret=True,
+        )
+
+    got = A.flash_attention_qkv(
+        qkv, H, KV, causal=True, block_q=16, block_kv=16, interpret=True,
+        rope_cos=cos, rope_sin=sin,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(outside(qkv)), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_flash_qkv_rope_table_validation():
+    from distributed_tensorflow_tpu.ops import attention as A
+    from distributed_tensorflow_tpu.ops.rope import rope_tables
+
+    B, S, H, dh = 2, 32, 2, 16
+    qkv = jnp.zeros((B, S, 3 * H * dh), jnp.float32)
+    cos, sin = rope_tables(dh, S)
+    with pytest.raises(ValueError, match="together"):
+        A.flash_attention_qkv(qkv, H, causal=True, interpret=True, rope_cos=cos)
+    bad_cos, bad_sin = rope_tables(dh, S + 8)  # wrong seq length
+    with pytest.raises(ValueError, match="rope_cos"):
+        A.flash_attention_qkv(
+            qkv, H, causal=True, interpret=True,
+            rope_cos=bad_cos, rope_sin=bad_sin,
+        )
+
+
+def test_transformer_packed_rope_matches_dense_tier():
+    """The LM's packed-flash training forward with in-kernel rope must match
+    the dense-attention tier (which rotates via apply_rope) — the end-to-end
+    guard that the kernel path computes the same model function."""
+    cfg_flash = _cfg(attention="flash", d_model=64, num_heads=2, num_layers=2)
+    cfg_dense = _cfg(attention="dense", d_model=64, num_heads=2, num_layers=2)
+    toks = _tokens(2, 32)
+    p = TransformerLM(cfg_dense).init(jax.random.PRNGKey(0), toks)["params"]
+    out_d = TransformerLM(cfg_dense).apply({"params": p}, toks)
+    out_f = TransformerLM(cfg_flash).apply({"params": p}, toks)
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_d), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_flash_qkv_inkernel_rope_iota_mode():
+    """rope_theta= computes cos/sin INSIDE the kernel from row iotas —
+    parity against the table-operand mode (fwd + grad) and mutual
+    exclusivity with explicit tables. (The model path ships tables: iota
+    mode measured 10 MFU points slower on the flagship — Mosaic's per-tile
+    transcendentals cost more than the table DMA they save, BASELINE.md
+    r5 — but it is the zero-operand option and stays covered.)"""
+    from distributed_tensorflow_tpu.ops import attention as A
+
+    qkv, cos, sin, outside, _ = _packed_rope_case()
+
+    def iota(qkv):
+        return A.flash_attention_qkv(
+            qkv, 4, 2, causal=True, block_q=16, block_kv=16,
+            interpret=True, rope_theta=10000.0,
+        )
+
+    np.testing.assert_allclose(
+        np.asarray(iota(qkv)), np.asarray(outside(qkv)), rtol=1e-4, atol=1e-4
+    )
+    g_out = jax.grad(lambda x: outside(x).sum())(qkv)
+    g_in = jax.grad(lambda x: iota(x).sum())(qkv)
+    np.testing.assert_allclose(
+        np.asarray(g_in), np.asarray(g_out), rtol=1e-4, atol=1e-4
+    )
+    with pytest.raises(ValueError, match="not both"):
+        A.flash_attention_qkv(
+            qkv, 4, 2, causal=True, interpret=True,
+            rope_theta=1.0, rope_cos=cos, rope_sin=sin,
+        )
