@@ -161,6 +161,22 @@ def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callab
     raise ValueError(f"unknown attention implementation: {cfg.attention!r}")
 
 
+def _accepts_rope_tables(attend) -> bool:
+    """Feature-detect rope kwargs on a packed-layout attend callable: the
+    in-repo packed fn takes them (in-kernel rotation); an EXTERNAL callable
+    tagged input_layout='packed_qkv' that predates rope gets the outside-
+    rotation fallback instead of a TypeError."""
+    import inspect
+
+    try:
+        params = inspect.signature(attend).parameters
+    except (TypeError, ValueError):  # builtins/partials without signatures
+        return False
+    return "rope_cos" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
+
+
 def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
                        positions=None):
     """Pre-norm self-attention + residual, shared by :class:`Block` and the
@@ -231,8 +247,34 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
         # ~7 ms/layer of materialized boundary passes at the flagship
         # shape (XLA cannot fuse elementwise work into a Pallas custom
         # call's operands): 60.7 → 72.7% flagship MFU (BASELINE.md r5).
-        if rope:
-            attn = attend(qkv, rope_cos=cos, rope_sin=sin)
+        if rope and _accepts_rope_tables(attend):
+            # Table precision follows compute precision: under bf16 compute
+            # the q/k tiles round to bf16 after rotation anyway, and bf16
+            # tables halve the kernels' per-tile table DMA — measured
+            # 11.00 → 10.36 ms on the flagship-shape packed fwd+bwd
+            # (no-rope floor 9.00; BASELINE.md r5). f32 compute keeps f32
+            # tables (and the f32 parity tolerances).
+            tdt = (
+                jnp.bfloat16
+                if cfg.compute_dtype == jnp.bfloat16
+                else cos.dtype
+            )
+            attn = attend(qkv, rope_cos=cos.astype(tdt), rope_sin=sin.astype(tdt))
+        elif rope:
+            # EXTERNAL packed-layout callable without the rope kwargs:
+            # rotate outside (slower — the boundary passes the in-kernel
+            # path exists to avoid — but the extension contract keeps
+            # working).
+            q, k, v = split_qkv()
+            q = apply_rope(q.reshape(b, s, cfg.num_heads, dh), cos, sin)
+            k = apply_rope(k.reshape(b, s, kv, dh), cos, sin)
+            attn = attend(
+                jnp.concatenate(
+                    [q.reshape(b, s, cfg.d_model),
+                     k.reshape(b, s, kv * dh), v],
+                    axis=-1,
+                )
+            )
         else:
             attn = attend(qkv)
     elif cache is None and layout == "bshd":

@@ -194,6 +194,8 @@ def _rot_combine(x, c, s, inverse: bool):
     transpose rotation (angle negated) — the backward's rotate-back for
     dq/dk. The swap is a static-slice concat (interpret-safe; Mosaic
     lowers it to vector moves), VPU-only work that never touches HBM."""
+    c = c.astype(jnp.float32)  # tables may arrive bf16 (DMA halving);
+    s = s.astype(jnp.float32)  # the rotation arithmetic stays f32
     if inverse:
         s = -s
     cf = jnp.concatenate([c, c], axis=-1)

@@ -433,3 +433,44 @@ def test_flash_qkv_inkernel_rope_iota_mode():
             qkv, 4, 2, causal=True, interpret=True,
             rope_theta=1.0, rope_cos=cos, rope_sin=sin,
         )
+
+
+def test_flash_qkv_inkernel_rope_bf16_tables():
+    """bf16 cos/sin tables (the bf16-compute model path: halves the
+    kernels' table DMA) stay within bf16 rounding of the f32-table path."""
+    qkv, cos, sin, _, inkernel = _packed_rope_case()
+    from distributed_tensorflow_tpu.ops import attention as A
+
+    ref = inkernel(qkv)
+    got = A.flash_attention_qkv(
+        qkv, 4, 2, causal=True, block_q=16, block_kv=16, interpret=True,
+        rope_cos=cos.astype(jnp.bfloat16), rope_sin=sin.astype(jnp.bfloat16),
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_external_packed_callable_without_rope_kwargs_falls_back():
+    """An EXTERNAL attend callable tagged input_layout='packed_qkv' that
+    predates the rope kwargs must keep working under position='rope' —
+    the sublayer rotates outside and hands it a plain packed qkv (no
+    TypeError), matching the in-repo kernel path numerically."""
+    from distributed_tensorflow_tpu.ops import attention as A
+
+    calls = []
+
+    def legacy_packed(qkv):  # NO rope kwargs
+        calls.append(qkv.shape)
+        return A.flash_attention_qkv(
+            qkv, 4, causal=True, block_q=16, block_kv=16, interpret=True
+        )
+
+    legacy_packed.input_layout = "packed_qkv"
+    cfg = _cfg(attention=legacy_packed)
+    toks = _tokens(2, 16)
+    p = TransformerLM(cfg).init(jax.random.PRNGKey(0), toks)["params"]
+    out = TransformerLM(cfg).apply({"params": p}, toks)
+    assert calls, "legacy packed callable was never invoked"
+    ref = TransformerLM(_cfg(attention="dense")).apply({"params": p}, toks)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
