@@ -172,9 +172,10 @@ def _accepts_rope_tables(attend) -> bool:
         params = inspect.signature(attend).parameters
     except (TypeError, ValueError):  # builtins/partials without signatures
         return False
-    return "rope_cos" in params or any(
-        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
-    )
+    # Require the EXPLICIT parameter: a legacy `**kwargs` wrapper would
+    # swallow the tables and silently attend over unrotated q/k — worse
+    # than the outside-rotation fallback it would bypass.
+    return "rope_cos" in params
 
 
 def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,

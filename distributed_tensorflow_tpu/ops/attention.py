@@ -1529,8 +1529,10 @@ def _unpack_qkv(qkv, h, kv=None, rope_cos=None, rope_sin=None):
 def _check_rope_tables(rope_cos, rope_sin, b, sq, d, rope_theta=None):
     """Resolve the packed-path rope mode: ``rope_theta`` (contiguous
     positions, tables computed in-kernel) → "iota"; cos/sin table operands
-    ((1|B, S, d//2) f32, per-batch explicit positions) → "tables"; neither
-    → None. Theta and tables are mutually exclusive."""
+    ((1|B, S, d//2), f32 OR bf16 — rotation arithmetic is f32 in-kernel
+    either way, and bf16 tables halve the per-tile table DMA under bf16
+    compute) → "tables"; neither → None. Theta and tables are mutually
+    exclusive."""
     if (rope_cos is None) != (rope_sin is None):
         raise ValueError("rope_cos and rope_sin must be passed together")
     if rope_theta is not None:

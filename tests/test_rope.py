@@ -474,3 +474,23 @@ def test_external_packed_callable_without_rope_kwargs_falls_back():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
     )
+
+
+def test_external_packed_callable_with_var_kwargs_also_falls_back():
+    """A legacy wrapper that swallows **kwargs must NOT be treated as
+    rope-capable — it would silently attend over unrotated q/k. The
+    sublayer must take the outside-rotation fallback instead."""
+    from distributed_tensorflow_tpu.models.transformer import _accepts_rope_tables
+    from distributed_tensorflow_tpu.ops import attention as A
+
+    def swallows(qkv, **extra):
+        return A.flash_attention_qkv(
+            qkv, 4, causal=True, block_q=16, block_kv=16, interpret=True
+        )
+
+    assert not _accepts_rope_tables(swallows)
+
+    def explicit(qkv, rope_cos=None, rope_sin=None):
+        return qkv
+
+    assert _accepts_rope_tables(explicit)
