@@ -1563,9 +1563,11 @@ def _flash_forward_qkv(
     H/KV query heads reads its shared kv-head column block — the index
     maps do the sharing, no expansion materializes). Returns out
     (B, S, H·dh) (+ lse (B·H, S, 1)). ``rope_cos``/``rope_sin``
-    (1|B, S, dh//2) f32 rotate q/k IN-KERNEL (:func:`_rot_tile`) — every
-    head rotates by the same position angles, so the tables are
-    head-independent and ride the row index maps."""
+    (1|B, S, dh//2), f32 or bf16 (bf16 halves the per-tile table DMA;
+    rotation arithmetic is f32 in-kernel either way), rotate q/k
+    IN-KERNEL (:func:`_rot_tile`) — every head rotates by the same
+    position angles, so the tables are head-independent and ride the
+    row index maps."""
     if not HAVE_PALLAS:
         raise RuntimeError(
             "jax.experimental.pallas unavailable — use blockwise_attention instead"
