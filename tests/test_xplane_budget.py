@@ -1,0 +1,88 @@
+"""tools/xplane_budget.py: TF-free XSpace wire parsing + op-kind classify.
+
+The parser's field numbers were verified against a real capture (tool
+docstring); these tests pin the wire-walker and the classifier against a
+hand-built XSpace so a refactor can't silently break the budget tool
+between rounds (the traces themselves need the real chip).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from tools.xplane_budget import classify, device_op_times, walk  # noqa: E402
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _field(fno: int, payload: bytes) -> bytes:
+    return _varint((fno << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _vfield(fno: int, value: int) -> bytes:
+    return _varint(fno << 3) + _varint(value)
+
+
+def _build_xspace(tmp_path):
+    """One TPU plane, one 'XLA Ops' line, two events over two metadata ops
+    (the second op occurs twice — durations must SUM per op)."""
+    meta1 = _field(
+        2, b"%fusion.1 = f32[8,8]{1,0:T(8,128)} fusion(%p0), kind=kLoop"
+    ) + _vfield(1, 7)
+    meta2 = _field(
+        2, b"%cc.2 = bf16[8]{0} custom-call(%x), custom_call_target=tpu_custom_call"
+    ) + _vfield(1, 9)
+    entries = _field(4, _vfield(1, 7) + _field(2, meta1)) + _field(
+        4, _vfield(1, 9) + _field(2, meta2)
+    )
+    events = (
+        _field(4, _vfield(1, 7) + _vfield(3, 1000))
+        + _field(4, _vfield(1, 9) + _vfield(3, 200))
+        + _field(4, _vfield(1, 9) + _vfield(3, 300))
+    )
+    line = _field(2, b"XLA Ops") + events
+    plane = _field(2, b"/device:TPU:0") + entries + _field(3, line)
+    space = _field(1, plane)
+    p = tmp_path / "t.xplane.pb"
+    p.write_bytes(space)
+    return str(p)
+
+
+def test_wire_walker_roundtrip():
+    buf = _vfield(1, 300) + _field(2, b"abc")
+    got = list(walk(buf))
+    assert got == [(1, 0, 300), (2, 2, b"abc")]
+
+
+def test_device_op_times_sums_per_op(tmp_path):
+    per_op, n_planes = device_op_times(_build_xspace(tmp_path))
+    assert n_planes == 1
+    by_head = {k.split(" = ")[0]: v for k, v in per_op.items()}
+    assert by_head == {"%fusion.1": 1000, "%cc.2": 500}
+
+
+def test_classify_uses_op_kind_not_operand_text():
+    # A fusion whose operand text mentions 'transpose' and 'slice' must
+    # still classify as a fusion (the r5 bugfix this test pins).
+    f = (
+        "%block_3.3 = (bf16[12,2048,2048]{2,1,0:T(8,128)(2,1)}) "
+        "fusion(%transpose.5, %slice.9), kind=kOutput, calls=%fused_computation"
+    )
+    assert classify(f).startswith("fusions")
+    cc = "%cc = bf16[8]{0} custom-call(%x), custom_call_target=tpu_custom_call"
+    assert classify(cc).startswith("pallas")
+    ar = "%ar = f32[4]{0} all-reduce(%g), replica_groups={}"
+    assert classify(ar) == "collectives"
+    cp = "%copy.1 = f32[4]{0:T(1024)} copy(%a)"
+    assert classify(cp).startswith("data movement")
+    assert classify("no kind here") == "other"
