@@ -109,6 +109,41 @@ class MnistTrainConfig:
     save_model_secs: int = field(
         default=600, metadata={"help": "Supervisor autosave parity, demo2/train.py:172"}
     )
+    max_to_keep: int = field(
+        default=5,
+        metadata={"help": "checkpoints retained by the autosave manager"},
+    )
+    guard_nonfinite: int = field(
+        default=1,
+        metadata={
+            "help": "skip optimizer updates whose global grad norm is "
+            "non-finite (params/opt state untouched, step count advances, "
+            "skipped_nonfinite metric emitted); 0 disables"
+        },
+    )
+    rollback_bad_windows: int = field(
+        default=2,
+        metadata={
+            "help": "after this many CONSECUTIVE eval windows containing "
+            "non-finite (skipped) steps, roll back to the last good "
+            "checkpoint; 0 disables rollback"
+        },
+    )
+    max_rollbacks: int = field(
+        default=3,
+        metadata={
+            "help": "give up (raise) after this many rollbacks in one run — "
+            "a run that keeps diverging needs a human, not a loop"
+        },
+    )
+    preempt_save: int = field(
+        default=1,
+        metadata={
+            "help": "install SIGTERM/SIGINT handlers that trigger a "
+            "coordinated emergency checkpoint at the next step boundary and "
+            "exit cleanly; 0 disables"
+        },
+    )
     seed: int = 0
     synthetic_data: bool = field(
         default=False, metadata={"help": "generate deterministic synthetic MNIST if idx files absent"}
@@ -189,6 +224,15 @@ class ClusterConfig:
     worker_hosts: str = "localhost:12355"
     job_name: str = field(default="worker", metadata={"help": "'ps' exits with a notice"})
     task_index: int = 0
+    initialization_timeout: int = field(
+        default=120,
+        metadata={
+            "help": "seconds to wait for every worker to join the "
+            "coordination service before failing loudly (a preempted or "
+            "mis-addressed worker must not hang the job forever); 0 keeps "
+            "the JAX default (300)"
+        },
+    )
 
     @property
     def worker_list(self) -> list[str]:
@@ -277,6 +321,18 @@ class RetrainConfig:
     save_model_secs: int = field(
         default=600,
         metadata={"help": "autosave interval when --train_dir is set"},
+    )
+    max_to_keep: int = field(
+        default=5,
+        metadata={"help": "checkpoints retained when --train_dir is set"},
+    )
+    rollback_bad_windows: int = field(
+        default=2,
+        metadata={
+            "help": "consecutive eval windows with non-finite (skipped) "
+            "steps before rolling back to the last checkpoint (needs "
+            "--train_dir); 0 disables"
+        },
     )
 
 

@@ -13,10 +13,13 @@ import os
 import sys
 import tarfile
 import tempfile
+import time
 import urllib.request
 from typing import Callable
 
+from distributed_tensorflow_tpu.utils import faults
 from distributed_tensorflow_tpu.utils.logging import get_logger
+from distributed_tensorflow_tpu.utils.retry import retry_call
 
 log = get_logger(__name__)
 
@@ -36,6 +39,34 @@ _UMASK = os.umask(0)
 os.umask(_UMASK)
 
 
+def sweep_stale_parts(
+    dest_dir: str, name: str, max_age_secs: float = 3600.0
+) -> list[str]:
+    """Remove ``<name>.*.part`` temp files older than ``max_age_secs`` —
+    debris from processes killed mid-download (mkstemp names are unique, so
+    they accumulate forever otherwise). The age gate protects a concurrent
+    LIVE downloader's temp file; a killed process's file only ages."""
+    removed = []
+    now = time.time()
+    try:
+        entries = os.listdir(dest_dir)
+    except OSError:
+        return removed
+    for fn in entries:
+        if not (fn.startswith(name + ".") and fn.endswith(".part")):
+            continue
+        path = os.path.join(dest_dir, fn)
+        try:
+            if now - os.stat(path).st_mtime >= max_age_secs:
+                os.remove(path)
+                removed.append(path)
+        except OSError:
+            continue  # raced another sweeper, or the file is live
+    if removed:
+        log.info("swept %d stale partial download(s): %s", len(removed), removed)
+    return removed
+
+
 def download_file(
     url: str,
     dest_path: str,
@@ -43,6 +74,9 @@ def download_file(
     sha256: str | None = None,
     validate: Callable[[str], None] | None = None,
     timeout: float = 60.0,
+    retries: int = 3,
+    retry_base_delay: float = 0.5,
+    stale_part_age_secs: float = 3600.0,
 ) -> bool:
     """Stream ``url`` into ``dest_path`` atomically; the one download helper
     shared by the Inception tgz fetch and the MNIST idx fetch.
@@ -54,6 +88,13 @@ def download_file(
     callback that raises on bad content), and never leaves a partial or
     failed file behind to poison later runs' exists-check.
 
+    Transient network errors (OSError family, incl. URLError and the
+    ``download`` fault-injection site) are retried ``retries`` times with
+    exponential backoff + jitter; verification failures are NOT retried —
+    a wrong sha256 stays wrong. Progress goes to **stderr** (stdout belongs
+    to scripts that parse it), as percent when the server sends
+    Content-Length and as a byte count otherwise.
+
     Returns True when a download happened, False when ``dest_path`` already
     existed."""
     if os.path.exists(dest_path):
@@ -61,42 +102,58 @@ def download_file(
     dest_dir = os.path.dirname(dest_path) or "."
     ensure_dir_exists(dest_dir)
     name = os.path.basename(dest_path)
-    fd, tmp = tempfile.mkstemp(dir=dest_dir, prefix=name + ".", suffix=".part")
-    digest = hashlib.sha256()
-    try:
-        # Wrap the fd FIRST: urlopen raising before os.fdopen would leak it.
-        with os.fdopen(fd, "wb") as f:
-            with urllib.request.urlopen(url, timeout=timeout) as r:
-                total = int(r.headers.get("Content-Length") or 0)
-                done = 0
-                while True:
-                    chunk = r.read(1 << 16)
-                    if not chunk:
-                        break
-                    f.write(chunk)
-                    digest.update(chunk)
-                    done += len(chunk)
-                    if progress and total > 0:
-                        pct = min(100.0, done / total * 100.0)
-                        sys.stdout.write(f"\r>> Downloading {name} {pct:.1f}%")
-                        sys.stdout.flush()
-        if progress:
-            sys.stdout.write("\n")
-        if sha256 is not None and digest.hexdigest() != sha256.lower():
-            raise ValueError(
-                f"{name}: sha256 {digest.hexdigest()} != expected {sha256}"
-            )
-        if validate is not None:
-            validate(tmp)
-        # mkstemp creates mode 0600; restore umask-default permissions (what
-        # the pre-mkstemp urlretrieve path produced) so a restrictive umask
-        # is honored and a permissive one still shares the data_dir.
-        os.chmod(tmp, 0o666 & ~_UMASK)
-        os.replace(tmp, dest_path)
-    except Exception:
-        if os.path.exists(tmp):
-            os.remove(tmp)
-        raise
+    sweep_stale_parts(dest_dir, name, stale_part_age_secs)
+
+    def _attempt() -> None:
+        faults.maybe_fail("download", url)
+        fd, tmp = tempfile.mkstemp(dir=dest_dir, prefix=name + ".", suffix=".part")
+        digest = hashlib.sha256()
+        try:
+            # Wrap the fd FIRST: urlopen raising before os.fdopen would leak it.
+            with os.fdopen(fd, "wb") as f:
+                with urllib.request.urlopen(url, timeout=timeout) as r:
+                    total = int(r.headers.get("Content-Length") or 0)
+                    done = 0
+                    while True:
+                        chunk = r.read(1 << 16)
+                        if not chunk:
+                            break
+                        f.write(chunk)
+                        digest.update(chunk)
+                        done += len(chunk)
+                        if progress:
+                            if total > 0:
+                                pct = min(100.0, done / total * 100.0)
+                                sys.stderr.write(f"\r>> Downloading {name} {pct:.1f}%")
+                            else:
+                                sys.stderr.write(
+                                    f"\r>> Downloading {name} {done / 1e6:.1f}MB"
+                                )
+                            sys.stderr.flush()
+            if progress:
+                sys.stderr.write("\n")
+            if sha256 is not None and digest.hexdigest() != sha256.lower():
+                raise ValueError(
+                    f"{name}: sha256 {digest.hexdigest()} != expected {sha256}"
+                )
+            if validate is not None:
+                validate(tmp)
+            # mkstemp creates mode 0600; restore umask-default permissions (what
+            # the pre-mkstemp urlretrieve path produced) so a restrictive umask
+            # is honored and a permissive one still shares the data_dir.
+            os.chmod(tmp, 0o666 & ~_UMASK)
+            os.replace(tmp, dest_path)
+        except Exception:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+
+    retry_call(
+        _attempt,
+        attempts=max(1, retries),
+        base_delay=retry_base_delay,
+        description=f"download {name}",
+    )
     log.info("Successfully downloaded %s %d bytes.", name, os.stat(dest_path).st_size)
     return True
 

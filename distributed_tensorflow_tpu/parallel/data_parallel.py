@@ -125,6 +125,42 @@ def shard_global_batch(batch: Batch, mesh: Mesh, spec: P | None = None) -> Batch
     )
 
 
+def _global_grad_norm(grads: Any) -> jnp.ndarray:
+    """Global L2 norm of a gradient tree, accumulated in f32 (the same
+    quantity optax's clip_by_global_norm gates on)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    total = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    return jnp.sqrt(total)
+
+
+def guarded_apply(tx, params, opt_state, grads):
+    """Non-finite-step guard: apply the optimizer update only when the global
+    gradient norm is finite; otherwise keep params AND opt state untouched
+    (a NaN step must not advance Adam's moments either — one poisoned moment
+    buffer corrupts every later step). Returns
+    ``(params, opt_state, skipped)`` with ``skipped`` a 0/1 f32 scalar the
+    loops aggregate into the ``skipped_nonfinite`` metric.
+
+    ``lax.cond`` keeps the gate jit/scan-compatible: the predicate is
+    replicated across the mesh (grads are post-pmean), so every device takes
+    the same branch."""
+    finite = jnp.isfinite(_global_grad_norm(grads))
+
+    def _apply(operands):
+        p, o, g = operands
+        g = fence_grads(g)
+        updates, o = tx.update(g, o, p)
+        p = jax.tree_util.tree_map(lambda a, u: a + u, p, updates)
+        return p, o
+
+    def _skip(operands):
+        p, o, _ = operands
+        return p, o
+
+    params, opt_state = lax.cond(finite, _apply, _skip, (params, opt_state, grads))
+    return params, opt_state, 1.0 - finite.astype(jnp.float32)
+
+
 def _shard_index(data_axes: tuple[str, str]):
     """Flat per-device index over the (data, model) axes — the one identity
     used by both the dropout stream and the pool-sampling stream."""
@@ -153,6 +189,7 @@ def _make_shard_step(
     tx,
     loss_fn: Callable,
     data_axes: tuple[str, str] = ("data", "model"),
+    guard_nonfinite: bool = True,
 ):
     """The per-step SPMD body shared by :func:`build_train_step` (one step per
     dispatch) and :func:`build_multi_step` (k steps per dispatch)."""
@@ -167,10 +204,17 @@ def _make_shard_step(
         grads = lax.pmean(grads, data_axes)
         loss = lax.pmean(loss, data_axes)
         acc = lax.pmean(acc, data_axes)
-        grads = fence_grads(grads)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-        return params, opt_state, global_step + 1, {"loss": loss, "accuracy": acc}
+        metrics = {"loss": loss, "accuracy": acc}
+        if guard_nonfinite:
+            params, opt_state, skipped = guarded_apply(tx, params, opt_state, grads)
+            metrics["skipped_nonfinite"] = skipped
+        else:
+            grads = fence_grads(grads)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        # global_step advances either way — a skipped update must not shift
+        # the data/RNG alignment of every later step.
+        return params, opt_state, global_step + 1, metrics
 
     return _shard_step
 
@@ -181,6 +225,7 @@ def build_train_step(
     mesh: Mesh,
     loss_fn: Callable = softmax_cross_entropy,
     donate: bool = True,
+    guard_nonfinite: bool = True,
 ):
     """Build a jitted SPMD train step.
 
@@ -190,9 +235,12 @@ def build_train_step(
     ``global_step`` is the reference's chief-maintained global step
     (``demo2/train.py:146-149``) — here every device holds the same
     replicated counter, incremented exactly once per synchronous step.
+    With ``guard_nonfinite`` (default) a non-finite global grad norm skips
+    the update (see :func:`guarded_apply`) and metrics carry a 0/1
+    ``skipped_nonfinite`` scalar.
     """
     shard_fn = jax.shard_map(
-        _make_shard_step(apply_fn, tx, loss_fn),
+        _make_shard_step(apply_fn, tx, loss_fn, guard_nonfinite=guard_nonfinite),
         mesh=mesh,
         in_specs=(P(), P(), P(), P(("data", "model")), P()),
         out_specs=(P(), P(), P(), P()),
@@ -208,6 +256,7 @@ def build_multi_step(
     mesh: Mesh,
     loss_fn: Callable = softmax_cross_entropy,
     donate: bool = True,
+    guard_nonfinite: bool = True,
 ):
     """k fused train steps per dispatch: ``lax.scan`` over a stacked batch.
 
@@ -221,7 +270,7 @@ def build_multi_step(
     every step. Semantics are identical to k calls of :func:`build_train_step`
     (same per-step RNG folding via the carried global_step).
     """
-    step = _make_shard_step(apply_fn, tx, loss_fn)
+    step = _make_shard_step(apply_fn, tx, loss_fn, guard_nonfinite=guard_nonfinite)
 
     def _shard_multi(params, opt_state, global_step, batches, rng):
         def body(carry, batch):
@@ -251,6 +300,7 @@ def build_accum_train_step(
     mesh: Mesh,
     loss_fn: Callable = softmax_cross_entropy,
     donate: bool = True,
+    guard_nonfinite: bool = True,
 ):
     """Gradient accumulation: ONE optimizer step from k microbatch gradient
     means — the way to train at an effective batch size whose activations
@@ -294,10 +344,15 @@ def build_accum_train_step(
         grads = lax.pmean(grads, data_axes)
         loss = lax.pmean(jnp.mean(losses), data_axes)
         acc = lax.pmean(jnp.mean(accs), data_axes)
-        grads = fence_grads(grads)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-        return params, opt_state, global_step + 1, {"loss": loss, "accuracy": acc}
+        metrics = {"loss": loss, "accuracy": acc}
+        if guard_nonfinite:
+            params, opt_state, skipped = guarded_apply(tx, params, opt_state, grads)
+            metrics["skipped_nonfinite"] = skipped
+        else:
+            grads = fence_grads(grads)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, global_step + 1, metrics
 
     shard_fn = jax.shard_map(
         _shard_accum,
@@ -318,6 +373,7 @@ def build_pool_train_fn(
     steps_per_call: int,
     loss_fn: Callable = softmax_cross_entropy,
     donate: bool = True,
+    guard_nonfinite: bool = True,
 ):
     """Device-resident-dataset training: k steps per dispatch, batches
     gathered on device from an HBM-resident example pool.
@@ -335,7 +391,7 @@ def build_pool_train_fn(
     reference's per-worker independent shuffles (``demo2/train.py:182``).
     """
     data_axes = ("data", "model")
-    step = _make_shard_step(apply_fn, tx, loss_fn, data_axes)
+    step = _make_shard_step(apply_fn, tx, loss_fn, data_axes, guard_nonfinite=guard_nonfinite)
 
     def _shard_pool_train(params, opt_state, global_step, pool, rng):
         n_local = pool["image"].shape[0]

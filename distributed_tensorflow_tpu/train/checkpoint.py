@@ -26,9 +26,18 @@ import numpy as np
 import orbax.checkpoint as ocp
 from flax import serialization
 
+from distributed_tensorflow_tpu.utils import faults
 from distributed_tensorflow_tpu.utils.logging import get_logger
+from distributed_tensorflow_tpu.utils.retry import retry_call
 
 log = get_logger(__name__)
+
+# Orbax I/O retry envelope: transient filesystem/NFS hiccups get a couple of
+# quick retries; deterministic failures (corrupt step, template mismatch)
+# raise OSError subclasses rarely and fall through to the walk-back loop.
+_IO_ATTEMPTS = 3
+_IO_BASE_DELAY = 0.1
+_IO_MAX_DELAY = 2.0
 
 
 def _cross_process_sharded(x) -> bool:
@@ -116,7 +125,21 @@ class CheckpointManager:
             # zero-iteration loop, final forced save of N) or when the timed
             # gate fires on the very last step before the final save.
             return
-        self._mngr.save(step, args=ocp.args.StandardSave(_savable(state)))
+        data = _savable(state)
+
+        def _write() -> None:
+            # Fault site ``ckpt_save`` fires BEFORE the Orbax call — models a
+            # transient I/O error the backoff retry recovers from.
+            faults.maybe_fail("ckpt_save", f"step {step}")
+            self._mngr.save(step, args=ocp.args.StandardSave(data))
+
+        retry_call(
+            _write,
+            attempts=_IO_ATTEMPTS,
+            base_delay=_IO_BASE_DELAY,
+            max_delay=_IO_MAX_DELAY,
+            description=f"checkpoint save step {step}",
+        )
         if wait:
             self._mngr.wait_until_finished()
 
@@ -124,30 +147,66 @@ class CheckpointManager:
         self._mngr.wait_until_finished()  # include any in-flight async save
         return self._mngr.latest_step()
 
+    def _walk_back_restore(self, restore_fn):
+        """Restore the newest READABLE step, newest→oldest: a truncated or
+        corrupt latest checkpoint (process killed mid-write, bad disk) is
+        skipped with a warning instead of blocking every restart while older
+        good checkpoints sit on disk. Returns (step, state) or None (no
+        steps, or none readable — init fresh beats crash-looping)."""
+        self._mngr.wait_until_finished()
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        skipped: list[int] = []
+        for step in steps:
+            def _read(step=step):
+                faults.maybe_fail("ckpt_restore", f"step {step}")
+                return restore_fn(step)
+
+            try:
+                state = retry_call(
+                    _read,
+                    attempts=2,
+                    base_delay=_IO_BASE_DELAY,
+                    max_delay=_IO_MAX_DELAY,
+                    description=f"checkpoint restore step {step}",
+                )
+            except Exception as e:
+                log.warning(
+                    "checkpoint step %d unreadable (%s: %s) — walking back",
+                    step, type(e).__name__, e,
+                )
+                skipped.append(step)
+                continue
+            if skipped:
+                log.warning(
+                    "restored step %d after skipping corrupt/partial "
+                    "checkpoint step(s) %s", step, skipped,
+                )
+            return step, state
+        if skipped:
+            log.error("no readable checkpoint (skipped %s) — starting fresh", skipped)
+        return None
+
     def restore_latest_raw(self):
-        """Restore the newest ckpt without a structure template (numpy leaves);
-        returns (step, state) or None."""
-        step = self.latest_step()
-        if step is None:
-            return None
-        return step, self._mngr.restore(step)
+        """Restore the newest readable ckpt without a structure template
+        (numpy leaves); returns (step, state) or None."""
+        return self._walk_back_restore(lambda step: self._mngr.restore(step))
 
     def restore_latest(self, template: Any):
-        """Returns (step, state) restored from the newest ckpt, or None —
-        mirrors Supervisor init-or-restore (``demo2/train.py:176``).
-        Cross-process-sharded template leaves restore as sharded jax.Arrays
-        (each process reads its own shards); everything else as numpy."""
-        step = self.latest_step()
-        if step is None:
-            return None
+        """Returns (step, state) restored from the newest readable ckpt, or
+        None — mirrors Supervisor init-or-restore (``demo2/train.py:176``),
+        plus the corrupt-checkpoint walk-back (see
+        :meth:`_walk_back_restore`). Cross-process-sharded template leaves
+        restore as sharded jax.Arrays (each process reads its own shards);
+        everything else as numpy."""
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
             if _cross_process_sharded(x)
             else np.asarray(jax.device_get(x)),
             template,
         )
-        state = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
-        return step, state
+        return self._walk_back_restore(
+            lambda step: self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        )
 
     def close(self) -> None:
         self._mngr.close()

@@ -30,7 +30,9 @@ from distributed_tensorflow_tpu.data.prefetch import (
 from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
 from distributed_tensorflow_tpu.parallel import data_parallel as dp
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_tpu.train import resilience
 from distributed_tensorflow_tpu.train.checkpoint import CheckpointManager
+from distributed_tensorflow_tpu.utils import faults
 from distributed_tensorflow_tpu.utils.logging import get_logger
 from distributed_tensorflow_tpu.utils.profiler import Profiler
 from distributed_tensorflow_tpu.utils.summary import SummaryWriter, variable_summaries
@@ -145,7 +147,10 @@ class MnistTrainer:
         self.opt_state = dp.replicate(opt_state, self.mesh)
         self.global_step = dp.replicate(jnp.zeros((), jnp.int32), self.mesh)
 
-        self.train_step = dp.build_train_step(self.model.apply, self.tx, self.mesh)
+        self._guard = bool(getattr(cfg, "guard_nonfinite", 1))
+        self.train_step = dp.build_train_step(
+            self.model.apply, self.tx, self.mesh, guard_nonfinite=self._guard
+        )
         if cfg.accum_steps > 1 and (cfg.steps_per_call > 1 or cfg.device_data):
             raise ValueError(
                 "accum_steps>1 is exclusive with steps_per_call>1 / device_data "
@@ -153,19 +158,34 @@ class MnistTrainer:
                 "other way)"
             )
         self.multi_step = (
-            dp.build_multi_step(self.model.apply, self.tx, self.mesh)
+            dp.build_multi_step(self.model.apply, self.tx, self.mesh, guard_nonfinite=self._guard)
             if cfg.steps_per_call > 1
             else None
         )
         self.accum_step = (
-            dp.build_accum_train_step(self.model.apply, self.tx, self.mesh)
+            dp.build_accum_train_step(self.model.apply, self.tx, self.mesh, guard_nonfinite=self._guard)
             if cfg.accum_steps > 1
             else None
         )
         self.eval_step = dp.build_eval_step(self.model.apply, self.mesh)
 
-        self.ckpt = CheckpointManager(cfg.log_dir, save_interval_secs=cfg.save_model_secs)
+        self.ckpt = CheckpointManager(
+            cfg.log_dir,
+            save_interval_secs=cfg.save_model_secs,
+            max_to_keep=getattr(cfg, "max_to_keep", 5),
+        )
         self.writer = SummaryWriter(cfg.log_dir) if is_chief else None
+
+        # Resilience state: lazily-accumulated per-window skipped-step
+        # scalars (device arrays — summed/fetched only at eval boundaries so
+        # the hot loop stays sync-free), the consecutive-bad-window counter
+        # driving rollback, and the preemption guard (installed for the
+        # duration of train()).
+        self._window_skips: list = []
+        self._bad_windows = 0
+        self._rollbacks = 0
+        self.total_skipped = 0
+        self._preempt: resilience.PreemptionGuard | None = None
 
         # Supervisor parity: init-or-restore from logdir (demo2/train.py:166-176).
         from distributed_tensorflow_tpu.train.checkpoint import restore_replicated
@@ -224,35 +244,41 @@ class MnistTrainer:
         timer = StepTimer(warmup_steps=2)
         step = start_step = int(jax.device_get(self.global_step))
         timer.start(step)
-        if step < num_steps:
-            if cfg.device_data:
-                self._train_loop(None, num_steps, step, timer)
-            else:
-                # Background input pipeline: batch assembly + HBM transfer
-                # overlap the device step (replaces the reference's serial
-                # feed_dict upload, demo1/train.py:153-155).
-                if self.multi_step is not None:
-                    chunks = self._chunk_sizes(step, num_steps)
-                    prefetch = stacked_device_batches(
-                        self.datasets.train, self.feed_batch, self.mesh, chunks
-                    )
-                elif self.accum_step is not None:
-                    # k microbatches per optimizer step, stacked on a leading
-                    # dim (the accum step scans over them).
-                    prefetch = stacked_device_batches(
-                        self.datasets.train,
-                        self.feed_batch,
-                        self.mesh,
-                        [self.cfg.accum_steps] * (num_steps - step),
-                    )
-                else:
-                    prefetch = bounded_device_batches(
-                        self.datasets.train, self.feed_batch, self.mesh, num_steps - step
-                    )
+        self._bad_windows = 0
+        self._window_skips = []
+        guard = resilience.PreemptionGuard() if getattr(cfg, "preempt_save", 1) else None
+        if guard is not None:
+            self._preempt = guard.install()
+        try:
+            while step < num_steps:
                 try:
-                    self._train_loop(prefetch, num_steps, step, timer)
-                finally:
-                    prefetch.close()
+                    self._run_training(step, num_steps, timer)
+                except resilience.Preempted as p:
+                    # Fall through to the forced save below: that IS the
+                    # coordinated emergency checkpoint, after which we return
+                    # cleanly so a restart resumes via restore_replicated.
+                    log.warning(
+                        "preemption at step %d — emergency checkpoint, then "
+                        "clean exit", p.step,
+                    )
+                    break
+                except resilience.RollbackRequested as rb:
+                    self._rollbacks += 1
+                    if self._rollbacks > getattr(cfg, "max_rollbacks", 3):
+                        raise RuntimeError(
+                            f"giving up after {self._rollbacks - 1} rollbacks: "
+                            f"{rb}"
+                        ) from rb
+                    if not self._rollback(rb, timer):
+                        log.error(
+                            "rollback requested but no checkpoint to restore "
+                            "— continuing from current state"
+                        )
+                step = int(jax.device_get(self.global_step))
+        finally:
+            if guard is not None:
+                guard.uninstall()
+            self._preempt = None
         step = int(jax.device_get(self.global_step))
         self._maybe_save(step, force=True)
         if self.is_chief and self.writer:
@@ -273,6 +299,59 @@ class MnistTrainer:
             "seconds": train_time,
             "steps_per_sec": rate,
         }
+
+    def _run_training(self, step: int, num_steps: int, timer: StepTimer) -> None:
+        """One attempt at running [step, num_steps): builds the input
+        pipeline and drives the hot loop. Preemption/rollback propagate as
+        exceptions (input pipeline and profiler are closed on the way out);
+        ``train()`` owns the recovery policy."""
+        cfg = self.cfg
+        if cfg.device_data:
+            self._train_loop(None, num_steps, step, timer)
+            return
+        # Background input pipeline: batch assembly + HBM transfer
+        # overlap the device step (replaces the reference's serial
+        # feed_dict upload, demo1/train.py:153-155).
+        if self.multi_step is not None:
+            chunks = self._chunk_sizes(step, num_steps)
+            prefetch = stacked_device_batches(
+                self.datasets.train, self.feed_batch, self.mesh, chunks
+            )
+        elif self.accum_step is not None:
+            # k microbatches per optimizer step, stacked on a leading
+            # dim (the accum step scans over them).
+            prefetch = stacked_device_batches(
+                self.datasets.train,
+                self.feed_batch,
+                self.mesh,
+                [self.cfg.accum_steps] * (num_steps - step),
+            )
+        else:
+            prefetch = bounded_device_batches(
+                self.datasets.train, self.feed_batch, self.mesh, num_steps - step
+            )
+        try:
+            self._train_loop(prefetch, num_steps, step, timer)
+        finally:
+            prefetch.close()
+
+    def _rollback(self, rb: "resilience.RollbackRequested", timer: StepTimer) -> bool:
+        """Restore the last good checkpoint after a rollback request; returns
+        False when there is nothing to restore."""
+        from distributed_tensorflow_tpu.train.checkpoint import restore_replicated
+
+        self._bad_windows = 0
+        self._window_skips = []
+        restored = restore_replicated(self.ckpt, self._state_dict(), self.mesh)
+        if restored is None:
+            return False
+        step, state = restored
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.global_step = state["global_step"]
+        timer.mark(int(step))
+        log.warning("rolled back to checkpoint step %d (%s)", step, rb)
+        return True
 
     def _train_loop(self, prefetch, num_steps: int, step: int, timer: StepTimer) -> None:
         cfg = self.cfg
@@ -321,6 +400,11 @@ class MnistTrainer:
                 if self.multi_step is not None
                 else 1  # accum: k microbatches but ONE optimizer step
             )
+            # Fault site ``nonfinite_grad:step=N``: NaN the dispatch covering
+            # step N so the grads go non-finite and the guard path (skip +
+            # metric + rollback policy) is exercised for real.
+            if faults.fire_step("nonfinite_grad", range(step, step + k)):
+                batch = {**batch, "image": batch["image"] * jnp.float32(jnp.nan)}
             # Base key only: the step fold happens on-device inside the jitted
             # program (keyed on global_step), so the hot loop does zero
             # per-step host dispatches besides the train step itself.
@@ -329,6 +413,7 @@ class MnistTrainer:
                     self.params, self.opt_state, self.global_step, metrics = self.multi_step(
                         self.params, self.opt_state, self.global_step, batch, self.rng
                     )
+                    self._note_skips(metrics)
                     # Stacked (k,) metrics → report the final step's values,
                     # matching what a per-step loop would log at this point.
                     metrics = {name: v[-1] for name, v in metrics.items()}
@@ -336,10 +421,12 @@ class MnistTrainer:
                     self.params, self.opt_state, self.global_step, metrics = self.accum_step(
                         self.params, self.opt_state, self.global_step, batch, self.rng
                     )
+                    self._note_skips(metrics)
                 else:
                     self.params, self.opt_state, self.global_step, metrics = self.train_step(
                         self.params, self.opt_state, self.global_step, batch, self.rng
                     )
+                    self._note_skips(metrics)
             step += k
             self._post_step(step, num_steps, metrics, timer)
 
@@ -353,25 +440,62 @@ class MnistTrainer:
         fns: dict[int, object] = {}  # one compiled program per distinct k
         for k in set(self._chunk_sizes(step, num_steps)):
             fns[k] = dp.build_pool_train_fn(
-                self.model.apply, self.tx, self.mesh, batch_per_shard, k
+                self.model.apply, self.tx, self.mesh, batch_per_shard, k,
+                guard_nonfinite=self._guard,
             )
         for k in self._chunk_sizes(step, num_steps):
             with prof.step(step, span=k):
                 self.params, self.opt_state, self.global_step, metrics = fns[k](
                     self.params, self.opt_state, self.global_step, pool, self.rng
                 )
+            self._note_skips(metrics)
             # Lazy on-device slice — no host sync in the hot loop; _post_step
             # device_gets at eval cadence only.
             metrics = {name: v[-1] for name, v in metrics.items()}
             step += k
             self._post_step(step, num_steps, metrics, timer)
 
+    def _note_skips(self, metrics) -> None:
+        """Queue this dispatch's skipped-step count (scalar or stacked) for
+        the window aggregate — a device-side sum, NO host sync here."""
+        s = metrics.get("skipped_nonfinite")
+        if s is not None:
+            self._window_skips.append(jnp.sum(s))
+
+    def _drain_window_skips(self) -> int:
+        """Total non-finite-skipped steps since the last eval boundary
+        (fetches the queued device scalars — call at boundaries only)."""
+        parts, self._window_skips = self._window_skips, []
+        if not parts:
+            return 0
+        return int(round(sum(float(jax.device_get(x)) for x in parts)))
+
     def _post_step(self, step: int, num_steps: int, metrics, timer: StepTimer) -> None:
         cfg = self.cfg
         at_boundary = step % cfg.eval_step_interval == 0 or step == num_steps
+        # Preemption first: a pending SIGTERM means save-and-exit beats one
+        # more eval. Fault site ``preempt:step=N`` feeds the same flag a real
+        # signal sets.
+        if self._preempt is not None:
+            if faults.fire_step("preempt", [step]):
+                self._preempt.request()
+            if self._preempt.should_exit(at_boundary):
+                raise resilience.Preempted(step)
+        window_skipped = 0
         if at_boundary:
             m = jax.device_get(metrics)  # completion barrier for the window
             timer.tick_to(step)
+            window_skipped = self._drain_window_skips()
+            self.total_skipped += window_skipped
+            if window_skipped:
+                self._bad_windows += 1
+                log.warning(
+                    "eval window ending at step %d skipped %d non-finite "
+                    "step(s) (%d consecutive bad window(s))",
+                    step, window_skipped, self._bad_windows,
+                )
+            else:
+                self._bad_windows = 0
             test_acc, test_loss = self.evaluate(self.datasets.test)
             train_acc, _ = self.evaluate(self.datasets.train, max_examples=10000)
             rate = timer.steps_per_sec  # 0.0 until the compile window passes
@@ -388,6 +512,7 @@ class MnistTrainer:
                         "test_accuracy": test_acc,
                         "test_loss": test_loss,
                         "train_accuracy": train_acc,
+                        "skipped_nonfinite": float(window_skipped),
                         **({"steps_per_sec": rate} if rate > 0 else {}),
                     },
                     step,
@@ -402,7 +527,24 @@ class MnistTrainer:
                         self.writer, f"{head_name}/weights",
                         p[head_name]["kernel"], step,
                     )
-        saved = self._maybe_save(step, at_eval_boundary=at_boundary)
+        if (
+            at_boundary
+            and window_skipped
+            and getattr(cfg, "rollback_bad_windows", 0) > 0
+            and self._bad_windows >= cfg.rollback_bad_windows
+            and self.ckpt.latest_step() is not None
+        ):
+            # K consecutive windows of skipped updates = a diverged run the
+            # guard alone can't rescue; train() restores the last good
+            # checkpoint. (The bad-window save suppression below keeps the
+            # latest checkpoint pre-divergence.)
+            raise resilience.RollbackRequested(step, self._bad_windows)
+        if at_boundary and window_skipped:
+            # Don't advance the checkpoint chain on a window that skipped
+            # updates: rollback must land BEFORE the divergence started.
+            saved = False
+        else:
+            saved = self._maybe_save(step, at_eval_boundary=at_boundary)
         if at_boundary or saved:
             # Exclude the eval/summary/save work above from the next
             # training window (the boundary tick_to already closed this

@@ -1,0 +1,128 @@
+"""Preemption-safe shutdown + divergence rollback control flow.
+
+The reference's ``tf.train.Supervisor`` gave demo2 crash-resume only by
+accident of its timed autosave (``demo2/train.py:166-176``) — a SIGTERM still
+lost up to ``save_model_secs`` of work. Here preemption is first-class:
+
+* :class:`PreemptionGuard` installs SIGTERM/SIGINT handlers that set a flag;
+  the training loop polls it at step boundaries and raises
+  :class:`Preempted`, which the trainer catches to run a coordinated
+  emergency save and return cleanly — restart then resumes through the
+  existing ``restore_replicated`` path.
+* Multi-process: the flag is agreed on at eval boundaries via
+  ``process_allgather`` (any preempted process preempts the group), so every
+  process enters the collective emergency save together — a unilateral exit
+  would wedge the others in their next collective.
+* :class:`RollbackRequested` is the non-finite guard's escalation: after K
+  consecutive eval windows containing skipped (non-finite) steps, the loop
+  rolls back to the last good checkpoint instead of burning compute on a
+  diverged run.
+
+Signal handlers only install in the main thread (Python restriction); off
+the main thread the guard degrades to poll-only (tests can still call
+``request()``).
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class Preempted(Exception):
+    """Raised by the training loop at a step boundary after a preemption
+    request; carries the host step the loop stopped at."""
+
+    def __init__(self, step: int):
+        super().__init__(f"preemption requested at step {step}")
+        self.step = step
+
+
+class RollbackRequested(Exception):
+    """Raised at an eval boundary when the non-finite-window budget is
+    exhausted; the trainer restores the last good checkpoint and resumes."""
+
+    def __init__(self, step: int, bad_windows: int):
+        super().__init__(
+            f"{bad_windows} consecutive eval windows with non-finite steps "
+            f"at step {step}"
+        )
+        self.step = step
+        self.bad_windows = bad_windows
+
+
+class PreemptionGuard:
+    """Latches SIGTERM/SIGINT into a flag the training loop polls.
+
+    Use as a context manager around the training loop so the previous
+    handlers are always restored (pytest owns SIGINT, for one)."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._flag = False
+        self._prev: dict[int, object] = {}
+        self._installed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def install(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self  # poll-only mode
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- state -------------------------------------------------------------
+
+    def _on_signal(self, signum, frame) -> None:
+        # Handler body stays async-signal-minimal: set the flag, nothing else.
+        self._flag = True
+
+    def request(self) -> None:
+        """Programmatic preemption (fault injection / tests) — identical to a
+        signal arriving."""
+        self._flag = True
+
+    @property
+    def requested(self) -> bool:
+        return self._flag
+
+    def should_exit(self, at_boundary: bool) -> bool:
+        """The loop's per-step-boundary poll. Single process: any boundary.
+        Multi-process: only eval boundaries, where all processes reach the
+        same program point and can agree collectively (any-of semantics)."""
+        if jax.process_count() == 1:
+            return self._flag
+        if not at_boundary:
+            return False
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([self._flag], dtype=np.bool_)
+        )
+        agreed = bool(np.any(flags))
+        if agreed and not self._flag:
+            log.info("peer process requested preemption — joining emergency save")
+        return agreed

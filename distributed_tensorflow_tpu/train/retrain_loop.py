@@ -42,7 +42,9 @@ from distributed_tensorflow_tpu.models import inception_v3 as iv3
 from distributed_tensorflow_tpu.models.head import BottleneckHead
 from distributed_tensorflow_tpu.parallel import data_parallel as dp
 from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+from distributed_tensorflow_tpu.train import resilience
 from distributed_tensorflow_tpu.train.checkpoint import export_inference_bundle
+from distributed_tensorflow_tpu.utils import faults
 from distributed_tensorflow_tpu.utils.logging import get_logger
 from distributed_tensorflow_tpu.utils.summary import SummaryWriter
 from distributed_tensorflow_tpu.utils.timer import WallClock
@@ -173,7 +175,9 @@ class RetrainTrainer:
             )
 
             self.ckpt = CheckpointManager(
-                cfg.train_dir, save_interval_secs=cfg.save_model_secs
+                cfg.train_dir,
+                save_interval_secs=cfg.save_model_secs,
+                max_to_keep=getattr(cfg, "max_to_keep", 5),
             )
             restored = restore_replicated(self.ckpt, self._state_dict(), self.mesh)
             if restored is not None:
@@ -183,6 +187,13 @@ class RetrainTrainer:
                 self.global_step = state["global_step"]
                 log.info("restored head-training checkpoint at step %d from %s",
                          step, cfg.train_dir)
+
+        # Resilience state (mirrors train/loop.py): per-window skipped-step
+        # scalars from the non-finite guard, the consecutive-bad-window
+        # counter, and the run total.
+        self._window_skips: list = []
+        self._bad_windows = 0
+        self.total_skipped = 0
 
     def _state_dict(self):
         return {
@@ -280,40 +291,100 @@ class RetrainTrainer:
         train_bs = -(-cfg.train_batch_size // self.mesh_size) * self.mesh_size
 
         step = int(jax.device_get(self.global_step))
-        while step < cfg.training_steps:
-            bottlenecks, truths, _ = self._sample(train_bs, "training")
-            batch = dp.shard_global_batch(
-                {"image": bottlenecks, "label": truths}, self.mesh
-            )
-            # Base key only — the per-step fold happens on-device in the jitted
-            # step, keyed on global_step.
-            self.params, self.opt_state, self.global_step, metrics = self.train_step(
-                self.params, self.opt_state, self.global_step, batch, self.step_rng
-            )
-            step += 1
-            is_last = step == cfg.training_steps
-            self._maybe_save(
-                step,
-                at_boundary=(step % cfg.eval_step_interval == 0 or is_last),
-            )
-            if step % cfg.eval_step_interval == 0 or is_last:
-                m = jax.device_get(metrics)
-                train_acc, train_ce = float(m["accuracy"]), float(m["loss"])
-                vb, vt, _ = self._sample(cfg.validation_batch_size, "validation")
-                val_acc, val_ce = self._eval_batch(vb, vt)
-                log.info(
-                    "%s: Step %d: Train accuracy = %.1f%%  Cross entropy = %f  "
-                    "Validation accuracy = %.1f%%",
-                    time.strftime("%Y-%m-%d %H:%M:%S"), step,
-                    train_acc * 100, train_ce, val_acc * 100,
+        with resilience.PreemptionGuard() as guard:
+            while step < cfg.training_steps:
+                bottlenecks, truths, _ = self._sample(train_bs, "training")
+                # Fault site ``nonfinite_grad:step=N`` — exercise the guard.
+                if faults.fire_step("nonfinite_grad", [step]):
+                    bottlenecks = np.full_like(bottlenecks, np.nan)
+                batch = dp.shard_global_batch(
+                    {"image": bottlenecks, "label": truths}, self.mesh
                 )
-                if self.train_writer:
-                    self.train_writer.add_scalars(
-                        {"accuracy": train_acc, "cross_entropy": train_ce}, step
+                # Base key only — the per-step fold happens on-device in the jitted
+                # step, keyed on global_step.
+                self.params, self.opt_state, self.global_step, metrics = self.train_step(
+                    self.params, self.opt_state, self.global_step, batch, self.step_rng
+                )
+                skipped = metrics.get("skipped_nonfinite")
+                if skipped is not None:
+                    self._window_skips.append(skipped)
+                step += 1
+                is_last = step == cfg.training_steps
+                at_boundary = step % cfg.eval_step_interval == 0 or is_last
+                if faults.fire_step("preempt", [step]):
+                    guard.request()
+                if guard.should_exit(at_boundary):
+                    log.warning(
+                        "preemption at step %d — emergency checkpoint, then "
+                        "clean stop", step,
                     )
-                    self.val_writer.add_scalars(
-                        {"accuracy": val_acc, "cross_entropy": val_ce}, step
+                    self._maybe_save(step, force=True)
+                    break
+                window_skipped = 0
+                if at_boundary:
+                    parts, self._window_skips = self._window_skips, []
+                    window_skipped = int(round(sum(
+                        float(jax.device_get(x)) for x in parts
+                    )))
+                    self.total_skipped += window_skipped
+                    if window_skipped:
+                        self._bad_windows += 1
+                        log.warning(
+                            "eval window ending at step %d skipped %d "
+                            "non-finite step(s) (%d consecutive)",
+                            step, window_skipped, self._bad_windows,
+                        )
+                    else:
+                        self._bad_windows = 0
+                    if (
+                        window_skipped
+                        and getattr(cfg, "rollback_bad_windows", 0) > 0
+                        and self._bad_windows >= cfg.rollback_bad_windows
+                        and self.ckpt is not None
+                        and self.ckpt.latest_step() is not None
+                    ):
+                        from distributed_tensorflow_tpu.train.checkpoint import (
+                            restore_replicated,
+                        )
+
+                        restored = restore_replicated(
+                            self.ckpt, self._state_dict(), self.mesh
+                        )
+                        if restored is not None:
+                            rb_step, state = restored
+                            self.params = state["params"]
+                            self.opt_state = state["opt_state"]
+                            self.global_step = state["global_step"]
+                            self._bad_windows = 0
+                            log.warning(
+                                "rolled back head training to checkpoint "
+                                "step %d after %d bad window(s)",
+                                rb_step, cfg.rollback_bad_windows,
+                            )
+                            step = int(rb_step)
+                            continue
+                # Bad windows don't advance the checkpoint chain (rollback
+                # must land before the divergence started).
+                if not window_skipped:
+                    self._maybe_save(step, at_boundary=at_boundary)
+                if at_boundary:
+                    m = jax.device_get(metrics)
+                    train_acc, train_ce = float(m["accuracy"]), float(m["loss"])
+                    vb, vt, _ = self._sample(cfg.validation_batch_size, "validation")
+                    val_acc, val_ce = self._eval_batch(vb, vt)
+                    log.info(
+                        "%s: Step %d: Train accuracy = %.1f%%  Cross entropy = %f  "
+                        "Validation accuracy = %.1f%%",
+                        time.strftime("%Y-%m-%d %H:%M:%S"), step,
+                        train_acc * 100, train_ce, val_acc * 100,
                     )
+                    if self.train_writer:
+                        self.train_writer.add_scalars(
+                            {"accuracy": train_acc, "cross_entropy": train_ce}, step
+                        )
+                        self.val_writer.add_scalars(
+                            {"accuracy": val_acc, "cross_entropy": val_ce}, step
+                        )
         self._maybe_save(step, force=True)
         train_time = clock.elapsed
         log.info("Training time: %.2fs", train_time)

@@ -76,6 +76,27 @@ def _configure_tpu_vmem_budget() -> None:
     )
 
 
+def _cpu_cache_unsafe() -> bool:
+    """jax/jaxlib < 0.5 mis-executes DESERIALIZED XLA:CPU executables:
+    observed on 0.4.37 — a cache-hit resumed run computes NaN gradients on
+    every step after the first and eventually segfaults, while the identical
+    freshly-compiled program is bitwise correct (cache off → clean run).
+    The persistent cache is purely an optimization, so on those versions it
+    stays off for CPU-only runs; TPU/GPU keep the warm-cache speedups."""
+    import jax
+
+    try:
+        major, minor = (int(x) for x in jax.__version__.split(".")[:2])
+    except (ValueError, AttributeError):
+        return False
+    if (major, minor) >= (0, 5):
+        return False
+    platforms = str(getattr(jax.config, "jax_platforms", None) or "") or os.environ.get(
+        "JAX_PLATFORMS", ""
+    )
+    return platforms.strip().lower() == "cpu"
+
+
 def enable_compilation_cache(directory: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at ``directory`` (default
     ``~/.cache/distributed_tensorflow_tpu/xla``; env override above).
@@ -91,6 +112,8 @@ def enable_compilation_cache(directory: str | None = None) -> str | None:
     _configure_tpu_vmem_budget()
     env = os.environ.get("DTF_COMPILATION_CACHE")
     if env == "0":
+        return None
+    if _cpu_cache_unsafe():
         return None
     directory = env or directory or _DEFAULT
     import jax

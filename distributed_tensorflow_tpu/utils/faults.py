@@ -1,0 +1,134 @@
+"""Env-var-driven fault-injection registry (the test harness's chaos monkey).
+
+``DTT_FAULT=download:2,ckpt_save:1,nonfinite_grad:step=7`` arms sites by name:
+
+* ``site:N`` — the next N traversals of ``site`` fire (count-armed);
+* ``site:step=K`` — ``site`` fires exactly when the training loop passes
+  host step K (step-armed; repeat the entry to arm several steps);
+* ``site`` alone — shorthand for ``site:1``.
+
+Sites wired through the stack (each consumed exactly where the real failure
+would occur, so recovery paths are exercised end-to-end):
+
+* ``download``       — network fetch body in ``data/download.py`` (inside the
+                       retry loop, so backoff recovers it);
+* ``ckpt_save``      — Orbax write in ``train/checkpoint.py`` (inside retry);
+* ``ckpt_restore``   — Orbax read in ``train/checkpoint.py`` (inside retry,
+                       then the walk-back loop);
+* ``nonfinite_grad`` — step-armed: the training loop poisons that step's
+                       batch with NaN, driving the non-finite guard;
+* ``preempt``        — step-armed: the loop raises a synthetic preemption
+                       request at that step (same flag a real SIGTERM sets).
+
+The registry is process-local and loads from the env on first use, so
+multiprocess tests arm workers simply by exporting ``DTT_FAULT``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+ENV_VAR = "DTT_FAULT"
+
+
+class InjectedFault(OSError):
+    """Deliberately an OSError subclass: injected faults flow through the
+    same retry/except paths real transient I/O errors do."""
+
+
+@dataclass
+class _Site:
+    remaining: int = 0
+    steps: set[int] = field(default_factory=set)
+
+
+_lock = threading.Lock()
+_registry: dict[str, _Site] | None = None  # None = not yet loaded from env
+
+
+def parse_spec(spec: str) -> dict[str, _Site]:
+    """Parse the ``DTT_FAULT`` grammar; raises ValueError on malformed input
+    (a silently-ignored typo in a fault spec would fake a passing test)."""
+    sites: dict[str, _Site] = {}
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        name, _, arg = entry.partition(":")
+        name = name.strip()
+        if not name:
+            raise ValueError(f"bad {ENV_VAR} entry {entry!r}: empty site name")
+        site = sites.setdefault(name, _Site())
+        arg = arg.strip()
+        if not arg:
+            site.remaining += 1
+        elif arg.isdigit():
+            site.remaining += int(arg)
+        elif arg.startswith("step="):
+            site.steps.add(int(arg[len("step="):]))
+        else:
+            raise ValueError(
+                f"bad {ENV_VAR} entry {entry!r}: expected 'site', 'site:N' "
+                "or 'site:step=K'"
+            )
+    return sites
+
+
+def configure(spec: str | None) -> None:
+    """Install a spec programmatically (tests); ``None`` re-arms from the env
+    on next use."""
+    global _registry
+    with _lock:
+        _registry = None if spec is None else parse_spec(spec)
+
+
+def reset() -> None:
+    configure(None)
+
+
+def _sites() -> dict[str, _Site]:
+    global _registry
+    if _registry is None:
+        _registry = parse_spec(os.environ.get(ENV_VAR, ""))
+        if _registry:
+            log.warning("%s armed: %s", ENV_VAR, os.environ.get(ENV_VAR))
+    return _registry
+
+
+def fire(site: str) -> bool:
+    """Consume one count-armed shot of ``site``; True when it fires."""
+    with _lock:
+        s = _sites().get(site)
+        if s is None or s.remaining <= 0:
+            return False
+        s.remaining -= 1
+    log.warning("injected fault fired: %s", site)
+    return True
+
+
+def fire_step(site: str, steps: Iterable[int]) -> bool:
+    """Consume any step-armed shots of ``site`` within ``steps`` (a fused
+    dispatch spans a step range); True when at least one fires."""
+    with _lock:
+        s = _sites().get(site)
+        if s is None or not s.steps:
+            return False
+        hit = s.steps.intersection(steps)
+        if not hit:
+            return False
+        s.steps -= hit
+    log.warning("injected fault fired: %s at step(s) %s", site, sorted(hit))
+    return True
+
+
+def maybe_fail(site: str, detail: str = "") -> None:
+    """Raise :class:`InjectedFault` when ``site`` is count-armed."""
+    if fire(site):
+        raise InjectedFault(f"injected fault at {site}" + (f" ({detail})" if detail else ""))

@@ -1,0 +1,52 @@
+"""Version compatibility shims for the JAX API surface this package uses.
+
+The codebase targets the modern spelling ``jax.shard_map(..., check_vma=)``.
+Older jaxlibs (< 0.5) only ship ``jax.experimental.shard_map.shard_map`` with
+the ``check_rep=`` keyword; without a shim every train/eval step builder dies
+with ``AttributeError: module 'jax' has no attribute 'shard_map'`` on such
+environments. Installing the alias once at package import keeps every call
+site on the one modern spelling instead of scattering try/except fallbacks
+through ten modules.
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    """Idempotently provide the modern spellings this package calls."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        import inspect
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        has_check_vma = "check_vma" in inspect.signature(_shard_map).parameters
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+            if check_vma is not None:
+                # Same meaning, renamed: check_rep (old) -> check_vma (new).
+                kwargs["check_vma" if has_check_vma else "check_rep"] = check_vma
+            return _shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+            )
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.distributed, "is_initialized"):
+        # Added to jax.distributed in 0.5; older versions expose the client
+        # handle on the internal global state.
+        def is_initialized() -> bool:
+            from jax._src import distributed as _dist
+
+            return getattr(_dist.global_state, "client", None) is not None
+
+        jax.distributed.is_initialized = is_initialized
+
+    if not hasattr(jax.lax, "axis_size"):
+        # lax.axis_size(name) predates nothing semantically: the size of a
+        # mapped axis is psum(1) over it.
+        def axis_size(axis_name):
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size

@@ -1,0 +1,101 @@
+"""Retry with exponential backoff + jitter.
+
+The resilience layer's answer to transient I/O failure (ROADMAP north-star:
+flaky networks, preempted storage): the reference's ``maybe_download_and_extract``
+died on the first ``URLError`` and every Orbax save/restore was one-shot.
+Callers wrap just the failure-prone body (the socket read, the Orbax write) —
+never verification logic, whose failures are deterministic.
+
+Backoff: ``base_delay * 2**(attempt-1)`` capped at ``max_delay``, then scaled
+by a uniform jitter factor in ``[1-jitter, 1+jitter]`` so a fleet of workers
+retrying the same dead endpoint doesn't thundering-herd it in lockstep.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from functools import wraps
+from typing import Callable, Iterable, TypeVar
+
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+T = TypeVar("T")
+
+# OSError covers socket errors, timeouts, urllib.error.URLError, filesystem
+# errors, and utils.faults.InjectedFault — the transient-failure family.
+DEFAULT_RETRYABLE: tuple[type[BaseException], ...] = (OSError,)
+
+
+def backoff_delays(
+    attempts: int,
+    base_delay: float,
+    max_delay: float,
+    jitter: float,
+    rng: random.Random,
+) -> list[float]:
+    """The (attempts-1) sleep durations between attempts — exposed so tests
+    can assert the timing envelope without sleeping."""
+    out = []
+    for attempt in range(1, attempts):
+        delay = min(max_delay, base_delay * 2 ** (attempt - 1))
+        out.append(delay * (1.0 - jitter + 2.0 * jitter * rng.random()))
+    return out
+
+
+def retry_call(
+    fn: Callable[[], T],
+    *,
+    attempts: int = 3,
+    base_delay: float = 0.5,
+    max_delay: float = 30.0,
+    jitter: float = 0.25,
+    retryable: Iterable[type[BaseException]] = DEFAULT_RETRYABLE,
+    sleep: Callable[[float], None] = time.sleep,
+    rng: random.Random | None = None,
+    description: str = "",
+) -> T:
+    """Call ``fn()`` up to ``attempts`` times; re-raise the last error.
+
+    Only ``retryable`` exception types are retried — anything else (a sha256
+    mismatch, a template shape error) propagates immediately: deterministic
+    failures don't get better with patience.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    retryable = tuple(retryable)
+    rng = rng if rng is not None else random.Random()
+    delays = backoff_delays(attempts, base_delay, max_delay, jitter, rng)
+    what = description or getattr(fn, "__name__", "call")
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retryable as e:
+            if attempt == attempts:
+                log.warning("%s: attempt %d/%d failed (%s) — giving up",
+                            what, attempt, attempts, e)
+                raise
+            delay = delays[attempt - 1]
+            log.warning("%s: attempt %d/%d failed (%s) — retrying in %.2fs",
+                        what, attempt, attempts, e, delay)
+            sleep(delay)
+    raise AssertionError("unreachable")
+
+
+def retrying(**retry_kwargs):
+    """Decorator form of :func:`retry_call`."""
+
+    def deco(fn):
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(
+                lambda: fn(*args, **kwargs),
+                description=fn.__qualname__,
+                **retry_kwargs,
+            )
+
+        return wrapper
+
+    return deco
