@@ -1,4 +1,5 @@
-"""Benchmark harness — prints ONE JSON line for the driver.
+"""Benchmark harness — prints ONE compact JSON line for the driver and
+writes the full record (detail strings, diagnostics) to ``BENCH_LAST.json``.
 
 Headline metric (round-over-round comparable): MNIST convnet training
 steps/sec/chip at the reference workload shape (batch 100 per chip, the
@@ -38,9 +39,10 @@ dispatch amortization):
     r2/r3's grating metric, which saturated at 1.0 where it could not
     show a regression).
 
-Metrics named in ``FLOORS`` (value floors) and ``FRAC_FLOORS`` (efficiency
-floors on the ``frac`` fraction-of-ceiling field) are enforced: any stated
-floor violated (or a floored metric/field missing) exits nonzero after the
+Metrics named in ``FLOORS`` (value floors), ``FRAC_FLOORS`` (efficiency
+floors on the ``frac`` fraction-of-ceiling field) and ``FRAC_CEILS``
+(ceilings — the async-autosave stall ratchet) are enforced: any stated
+bound violated (or a gated metric/field missing) exits nonzero after the
 record prints, on TPU full (non-smoke) runs.
 
 ``vs_baseline`` context: the reference publishes no numbers
@@ -833,6 +835,30 @@ def bench_ckpt_403m() -> list[dict]:
         def spread(xs):
             return f"min/med {min(xs):.1f}/{med(xs):.1f} s over {len(xs)} reps"
 
+        # Zero-stall autosave: what the TRAINING THREAD actually pays for an
+        # async save — the on-device snapshot-copy dispatch + job enqueue
+        # (CheckpointManager.stall_seconds measures exactly that blocked
+        # time); the device->host fetch and the write run on the snapshot
+        # thread. The warmup save compiles the copy program so the measured
+        # rep reflects steady-state autosave cost. FRAC_CEILS ratchets
+        # frac = stall / median blocking save at <= 0.25.
+        tmp_async = tempfile.mkdtemp(prefix="bench_ckpt_async_")
+        try:
+            amngr = CheckpointManager(
+                tmp_async, save_interval_secs=0, max_to_keep=1, async_snapshot=True
+            )
+            amngr.save(1, state)  # warmup: copy-program compile + first write
+            amngr.wait_until_finished()
+            stall_base = amngr.stall_seconds
+            t0 = time.perf_counter()
+            amngr.save(2, state)
+            stall = amngr.stall_seconds - stall_base
+            amngr.wait_until_finished()
+            async_total = time.perf_counter() - t0
+            amngr.close()
+        finally:
+            shutil.rmtree(tmp_async, ignore_errors=True)
+
         tag = "403m" if not SMOKE else "smoke"
         out = [
             {
@@ -842,6 +868,17 @@ def bench_ckpt_403m() -> list[dict]:
                 "detail": f"Orbax save, {n_params/1e6:.0f}M params + Adam state "
                 f"({gb:.1f} GB f32), device->host via axon tunnel + local disk; "
                 + spread(saves),
+            },
+            {
+                "metric": f"ckpt_stall_seconds_{tag}",
+                "value": round(stall, 3),
+                "unit": "s",
+                "frac": round(stall / med(saves), 3) if med(saves) > 0 else None,
+                "detail": f"main-thread blocked time of an ASYNC autosave of the "
+                f"same {gb:.1f} GB tree (on-device snapshot copy dispatch + "
+                f"enqueue; background fetch/write took {async_total:.1f} s "
+                f"end-to-end); frac = stall / median blocking save, "
+                "ceiling 0.25 ENFORCED (bench.FRAC_CEILS)",
             },
             {
                 "metric": f"ckpt_restore_seconds_{tag}",
@@ -1131,9 +1168,18 @@ FRAC_FLOORS = {
     "flash_attention_8k_d128_fwd_bwd_kernel_only": 0.50,
 }
 
+# Efficiency CEILINGS on the ``frac`` field: the async-autosave stall must
+# stay a small fraction of the blocking save's wall-clock — the zero-stall
+# checkpoint pipeline's ratchet. frac here = main-thread stall / median
+# synchronous save; 0.25 trips long before the pipeline regresses back to
+# "the loop pays the whole device->host fetch" (frac ~1.0).
+FRAC_CEILS = {
+    "ckpt_stall_seconds_403m": 0.25,
+}
+
 
 def enforce_floors(metrics: list[dict]) -> list[str]:
-    """Return human-readable floor violations (empty = all floors hold)."""
+    """Return human-readable floor/ceiling violations (empty = all hold)."""
     by_name = {m.get("metric"): m for m in metrics}
     problems = []
     for name, floor in FLOORS.items():
@@ -1150,6 +1196,12 @@ def enforce_floors(metrics: list[dict]) -> list[str]:
             problems.append(f"{name}: MISSING frac (frac floor {floor})")
         elif m["frac"] < floor:
             problems.append(f"{name}: frac {m['frac']} < floor {floor}")
+    for name, ceil in FRAC_CEILS.items():
+        m = by_name.get(name)
+        if m is None or m.get("frac") is None:
+            problems.append(f"{name}: MISSING frac (frac ceiling {ceil})")
+        elif m["frac"] > ceil:
+            problems.append(f"{name}: frac {m['frac']} > ceiling {ceil}")
     return problems
 
 
@@ -1178,7 +1230,20 @@ def main() -> None:
             except Exception as e:  # one broken bench must not hide the rest
                 extra.append({"metric": f"{fn.__name__}_error", "error": str(e)[:300]})
     headline["extra_metrics"] = extra
-    print(json.dumps(headline))
+    # The FULL record (detail strings, diagnostics) goes to BENCH_LAST.json;
+    # stdout gets ONE COMPACT line the driver can parse — the r3-r5 records
+    # all came back "parsed": null because the detail-laden line was long
+    # enough to be truncated mid-JSON.
+    with open("BENCH_LAST.json", "w") as fh:
+        json.dump(headline, fh, indent=2)
+        fh.write("\n")
+    compact = {k: v for k, v in headline.items() if k != "extra_metrics"}
+    compact["extra_metrics"] = [
+        {k: v for k, v in m.items() if k in ("metric", "value", "unit", "frac", "error")}
+        for m in extra
+    ]
+    compact["record_file"] = "BENCH_LAST.json"
+    print(json.dumps(compact, separators=(",", ":")))
     # Floors describe the real-hardware record: off-TPU (e.g. a CPU-only
     # checkout running the full suite) lm_train_mfu is legitimately absent
     # (unknown chip peak), so only the driver's TPU runs enforce by default.

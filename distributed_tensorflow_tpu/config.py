@@ -113,6 +113,23 @@ class MnistTrainConfig:
         default=5,
         metadata={"help": "checkpoints retained by the autosave manager"},
     )
+    ckpt_async: int = field(
+        default=1,
+        metadata={
+            "help": "zero-stall autosave: the device->host snapshot fetch "
+            "and the disk write run on a background thread (forced/final "
+            "saves still block until durable); 0 restores the synchronous "
+            "fetch"
+        },
+    )
+    snapshot_chunk_mb: int = field(
+        default=64,
+        metadata={
+            "help": "chunk size of the double-buffered device->host "
+            "snapshot copy (chunk i+1's transfer overlaps chunk i's "
+            "materialization)"
+        },
+    )
     guard_nonfinite: int = field(
         default=1,
         metadata={
@@ -325,6 +342,20 @@ class RetrainConfig:
     max_to_keep: int = field(
         default=5,
         metadata={"help": "checkpoints retained when --train_dir is set"},
+    )
+    ckpt_async: int = field(
+        default=1,
+        metadata={
+            "help": "zero-stall autosave (background snapshot + write) when "
+            "--train_dir is set; 0 restores the synchronous fetch"
+        },
+    )
+    snapshot_chunk_mb: int = field(
+        default=64,
+        metadata={
+            "help": "chunk size of the double-buffered device->host "
+            "snapshot copy"
+        },
     )
     rollback_bad_windows: int = field(
         default=2,

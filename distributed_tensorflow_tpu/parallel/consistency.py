@@ -11,10 +11,86 @@ processes/replicas.
 from __future__ import annotations
 
 import hashlib
+import re
 from typing import Any
 
 import jax
 import numpy as np
+
+_COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e5m2": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _shape_list_bytes(shapes: str) -> int:
+    """Byte size of an HLO result-type string — a single shape
+    (``f32[128,64]{1,0}``) or a tuple (``(f32[10], f32[])``); unknown dtype
+    tokens (token, opaque) count zero."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(shapes):
+        itemsize = _HLO_DTYPE_BYTES.get(dt)
+        if itemsize is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * itemsize
+    return total
+
+
+def hlo_collective_bytes(hlo_text: str, ops=_COLLECTIVE_OPS) -> dict[str, int]:
+    """Payload bytes per collective kind in an optimized-HLO dump: for every
+    collective-op DEFINITION (the same right-before-operand-paren matcher as
+    the count guard in ``tests/test_collectives.py``), sum the byte size of
+    its result shape(s). Async ``-start`` forms carry an (operands, results)
+    pair in their tuple type, so their bytes are halved — one payload, not
+    two. Totals are INVARIANT to XLA's combiner (N per-leaf psums and one
+    combined tuple all-reduce move the same bytes), which makes bytes a
+    stabler cross-version guard than instruction counts."""
+    out: dict[str, int] = {}
+    for op in ops:
+        total = 0
+        for m in re.finditer(
+            rf"^\s*(?:ROOT )?%?\S+ = (.*?) ({op}(?:-start)?)\(", hlo_text, re.M
+        ):
+            shapes, tok = m.groups()
+            nbytes = _shape_list_bytes(shapes)
+            if tok.endswith("-start"):
+                nbytes //= 2
+            total += nbytes
+        out[op] = total
+    return out
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total leaf payload bytes of a pytree (shape × itemsize) — the
+    expected-bytes side of the collective payload check (e.g. a DP step's
+    gradient all-reduce moves exactly ``tree_bytes(params)`` plus its pmean'd
+    metric scalars)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = tuple(np.shape(leaf))
+        dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * dtype.itemsize
+    return total
 
 
 def param_fingerprint(params: Any) -> str:

@@ -80,6 +80,24 @@ def place_by_specs(tree: Any, mesh: Mesh, specs: Any) -> Any:
     return jax.tree_util.tree_map(place, tree, specs)
 
 
+@jax.jit
+def _copy_leaves(leaves):
+    return [jnp.copy(x) for x in leaves]
+
+
+def device_copy(leaves: list) -> list:
+    """Fresh on-device buffers for a list of ``jax.Array`` leaves — the
+    checkpoint snapshot stage's defensive copy. The copies are owned by the
+    snapshot alone, so a later train dispatch that DONATES the originals
+    (every MNIST-path step builder donates by default) can never invalidate
+    what the background device→host fetch reads. One asynchronous dispatch;
+    the cost is one transient extra copy of the tree in device memory — the
+    device half of the snapshot double buffer. Sharded inputs keep their
+    shardings (the copy is collective-free), so every process must call this
+    at the same program point in multi-process runs, like any jit."""
+    return _copy_leaves(leaves)
+
+
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Place a pytree fully-replicated over the mesh (params/opt state live in
     HBM once per device — the reference instead kept one copy on ps hosts and

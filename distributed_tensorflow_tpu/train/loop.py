@@ -173,6 +173,8 @@ class MnistTrainer:
             cfg.log_dir,
             save_interval_secs=cfg.save_model_secs,
             max_to_keep=getattr(cfg, "max_to_keep", 5),
+            async_snapshot=bool(getattr(cfg, "ckpt_async", 1)),
+            snapshot_chunk_mb=getattr(cfg, "snapshot_chunk_mb", 64),
         )
         self.writer = SummaryWriter(cfg.log_dir) if is_chief else None
 
@@ -298,6 +300,9 @@ class MnistTrainer:
             "steps": step,
             "seconds": train_time,
             "steps_per_sec": rate,
+            # Main-thread time blocked inside save paths (the zero-stall
+            # pipeline's own measure of what autosave cost the loop).
+            "ckpt_stall_seconds": round(self.ckpt.stall_seconds, 4),
         }
 
     def _run_training(self, step: int, num_steps: int, timer: StepTimer) -> None:
@@ -342,6 +347,10 @@ class MnistTrainer:
 
         self._bad_windows = 0
         self._window_skips = []
+        # A snapshot queued during the diverging window must not complete
+        # into the step we are rolling away from (restore itself drains
+        # whatever already reached the write stage).
+        self.ckpt.veto_pending()
         restored = restore_replicated(self.ckpt, self._state_dict(), self.mesh)
         if restored is None:
             return False
@@ -542,6 +551,10 @@ class MnistTrainer:
         if at_boundary and window_skipped:
             # Don't advance the checkpoint chain on a window that skipped
             # updates: rollback must land BEFORE the divergence started.
+            # That veto extends to any snapshot still queued from a timed
+            # save INSIDE this window (async saves capture state at enqueue
+            # time, but a bad window disqualifies the whole window).
+            self.ckpt.veto_pending()
             saved = False
         else:
             saved = self._maybe_save(step, at_eval_boundary=at_boundary)

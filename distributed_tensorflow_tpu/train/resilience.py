@@ -17,6 +17,12 @@ lost up to ``save_model_secs`` of work. Here preemption is first-class:
   consecutive eval windows containing skipped (non-finite) steps, the loop
   rolls back to the last good checkpoint instead of burning compute on a
   diverged run.
+* Async-save integration: the emergency checkpoint is a FORCED save, which
+  drains the in-flight background snapshot first (one durable, committed
+  artifact on exit); rollback's restore likewise drains-or-finalizes pending
+  saves, and bad eval windows veto queued snapshots
+  (``CheckpointManager.veto_pending``) so the chain never advances into the
+  divergence.
 
 Signal handlers only install in the main thread (Python restriction); off
 the main thread the guard degrades to poll-only (tests can still call

@@ -178,6 +178,8 @@ class RetrainTrainer:
                 cfg.train_dir,
                 save_interval_secs=cfg.save_model_secs,
                 max_to_keep=getattr(cfg, "max_to_keep", 5),
+                async_snapshot=bool(getattr(cfg, "ckpt_async", 1)),
+                snapshot_chunk_mb=getattr(cfg, "snapshot_chunk_mb", 64),
             )
             restored = restore_replicated(self.ckpt, self._state_dict(), self.mesh)
             if restored is not None:
@@ -347,6 +349,9 @@ class RetrainTrainer:
                             restore_replicated,
                         )
 
+                        # Rollback must land pre-divergence: cancel any
+                        # queued snapshot before draining into the restore.
+                        self.ckpt.veto_pending()
                         restored = restore_replicated(
                             self.ckpt, self._state_dict(), self.mesh
                         )
@@ -364,8 +369,12 @@ class RetrainTrainer:
                             step = int(rb_step)
                             continue
                 # Bad windows don't advance the checkpoint chain (rollback
-                # must land before the divergence started).
-                if not window_skipped:
+                # must land before the divergence started) — including any
+                # snapshot still queued from inside the window.
+                if window_skipped:
+                    if self.ckpt is not None:
+                        self.ckpt.veto_pending()
+                else:
                     self._maybe_save(step, at_boundary=at_boundary)
                 if at_boundary:
                     m = jax.device_get(metrics)

@@ -196,3 +196,108 @@ def test_ring_attention_uses_collective_permute():
     )
     assert counts["collective-permute"] >= 1, counts
     assert counts["all-gather"] == 0, counts
+
+
+# ---------------------------------------------------------------------------
+# Payload BYTES guards (VERDICT r5: "multi-chip asserts count collectives but
+# not bytes"). Bytes are summed over result shapes per collective DEFINITION
+# (parallel.consistency.hlo_collective_bytes) and are INVARIANT to XLA's op
+# combiner — N per-leaf psums and one combined tuple all-reduce move the same
+# payload — so these hold even on stacks where the count asserts above drift.
+# ---------------------------------------------------------------------------
+
+
+def test_hlo_collective_bytes_parser():
+    from distributed_tensorflow_tpu.parallel.consistency import (
+        hlo_collective_bytes,
+    )
+
+    hlo = "\n".join(
+        [
+            "ENTRY main {",
+            # plain result with layout annotation: 128*64*4 = 32768 bytes
+            "  %ar0 = f32[128,64]{1,0} all-reduce(f32[128,64] %p0), to_apply=%add",
+            # tuple result: 10*4 + 4 = 44 bytes
+            "  ROOT %ar1 = (f32[10], f32[]) all-reduce(f32[10] %a, f32[] %b)",
+            # async -start carries (operands, results): counted ONCE = 1024
+            "  %ag = (bf16[256]{0}, bf16[256]{0}) all-gather-start(bf16[256] %x)",
+            # operand mentions / done ops must NOT count
+            "  %agd = bf16[256]{0} all-gather-done((bf16[256], bf16[256]) %ag)",
+            "  %gte = f32[10] get-tuple-element((f32[10], f32[]) %ar1), index=0",
+            # scalar collective-permute: 4 bytes
+            "  %cp = f32[] collective-permute(f32[] %s), source_target_pairs={{0,1}}",
+            "}",
+        ]
+    )
+    bytes_found = hlo_collective_bytes(hlo)
+    assert bytes_found["all-reduce"] == 128 * 64 * 4 + 44, bytes_found
+    assert bytes_found["all-gather"] == 256 * 2, bytes_found
+    assert bytes_found["collective-permute"] == 4, bytes_found
+    assert bytes_found["reduce-scatter"] == 0 and bytes_found["all-to-all"] == 0
+
+
+def test_dp_step_all_reduce_payload_bytes():
+    """The DP step's whole communication payload is exactly the f32 gradient
+    tree (same shapes as params) plus the two pmean'd metric scalars — a
+    silent doubling of gradient traffic trips this even if the op count is
+    unchanged (and vice versa)."""
+    from distributed_tensorflow_tpu.parallel.consistency import (
+        hlo_collective_bytes,
+        tree_bytes,
+    )
+
+    mesh = make_mesh()
+    model = MnistCNN(compute_dtype=jnp.float32)
+    tx = optax.adam(1e-4)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 784), jnp.float32))[
+        "params"
+    ]
+    p = dp.replicate(params, mesh)
+    o = dp.replicate(tx.init(params), mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    batch = dp.shard_batch(
+        {
+            "image": np.zeros((16, 784), np.float32),
+            "label": np.eye(10, dtype=np.float32)[np.zeros(16, int)],
+        },
+        mesh,
+    )
+    step = dp.build_train_step(model.apply, tx, mesh, donate=False)
+    txt = step.lower(p, o, g, batch, jax.random.PRNGKey(0)).compile().as_text()
+    found = hlo_collective_bytes(txt)
+    assert found["all-reduce"] == tree_bytes(params) + 8, (
+        found, tree_bytes(params)
+    )
+    assert found["all-gather"] == 0 and found["reduce-scatter"] == 0
+
+
+def test_fsdp_all_gather_payload_bytes():
+    """ZeRO-3's param gather happens OUTSIDE value_and_grad (DESIGN §3), so
+    each padded leaf's bytes cross the wire exactly once per step: total
+    all-gather payload == the sharded param tree's bytes, independent of how
+    many ops XLA splits the gathers into. A 2x here means the gather moved
+    inside the grad computation and is being recomputed."""
+    from distributed_tensorflow_tpu.parallel.consistency import (
+        hlo_collective_bytes,
+        tree_bytes,
+    )
+
+    mesh = make_mesh()
+    cfg = _lm_cfg()
+    host = jax.device_get(
+        TransformerLM(cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+            "params"
+        ]
+    )
+    tx = optax.adam(1e-3)
+    step = fsdp.build_fsdp_lm_train_step(cfg, tx, mesh, host, donate=False)
+    fp = fsdp.shard_fsdp_params(host, mesh)
+    fo = fsdp.init_fsdp_opt_state(tx, host, mesh)
+    g = dp.replicate(jnp.zeros((), jnp.int32), mesh)
+    toks = jax.device_put(
+        jnp.zeros((16, 16), jnp.int32),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(("data", "model"), None)),
+    )
+    txt = step.lower(fp, fo, g, toks, jax.random.PRNGKey(0)).compile().as_text()
+    found = hlo_collective_bytes(txt)
+    assert found["all-gather"] == tree_bytes(fp), (found, tree_bytes(fp))

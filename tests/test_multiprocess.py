@@ -63,6 +63,20 @@ def test_two_process_group(tmp_path):
     assert (tmp_path / "chief.txt").read_text() == "ok"
 
 
+def test_two_process_async_autosave_deferred_finalize(tmp_path):
+    """Zero-stall checkpointing acceptance: a 2-process run performs timed
+    autosaves issued ASYNC (non-``wait=True``) — per-process sharded shard
+    writes on background threads, collective COMMIT deferred to the next
+    eval boundary on the main thread — and completes without deadlocking
+    against the gate broadcast (the interleaving that previously forced
+    multi-process saves fully synchronous). The mid-run step must be
+    committed, and a relaunch must restore from the final save."""
+    log_dir = str(tmp_path / "logs")
+    outs = _run_workers("mp_async_ckpt_worker.py", log_dir, "ASYNC_CKPT_WORKER_{i}_OK")
+    for i in range(2):
+        assert "restored checkpoint at step 8" in outs[i], outs[i]
+
+
 def test_demo2_two_process_end_to_end(tmp_path):
     """The full demo2 workload over two real processes (fused steps_per_call
     path): training runs, params stay bitwise-consistent across processes

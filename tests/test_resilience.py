@@ -305,6 +305,48 @@ def test_restore_returns_none_when_every_step_corrupt(tmp_path):
     mngr.close()
 
 
+@pytest.mark.fault
+def test_async_save_retries_injected_fault_on_background_thread(tmp_path):
+    """DTT_FAULT=ckpt_save:1 must still be recovered when the write happens
+    on the snapshot worker thread (the async path), not just the blocking
+    one."""
+    from distributed_tensorflow_tpu.train.checkpoint import CheckpointManager
+
+    mngr = CheckpointManager(str(tmp_path / "ck"), save_interval_secs=0)
+    faults.configure("ckpt_save:1")
+    state = {"w": np.arange(4.0, dtype=np.float32)}
+    assert mngr.save(7, state)  # async: accepted without blocking
+    mngr.wait_until_finished()
+    assert mngr.latest_step() == 7
+    assert not faults.fire("ckpt_save")  # the one shot was consumed + retried
+    step, restored = mngr.restore_latest(state)
+    assert step == 7
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    mngr.close()
+
+
+def test_timed_gate_skips_without_blocking_when_save_in_flight(tmp_path):
+    """The head-of-line fix: a timed gate firing while the previous save is
+    still in flight skips with a warning instead of stalling the caller for
+    the previous write (old behavior: unconditional wait_until_finished)."""
+    import time as _time
+
+    from distributed_tensorflow_tpu.train.checkpoint import CheckpointManager
+
+    mngr = CheckpointManager(str(tmp_path / "ck"), save_interval_secs=0)
+    mngr._hold_next_snapshot = True  # park save 1 in flight
+    state = {"w": np.arange(8.0, dtype=np.float32)}
+    assert mngr.maybe_save(1, state)
+    t0 = _time.perf_counter()
+    assert not mngr.maybe_save(2, state)  # gate fires again: skip, don't block
+    assert _time.perf_counter() - t0 < 2.0
+    for j in mngr._jobs:  # release the parked snapshot
+        j.held = False
+    mngr.wait_until_finished()
+    assert mngr.latest_step() == 1  # save 1 completed; save 2 was skipped
+    mngr.close()
+
+
 def test_max_to_keep_plumbed_from_config(tmp_path, monkeypatch):
     """MnistTrainConfig.max_to_keep reaches the CheckpointManager."""
     from distributed_tensorflow_tpu.config import MnistTrainConfig, RetrainConfig
@@ -317,9 +359,9 @@ def test_max_to_keep_plumbed_from_config(tmp_path, monkeypatch):
     real = ckpt_mod.CheckpointManager
 
     class Spy(real):
-        def __init__(self, directory, save_interval_secs=600.0, max_to_keep=5):
+        def __init__(self, directory, save_interval_secs=600.0, max_to_keep=5, **kw):
             seen["max_to_keep"] = max_to_keep
-            super().__init__(directory, save_interval_secs, max_to_keep)
+            super().__init__(directory, save_interval_secs, max_to_keep, **kw)
 
     import distributed_tensorflow_tpu.train.loop as loop_mod
 
@@ -566,6 +608,48 @@ def test_preemption_emergency_save_and_resume(tmp_path, resil_data):
     assert int(jax.device_get(t2.global_step)) == 5  # resumed, not restarted
     stats2 = t2.train()
     assert stats2["steps"] == 10
+
+
+@pytest.mark.fault
+def test_rollback_vetoes_queued_snapshot(tmp_path, resil_data):
+    """A snapshot queued by a timed save INSIDE a diverging window must not
+    advance the checkpoint chain: the bad-window veto cancels it, and the
+    rollback restores the pre-divergence step."""
+    kw = dict(eval_step_interval=3, rollback_bad_windows=2)
+    a = _make_trainer(_trainer_cfg(tmp_path, training_steps=3, **kw), resil_data)
+    a.train()
+    assert a.ckpt.latest_step() == 3  # the good checkpoint
+    faults.configure("nonfinite_grad:step=4,nonfinite_grad:step=7")
+    b = _make_trainer(_trainer_cfg(tmp_path, training_steps=12, **kw), resil_data)
+    b.ckpt._hold_next_snapshot = True  # keep the queued snapshot cancellable
+    b.ckpt._last_save = 0.0  # the timed gate fires at step 4 — mid bad window
+    stats = b.train()
+    assert stats["steps"] == 12
+    assert b._rollbacks == 1
+    # The held step-4 snapshot was vetoed at the bad boundary: the chain
+    # never advanced past the good step, so rollback restored step 3 and
+    # only the final forced save added a step.
+    assert b.ckpt.all_steps() == [3, 12]
+
+
+@pytest.mark.fault
+def test_preemption_drains_inflight_snapshot_single_durable(tmp_path, resil_data):
+    """Preemption while async autosaves are in flight: the emergency save
+    drains the background snapshot and leaves exactly one durable, readable
+    latest checkpoint at the stop step."""
+    faults.configure("preempt:step=5")
+    cfg = _trainer_cfg(
+        tmp_path, training_steps=10, eval_step_interval=5,
+        save_model_secs=0,  # timed gate fires every step: async saves in flight
+    )
+    t1 = _make_trainer(cfg, resil_data)
+    stats = t1.train()
+    assert stats["steps"] == 5
+    assert t1.ckpt.latest_step() == 5  # the emergency save, durable
+    step, restored = t1.ckpt.restore_latest(t1._state_dict())
+    assert step == 5
+    assert int(np.asarray(restored["global_step"])) == 5
+    assert stats["ckpt_stall_seconds"] >= 0.0  # stall accounting is plumbed
 
 
 def test_sigterm_sets_preemption_flag():
