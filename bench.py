@@ -567,6 +567,155 @@ def bench_lm_decode() -> list[dict]:
     return out
 
 
+def bench_serving() -> list[dict]:
+    """Continuous batching (serve/SlotEngine + FCFS scheduler) vs the
+    sequential status quo (one request at a time through ONE reused jitted
+    ``build_generate_fn``) on the SAME transformer — the Orca claim as a
+    ratchet. Greedy on both sides (apples-to-apples: temperature=0
+    sequential pays no sampling sorts, and neither does the engine's
+    greedy fast path). Decode must be weight-read bound for slot-batching
+    to pay (each batched step reads params once for ``slots`` tokens), so
+    the smoke model is sized past LLC (~55 MB f32) rather than tiny, and
+    the TPU run uses the ~100M-param decode-bench shape. Also reports p99
+    TTFT under the closed-loop burst (all requests submitted at t0 — tail
+    TTFT includes queue wait behind earlier waves, the honest serving
+    number) and the engine's post-warmup recompile count (must be 0)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_tpu.models.decoding import build_generate_fn
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from distributed_tensorflow_tpu.serve import (
+        Request,
+        Scheduler,
+        ServingMetrics,
+        SlotEngine,
+    )
+
+    if SMOKE:
+        dm, h, nl, dff, vocab = 512, 8, 4, 2048, 1024
+        P, n_new, n_req, slots = 16, 16, 8, 4
+        # One steps_per_sync: CPU dispatch is cheap and stable.
+        sync_candidates = (8,)
+        dtype = jnp.float32
+    else:
+        if jax.default_backend() != "tpu":
+            return []
+        # The mid-size decode-bench shape (~100M params): decisively
+        # weight-read bound at B=1, so slot-batching has physics headroom.
+        dm, h, nl, dff, vocab = 1024, 8, 8, 4096, 256
+        P, n_new, n_req, slots = 128, 256, 16, 8
+        # Per-dispatch tunnel latency swings 2.5-95 ms; steps_per_sync is
+        # the serving config that amortizes it, so the bench picks the best
+        # of two honest configs rather than hard-coding one tunnel regime.
+        sync_candidates = (32, 128)
+        dtype = jnp.bfloat16
+
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=dm, num_heads=h, num_layers=nl, d_ff=dff,
+        max_seq_len=P + n_new, compute_dtype=dtype,
+    )
+    model = TransformerLM(cfg)
+    params = jax.jit(
+        lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0))
+    prompts = np.random.default_rng(0).integers(
+        0, vocab, (n_req, P), dtype=np.int64
+    ).astype(np.int32)
+
+    # Both sides take the best of `repeats` identical passes: on a shared
+    # CPU box a noisy-neighbor burst can halve one pass's throughput, and
+    # min-time is the standard estimator for "what the code costs" under
+    # additive noise. TPU runs are dedicated; one pass is stable there.
+    repeats = 3 if SMOKE else 1
+
+    # Sequential baseline: the pre-serving API exactly as tools/generate.py
+    # drives it — one compiled program, requests one after another.
+    gen = build_generate_fn(cfg, n_new)
+    key = jax.random.PRNGKey(0)
+    _drain(gen(params, jnp.asarray(prompts[:1]), key)[0, -1])  # compile
+    seq_s = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            _drain(gen(params, jnp.asarray(prompts[i:i + 1]), key)[0, -1])
+        seq_s = min(seq_s, time.perf_counter() - t0)
+    seq_tok_s = n_req * n_new / seq_s
+
+    best = None
+    for k_sync in sync_candidates:
+        engine = SlotEngine(
+            cfg, params, slots=slots, max_len=P + n_new, prefill_len=P,
+            steps_per_sync=k_sync,
+        )
+        compiled = engine.warmup()
+        point = None
+        for _ in range(repeats):
+            metrics = ServingMetrics()
+            sched = Scheduler(engine, max_queue_depth=n_req + 1,
+                              metrics=metrics)
+            pendings = [
+                sched.submit(Request(prompt=tuple(prompts[i]),
+                                     max_new_tokens=n_new))
+                for i in range(n_req)
+            ]
+            t0 = time.perf_counter()
+            done = sched.run_until_idle(max_steps=n_req * n_new + 16)
+            wall_s = time.perf_counter() - t0
+            assert done == n_req and all(p.done() for p in pendings)
+            attempt = {
+                "tok_s": n_req * n_new / wall_s,
+                "k_sync": k_sync,
+                "ttft_p99_ms": metrics.ttft.percentile(99) * 1e3,
+                "recompiles": engine.compile_count() - compiled,
+            }
+            if point is None or attempt["tok_s"] > point["tok_s"]:
+                point = attempt
+        if best is None or point["tok_s"] > best["tok_s"]:
+            best = point
+
+    speedup = best["tok_s"] / seq_tok_s
+    shape_note = (
+        f"{dm}d/{nl}L vocab {vocab}, prompt {P} + {n_new} new x {n_req} "
+        f"req, {slots} slots, steps_per_sync {best['k_sync']}, greedy"
+    )
+    return [
+        {
+            "metric": "serve_throughput_tok_s",
+            "value": round(best["tok_s"], 0),
+            "unit": "tokens/s",
+            "detail": (
+                f"continuous batching, {shape_note}; sequential "
+                f"build_generate_fn baseline {seq_tok_s:,.0f} tok/s; "
+                f"{best['recompiles']} recompiles after warmup"
+            ),
+        },
+        {
+            "metric": "serve_p99_ttft_ms",
+            "value": round(best["ttft_p99_ms"], 2),
+            "unit": "ms",
+            "detail": (
+                f"closed-loop burst (all {n_req} submitted at t0; tail "
+                f"waits behind {n_req - slots} queued), {shape_note}"
+            ),
+        },
+        {
+            "metric": "serve_speedup_vs_sequential",
+            "value": round(speedup, 2),
+            "unit": "x",
+            "detail": (
+                f"engine {best['tok_s']:,.0f} vs sequential "
+                f"{seq_tok_s:,.0f} tok/s, {shape_note}; >= 2.0 ENFORCED "
+                "(bench.FLOORS)"
+            ),
+        },
+    ]
+
+
 def bench_flash_kernel() -> list[dict]:
     """Flash attention at the round-1-comparable 8k shape (D=64) and the
     MXU-native D=128 shape, two timing modes per shape:
@@ -1149,6 +1298,14 @@ FLOORS = {
     # the same ~4-point margin as lm_train_mfu and trips well before the
     # outside rotation's 0.607.
     "lm_train_mfu_rope": 0.72,
+    # The serving subsystem's reason to exist: continuous batching must
+    # beat serving the same requests one at a time through sequential
+    # build_generate_fn by >= 2x on the same transformer (ISSUE 4
+    # acceptance; smoke measures 2.3-2.5x at 4 slots on CPU, the physics
+    # ceiling is ~slots x at the weight-read bound). A regression to ~1x
+    # means the engine re-serialized (lost the slot batch) or recompiles
+    # per request (lost the fixed shapes).
+    "serve_speedup_vs_sequential": 2.0,
 }
 
 # Efficiency floors on the ``frac`` field (fraction of the metric's own
@@ -1218,6 +1375,7 @@ def main() -> None:
         for fn in (
             bench_lm_mfu,
             bench_lm_decode,
+            bench_serving,
             bench_flash_kernel,
             bench_mnist_real_accuracy,
             bench_mnist_accuracy,

@@ -373,3 +373,51 @@ class DistributedRetrainConfig(RetrainConfig):
     (``retrain2/retrain2.py:551``)."""
 
     training_steps: int = 2000
+
+
+@dataclass
+class ServeConfig:
+    """Continuous-batching inference server (``serve/``, ``tools/serve_lm.py``).
+
+    Beyond-reference: the source demos never serve. Defaults target the
+    small-LM CPU/TPU demo path; production knobs are the slot count (batch
+    capacity — more slots amortize weight reads until the KV read bound),
+    ``steps_per_sync`` (decode micro-steps fused per host round-trip —
+    raise on TPU where per-dispatch latency dominates small models), and
+    the admission pair ``max_queue_depth``/``request_timeout_s``."""
+
+    host: str = field(default="127.0.0.1", metadata={"help": "bind address"})
+    port: int = field(default=8000, metadata={"help": "bind port; 0 = ephemeral"})
+    slots: int = field(
+        default=4, metadata={"help": "concurrent request capacity (batch lanes)"}
+    )
+    serve_max_len: int = field(
+        default=0,
+        metadata={"help": "per-slot KV capacity; 0 = model max_seq_len"},
+    )
+    prefill_len: int = field(
+        default=0,
+        metadata={"help": "padded prompt capacity; 0 = serve_max_len // 2"},
+    )
+    steps_per_sync: int = field(
+        default=1,
+        metadata={
+            "help": "decode micro-steps per jitted engine round (amortizes "
+            "host dispatch; tokens are delivered in bursts of this size)"
+        },
+    )
+    max_queue_depth: int = field(
+        default=64,
+        metadata={"help": "queued requests beyond which submits shed (429)"},
+    )
+    request_timeout_s: float = field(
+        default=60.0,
+        metadata={"help": "HTTP handler wait before a 503 timeout answer"},
+    )
+    serve_log_dir: str = field(
+        default="",
+        metadata={"help": "if set, publish serving metrics to TB events here"},
+    )
+    metrics_interval_s: float = field(
+        default=10.0, metadata={"help": "TB publish period"}
+    )

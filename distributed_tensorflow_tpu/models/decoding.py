@@ -18,7 +18,13 @@ import jax.numpy as jnp
 
 from distributed_tensorflow_tpu.models.transformer import TransformerConfig, TransformerLM
 
-__all__ = ["init_cache", "build_generate_fn", "sample_logits"]
+__all__ = [
+    "init_cache",
+    "build_generate_fn",
+    "decode_step",
+    "sample_logits",
+    "sample_logits_batched",
+]
 
 _NEG_INF = -1e30  # matches ops.attention.NEG_INF: masked, not NaN-prone
 
@@ -57,6 +63,50 @@ def sample_logits(logits, key, temperature: float = 0.0,
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+def sample_logits_batched(logits, keys, temperature, top_k, top_p):
+    """Traced per-row sampling: ``(B, V) logits → (B,) int32 tokens`` with
+    PER-ROW sampling params — the serving engine's slot-batched counterpart
+    of :func:`sample_logits` (whose params are Python scalars resolved at
+    trace time, so one compiled program serves one sampling config).
+
+    ``temperature`` (B,) f32 — rows ``<= 0`` are greedy argmax. ``top_k``
+    (B,) int32 — rows ``< 1`` (or ``>= V``) disable the filter. ``top_p``
+    (B,) f32 — rows outside ``(0, 1]`` disable the filter. ``keys`` is a
+    (B,) batch of PRNG keys (one independent stream per row, so slots
+    sharing a step draw from unrelated streams). Filter semantics match
+    :func:`sample_logits` filter-for-filter (temper, then top-k, then
+    nucleus on the post-top-k distribution), so a single busy slot in the
+    serving engine reproduces ``tools/generate.py``; everything is sorts
+    and wheres — no data-dependent shapes, so the whole thing jits into
+    the engine's fixed decode step."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+    temperature = temperature.astype(jnp.float32)
+    safe_t = jnp.where(temperature > 0.0, temperature, 1.0)
+    l = logits.astype(jnp.float32) / safe_t[:, None]
+    # top-k as a rank cutoff on the descending sort (mirrors sample_logits'
+    # lax.top_k kth-value threshold; disabled rows use k = V, a no-op).
+    desc = jnp.sort(l, axis=-1)[:, ::-1]
+    k_eff = jnp.clip(jnp.where(top_k >= 1, top_k, v), 1, v)
+    kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=-1)
+    l = jnp.where(l < kth, _NEG_INF, l)
+    # Nucleus on the post-top-k logits: smallest descending prefix whose
+    # EXCLUSIVE cumulative mass is < p (first token always survives).
+    # Disabled rows use p = 1.0: filtered-out entries carry ~zero mass, so
+    # the kept prefix covers every surviving token — no further filtering.
+    p_eff = jnp.where((top_p > 0.0) & (top_p <= 1.0), top_p, 1.0).astype(
+        jnp.float32
+    )
+    desc = jnp.sort(l, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    n_keep = jnp.sum((cum - probs) < p_eff[:, None], axis=-1, keepdims=True)
+    thresh = jnp.take_along_axis(desc, n_keep - 1, axis=-1)
+    l = jnp.where(l < thresh, _NEG_INF, l)
+    sampled = jax.vmap(jax.random.categorical)(keys, l).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
     """Static-shape per-layer KV buffers + one shared filled-prefix length.
     Under GQA the buffers hold the UNEXPANDED ``kv_heads`` — the cache (and
@@ -87,6 +137,17 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
     }
 
 
+def decode_step(model: TransformerLM, params, cache, tok):
+    """One cached decode step: ``tok (B, s) int32 → (cache', last-position
+    logits (B, V))``. Positions come from the cache's filled length inside
+    the model. Factored out of :func:`build_generate_fn`'s token loop so the
+    serving engine (``serve/engine.py``) drives the SAME per-token program —
+    per-slot under ``jax.vmap``, where the cache's ``len`` becomes a
+    per-slot traced scalar and the K/V appends become per-slot scatters."""
+    logits, cache = model.apply({"params": params}, tok, cache=cache)
+    return cache, logits[:, -1]
+
+
 def build_generate_fn(
     cfg: TransformerConfig,
     max_new_tokens: int,
@@ -109,12 +170,6 @@ def build_generate_fn(
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     model = TransformerLM(cfg)
-
-    def one_token(params, cache, tok):
-        """tok (B, 1) → (cache', last-position logits (B, V)). Positions come
-        from the cache's filled length inside the model."""
-        logits, cache = model.apply({"params": params}, tok, cache=cache)
-        return cache, logits[:, -1]
 
     def generate(params, prompt, rng):
         b, p = prompt.shape
@@ -154,7 +209,7 @@ def build_generate_fn(
         def dec(carry, key):
             cache, logits = carry
             tok = sample(logits, key)
-            cache, logits = one_token(params, cache, tok[:, None])
+            cache, logits = decode_step(model, params, cache, tok[:, None])
             return (cache, logits), tok
 
         # The final token needs no forward pass — sample it from the last

@@ -1,0 +1,360 @@
+"""Continuous-batching decode engine: fixed shapes, zero recompiles.
+
+Two jitted programs serve every request mix after warmup:
+
+* **prefill** — one batched causal forward of a PADDED ``(1, prefill_len)``
+  prompt into a fresh ``(1, max_len)`` cache (``models/decoding.init_cache``),
+  then the request's FIRST token sampled at its true last prompt position.
+  Padding keeps the shape fixed across heterogeneous prompt lengths; the
+  junk K/V the pad positions write is never readable (see the overwrite
+  invariant below). The resulting cache is scattered into the request's
+  pool slot in place (``kv_pool.adopt``).
+
+* **decode step** — ``steps_per_sync`` micro-steps over the WHOLE slot
+  batch fused into one ``lax.scan`` program. Each micro-step runs the
+  per-token program factored out of ``build_generate_fn``
+  (``models/decoding.decode_step``) per slot under ``jax.vmap``: the
+  cache's ``len`` becomes a per-slot traced scalar, so every slot appends
+  at ITS OWN filled length (the K/V writes lower to per-slot scatters) and
+  rotates/embeds at its own positions. Sampling is per-slot too
+  (``sample_logits_batched``: traced temperature/top-k/top-p, one PRNG
+  stream per slot). Inactive slots are masked — they burn a lane of
+  compute to keep the shape fixed, which is exactly the trade that makes
+  the program compile once.
+
+Correctness invariant for slot reuse (why freed slots are not zeroed and
+pad junk is harmless): after prefill the filled length is the TRUE prompt
+length ``p``, and a decode step at length ``len`` writes position ``len``
+BEFORE attending keys ``0..len`` (the cache append precedes the score
+einsum in ``attention_sublayer``). By induction every attended key was
+written by this request — stale rows from a previous tenant or from pad
+positions sit strictly above the filled length until the step that
+overwrites them. ``tests/test_serve_engine.py::test_slot_reuse_isolation``
+pins this.
+
+Host/device split: the big pool buffers live on device and are DONATED
+through both programs (in-place turnover); the per-slot registers
+(lengths, current token, sampling params, budgets) are small host numpy
+arrays passed in each call — the host is the scheduler's view, the device
+never holds control state the host also needs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.models.decoding import (
+    decode_step,
+    init_cache,
+    sample_logits_batched,
+)
+from distributed_tensorflow_tpu.models.transformer import TransformerLM
+from distributed_tensorflow_tpu.serve.kv_pool import SlotKVPool
+
+__all__ = ["SlotEngine"]
+
+
+class SlotEngine:
+    """Fixed-capacity continuous-batching engine over one model replica.
+
+    Drive it with :class:`~distributed_tensorflow_tpu.serve.scheduler.
+    Scheduler` (request queue + admission control) or directly:
+    ``acquire_slot`` → ``start`` (prefill, returns the first token) →
+    repeated ``step`` (one ``steps_per_sync``-token batch round) →
+    ``release``. Single-threaded by contract: one thread owns the engine.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        *,
+        slots: int = 4,
+        max_len: int | None = None,
+        prefill_len: int | None = None,
+        steps_per_sync: int = 1,
+    ):
+        max_len = int(max_len or cfg.max_seq_len)
+        prefill_len = int(prefill_len or max(1, max_len // 2))
+        if max_len > cfg.max_seq_len:
+            raise ValueError(
+                f"max_len {max_len} > model max_seq_len {cfg.max_seq_len}"
+            )
+        if not 1 <= prefill_len <= max_len:
+            raise ValueError(
+                f"prefill_len {prefill_len} outside [1, max_len {max_len}]"
+            )
+        if steps_per_sync < 1:
+            raise ValueError(f"steps_per_sync must be >= 1, got {steps_per_sync}")
+        self.cfg = cfg
+        self.params = params
+        self.model = TransformerLM(cfg)
+        self.slots = int(slots)
+        self.max_len = max_len
+        self.prefill_len = prefill_len
+        self.steps_per_sync = int(steps_per_sync)
+        self.pool = SlotKVPool(cfg, self.slots, max_len)
+
+        # Per-slot host registers. Fixed dtypes — the jit signatures (and
+        # therefore the zero-recompile guarantee) depend on them.
+        n = self.slots
+        self.active = np.zeros(n, bool)
+        self.lengths = np.zeros(n, np.int32)  # filled cache prefix per slot
+        self.cur_tok = np.zeros(n, np.int32)  # last sampled, next to feed
+        self.temp = np.zeros(n, np.float32)
+        self.top_k = np.zeros(n, np.int32)
+        self.top_p = np.zeros(n, np.float32)
+        self.seed = np.zeros(n, np.uint32)
+        self.made = np.zeros(n, np.int32)  # tokens generated so far
+        self.budget = np.ones(n, np.int32)  # max_new_tokens per slot
+        self.eos = np.full(n, -1, np.int32)  # -1 = no eos stop
+
+        model, k_sync = self.model, self.steps_per_sync
+
+        def make_prefill(sampled: bool):
+            def prefill_fn(params, tokens, length, temp, top_k, top_p, seed):
+                """(1, prefill_len) padded prompt → (fresh (1, max_len) cache
+                layers, first sampled token). ``length`` is the true prompt
+                length (traced — heterogeneous prompts share the compile)."""
+                cache = init_cache(cfg, 1, max_len)
+                logits, cache = model.apply(
+                    {"params": params}, tokens, cache=cache
+                )
+                last = jnp.take(logits[0], length - 1, axis=0)  # (V,)
+                if sampled:
+                    key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
+                    first = sample_logits_batched(
+                        last[None], key[None], temp[None], top_k[None],
+                        top_p[None],
+                    )[0]
+                else:
+                    first = jnp.argmax(last).astype(jnp.int32)
+                return cache["layers"], first
+
+            return prefill_fn
+
+        def make_step(sampled: bool):
+            def step_fn(
+                params, layers, active, lengths, tok,
+                temp, top_k, top_p, seed, made, budget, eos,
+            ):
+                """One engine round = ``steps_per_sync`` scanned micro-steps.
+                Returns the new pool/registers plus ``(k, slots)`` sampled
+                tokens and their validity mask (a slot's tokens are valid
+                while it was active at sampling time — the final token of a
+                finishing slot is valid, the masked lanes after it are
+                not)."""
+
+                def one(slot_layers, length, t):
+                    cache = {
+                        "layers": [
+                            {k: v[None] for k, v in l.items()}
+                            for l in slot_layers
+                        ],
+                        "len": length,
+                    }
+                    cache, logits = decode_step(
+                        model, params, cache, t[None, None]
+                    )
+                    out_layers = [
+                        {k: v[0] for k, v in l.items()} for l in cache["layers"]
+                    ]
+                    return out_layers, logits[0]
+
+                def micro(carry, _):
+                    layers, active, lengths, tok, made = carry
+                    layers, logits = jax.vmap(one)(layers, lengths, tok)
+                    if sampled:
+                        keys = jax.vmap(
+                            lambda s, m: jax.random.fold_in(
+                                jax.random.PRNGKey(s), m
+                            )
+                        )(seed, made)
+                        nxt = sample_logits_batched(
+                            logits, keys, temp, top_k, top_p
+                        )
+                    else:
+                        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                    nxt = jnp.where(active, nxt, tok)
+                    new_lengths = jnp.where(active, lengths + 1, lengths)
+                    new_made = jnp.where(active, made + 1, made)
+                    finished = active & ((new_made >= budget) | (nxt == eos))
+                    return (
+                        (layers, active & ~finished, new_lengths, nxt,
+                         new_made),
+                        (nxt, active),
+                    )
+
+                carry, (toks, valid) = jax.lax.scan(
+                    micro, (layers, active, lengths, tok, made), None,
+                    length=k_sync,
+                )
+                layers, active, lengths, tok, made = carry
+                return layers, active, lengths, tok, made, toks, valid
+
+            return step_fn
+
+        # Two compiled variants of each program, host-selected per call:
+        # per-row top-k/top-p needs two full-vocab XLA sorts per micro-step
+        # (per-row cutoffs defeat lax.top_k's static k), and on CPU those
+        # sorts cost more than the whole d512 argmax step — an all-greedy
+        # round (THE common serving mix, and what the bench's sequential
+        # baseline pays: sample_logits with temperature=0 is pure argmax)
+        # must not pay them. Still a fixed program set: warmup compiles all
+        # four, and the compile-count assert covers the lot.
+        self._prefill_greedy = jax.jit(make_prefill(False))
+        self._prefill_sampled = jax.jit(make_prefill(True))
+        self._step_greedy = jax.jit(make_step(False), donate_argnums=(1,))
+        self._step_sampled = jax.jit(make_step(True), donate_argnums=(1,))
+
+    # -- slot lifecycle ---------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return self.pool.num_free
+
+    @property
+    def active_count(self) -> int:
+        return int(self.active.sum())
+
+    def acquire_slot(self) -> int | None:
+        return self.pool.alloc()
+
+    def release(self, slot: int) -> None:
+        self.active[slot] = False
+        self.pool.free(slot)
+
+    def start(
+        self,
+        slot: int,
+        prompt,
+        *,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
+        seed: int = 0,
+        eos_id: int | None = None,
+    ) -> tuple[int, bool]:
+        """Prefill ``prompt`` into ``slot`` and sample its first token.
+
+        Returns ``(first_token, finished)``; a request that is already done
+        after one token (budget 1, or the first token is its eos) comes
+        back ``finished=True`` and the caller releases the slot."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        p = int(prompt.size)
+        if p < 1:
+            raise ValueError("prompt must contain at least one token")
+        if p > self.prefill_len:
+            raise ValueError(
+                f"prompt length {p} > engine prefill_len {self.prefill_len}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if p + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {p} + {max_new_tokens} new > engine max_len "
+                f"{self.max_len}"
+            )
+        padded = np.zeros((1, self.prefill_len), np.int32)
+        padded[0, :p] = prompt
+        prefill = (
+            self._prefill_sampled if temperature > 0.0 else self._prefill_greedy
+        )
+        new_layers, first = prefill(
+            self.params, padded, np.int32(p), np.float32(temperature),
+            np.int32(top_k), np.float32(top_p), np.uint32(seed),
+        )
+        self.pool.adopt(slot, new_layers)
+        first = int(first)
+        eos = -1 if eos_id is None else int(eos_id)
+        finished = max_new_tokens == 1 or first == eos
+        self.active[slot] = not finished
+        self.lengths[slot] = p
+        self.cur_tok[slot] = first
+        self.temp[slot] = temperature
+        self.top_k[slot] = top_k
+        self.top_p[slot] = top_p
+        self.seed[slot] = np.uint32(seed & 0xFFFFFFFF)
+        self.made[slot] = 1
+        self.budget[slot] = max_new_tokens
+        self.eos[slot] = eos
+        return first, finished
+
+    def step(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One batch round over every slot (``steps_per_sync`` tokens).
+
+        Returns ``(tokens (k, slots) int32, valid (k, slots) bool,
+        done (slots,) bool)``. ``done`` marks slots that finished during
+        this round — the caller collects their output and ``release``s
+        them, which is what lets the NEXT round admit replacements
+        (iteration-level batching)."""
+        if not self.active.any():
+            raise RuntimeError("step() with no active slots")
+        # The sampled program handles greedy rows correctly (via `where`),
+        # so a mixed batch runs sampled; only an all-greedy batch takes the
+        # sort-free fast path.
+        step = (
+            self._step_sampled
+            if bool((self.temp[self.active] > 0.0).any())
+            else self._step_greedy
+        )
+        out = step(
+            self.params, self.pool.layers, self.active, self.lengths,
+            self.cur_tok, self.temp, self.top_k, self.top_p, self.seed,
+            self.made, self.budget, self.eos,
+        )
+        layers, active, lengths, tok, made, toks, valid = out
+        self.pool.layers = layers
+        was_active = self.active
+        # np.array (copy), not np.asarray: zero-copy views of jax buffers
+        # are read-only, and start()/release() write these registers.
+        self.active = np.array(active)
+        self.lengths = np.array(lengths)
+        self.cur_tok = np.array(tok)
+        self.made = np.array(made)
+        done = was_active & ~self.active
+        return np.asarray(toks), np.asarray(valid), done
+
+    # -- warmup / zero-recompile accounting -------------------------------
+
+    def warmup(self) -> int:
+        """Compile both programs (and the pool's adopt) on a throwaway
+        request; returns :meth:`compile_count`. Run this before taking
+        traffic — after it, the count must never grow (the serving
+        equivalent of ``__graft_entry__``'s collective-count asserts;
+        asserted under churn in ``tests/test_serve_engine.py``)."""
+        slot = self.acquire_slot()
+        if slot is None:
+            raise RuntimeError("warmup needs a free slot")
+        # Both sampling variants of both programs: greedy pass, then a
+        # temperature/top-k/top-p pass.
+        for kwargs in (
+            {"temperature": 0.0},
+            {"temperature": 1.0, "top_k": 2, "top_p": 0.9},
+        ):
+            try:
+                _, finished = self.start(
+                    slot, [0], max_new_tokens=2, seed=0, **kwargs
+                )
+                if not finished:
+                    while self.active[slot]:
+                        self.step()
+                    self.active[slot] = False
+            finally:
+                self.release(slot)
+            slot = self.acquire_slot()
+        self.release(slot)
+        return self.compile_count()
+
+    def compile_count(self) -> int:
+        """Total compiled programs across the engine's jitted callables —
+        stable after :meth:`warmup` or something is shape-unstable."""
+        own = sum(
+            f._cache_size() if hasattr(f, "_cache_size") else 0
+            for f in (self._prefill_greedy, self._prefill_sampled,
+                      self._step_greedy, self._step_sampled)
+        )
+        return own + self.pool.compile_count()

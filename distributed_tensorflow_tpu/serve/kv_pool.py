@@ -1,0 +1,108 @@
+"""Slot-pooled KV cache: fixed device buffers, in-place slot turnover.
+
+One allocation for the engine's lifetime: per-layer K/V buffers shaped
+``(slots, kv_heads, max_len, head_dim)`` (plus per-row f32 scales under the
+int8-KV config), built on ``models/decoding.init_cache`` so every cache
+layout the model family supports — GQA's unexpanded kv heads, int8 rows —
+pools identically. Admitting a request never allocates: the prefilled
+(1, …) cache is scattered into its slot with ``.at[slot].set`` inside a
+jitted, buffer-donating program, so XLA aliases the pool in place (the
+vLLM lesson: cheap admission is what makes token-granularity scheduling
+worth doing). ``slot`` is a traced scalar — one compile covers every slot.
+
+Freeing is a host-side bookkeeping pop: a freed slot's stale K/V rows are
+NOT zeroed on the hot path. That is safe by the same invariant the decode
+step relies on (``engine.py``): prefill rewrites positions ``[0, p)`` and
+sets the filled length to ``p``, and every decode step writes position
+``len`` BEFORE attending keys ``0..len`` — stale rows above the filled
+length are overwritten before they are ever readable. ``reset`` exists for
+hygiene/debugging, not correctness.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu.models.decoding import init_cache
+
+__all__ = ["SlotKVPool"]
+
+
+class SlotKVPool:
+    """Fixed-capacity pooled KV buffers + free-slot bookkeeping.
+
+    ``layers`` is the live device pytree (list of per-layer dicts with
+    leading ``slots`` axis). The jitted mutators donate it, so holders of a
+    stale reference are invalidated — always read ``pool.layers`` fresh.
+    Host-side per-slot state (filled lengths, sampling params) lives in the
+    engine; the pool owns only the big buffers and the free list.
+    """
+
+    def __init__(self, cfg, slots: int, max_len: int):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.layers = init_cache(cfg, slots, max_len)["layers"]
+        # LIFO reuse: the most recently freed slot's buffers are the most
+        # likely to still be resident in any cache hierarchy.
+        self._free: list[int] = list(range(slots - 1, -1, -1))
+
+        def adopt_fn(layers, slot, new_layers):
+            # new_layers leaves are (1, kv, max_len, dh) — a single-request
+            # prefill cache; strip the unit batch dim and scatter into the
+            # pool row. Donating `layers` lets XLA write the pool in place.
+            return jax.tree_util.tree_map(
+                lambda pool, new: pool.at[slot].set(new[0]), layers, new_layers
+            )
+
+        def reset_fn(layers, slot):
+            return jax.tree_util.tree_map(
+                lambda pool: pool.at[slot].set(0), layers
+            )
+
+        self._adopt = jax.jit(adopt_fn, donate_argnums=(0,))
+        self._reset = jax.jit(reset_fn, donate_argnums=(0,))
+
+    # -- host-side bookkeeping -------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return 1.0 - len(self._free) / self.slots
+
+    def alloc(self) -> int | None:
+        """Claim a slot index, or None when the pool is full."""
+        return self._free.pop() if self._free else None
+
+    def free(self, slot: int) -> None:
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        if slot in self._free:
+            raise ValueError(f"double free of slot {slot}")
+        self._free.append(slot)
+
+    # -- jitted in-place mutators ----------------------------------------
+
+    def adopt(self, slot: int, new_layers) -> None:
+        """Scatter a prefilled (1, …) cache into ``slot`` in place."""
+        self.layers = self._adopt(self.layers, np.int32(slot), new_layers)
+
+    def reset(self, slot: int) -> None:
+        """Zero a slot's rows (hygiene only — see module docstring)."""
+        self.layers = self._reset(self.layers, np.int32(slot))
+
+    def compile_count(self) -> int:
+        """Compiled-program count across the pool's jitted mutators (the
+        engine sums this into its zero-recompile-after-warmup assert)."""
+        return sum(
+            f._cache_size() if hasattr(f, "_cache_size") else 0
+            for f in (self._adopt, self._reset)
+        )

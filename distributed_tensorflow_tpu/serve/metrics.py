@@ -1,0 +1,163 @@
+"""Serving observability: latency histograms + queue/occupancy gauges.
+
+Host-side and allocation-light — metric updates happen on the scheduler's
+hot path (once per engine round, once per request), so they are plain
+appends into bounded deques; percentile math is deferred to ``snapshot()``
+(the /metrics endpoint, the loadgen report, the bench). ``publish()``
+bridges into the repo's own TensorBoard writer (``utils/summary.py``) so a
+serving run's TTFT / per-token latency show up next to the training runs'
+step-time panels in the same stock TensorBoard.
+
+The two latencies that matter, measured where the SLO is felt:
+
+* **TTFT** (time to first token) — submit → first sampled token; includes
+  queue wait + prefill, so admission-control failures show up here first.
+* **per-token latency** — the inter-token gap on the decode path; under
+  continuous batching this is one engine round divided by the tokens it
+  produced, the number the 2x-vs-sequential bench ratchet guards.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+import numpy as np
+
+__all__ = ["Histogram", "ServingMetrics"]
+
+
+class Histogram:
+    """Bounded reservoir of float observations with percentile readout.
+
+    Keeps the most recent ``maxlen`` samples (deque semantics — serving
+    metrics should reflect CURRENT behavior, not the warmup transient from
+    an hour ago) while ``count``/``total`` keep exact lifetime aggregates.
+    """
+
+    def __init__(self, maxlen: int = 4096):
+        self._samples: deque[float] = deque(maxlen=maxlen)
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self._samples.append(value)
+        self.count += 1
+        self.total += value
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; 0.0 when no samples have been observed."""
+        if not self._samples:
+            return 0.0
+        return float(np.percentile(np.asarray(self._samples), q))
+
+    def summary(self) -> dict:
+        s = np.asarray(self._samples) if self._samples else np.zeros(1)
+        return {
+            "count": self.count,
+            "mean": self.total / self.count if self.count else 0.0,
+            "p50": float(np.percentile(s, 50)) if self._samples else 0.0,
+            "p95": float(np.percentile(s, 95)) if self._samples else 0.0,
+            "p99": float(np.percentile(s, 99)) if self._samples else 0.0,
+            "max": float(s.max()) if self._samples else 0.0,
+        }
+
+    def values(self) -> np.ndarray:
+        """Current reservoir contents (for SummaryWriter.add_histogram)."""
+        return np.asarray(self._samples, np.float64)
+
+
+class ServingMetrics:
+    """One serving process's counters, gauges, and latency histograms.
+
+    Thread-safe (the HTTP server's handler threads observe TTFT while the
+    scheduler thread observes round latencies). Units are seconds
+    internally; ``snapshot()`` reports milliseconds for the latency fields
+    because that is the scale humans read SLOs in.
+    """
+
+    def __init__(self, histogram_maxlen: int = 4096):
+        self._lock = threading.Lock()
+        self.ttft = Histogram(histogram_maxlen)
+        self.per_token = Histogram(histogram_maxlen)
+        self.queue_depth = Histogram(histogram_maxlen)
+        self.occupancy = Histogram(histogram_maxlen)
+        self.queue_depth_peak = 0
+        self.completed = 0
+        self.shed = 0
+        self.tokens_out = 0
+
+    # -- recording (scheduler hot path) -----------------------------------
+
+    def record_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self.ttft.observe(seconds)
+
+    def record_round(self, seconds: float, tokens: int) -> None:
+        """One engine decode round that produced ``tokens`` valid tokens."""
+        with self._lock:
+            self.tokens_out += int(tokens)
+            if tokens > 0:
+                self.per_token.observe(seconds / tokens)
+
+    def record_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth.observe(float(depth))
+            self.queue_depth_peak = max(self.queue_depth_peak, int(depth))
+
+    def record_occupancy(self, frac: float) -> None:
+        with self._lock:
+            self.occupancy.observe(float(frac))
+
+    def record_completed(self) -> None:
+        with self._lock:
+            self.completed += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    # -- readout ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-ready view (the /metrics endpoint and loadgen's report)."""
+        with self._lock:
+            def ms(h: Histogram) -> dict:
+                s = h.summary()
+                return {
+                    k: (v * 1e3 if k != "count" else v) for k, v in s.items()
+                }
+
+            return {
+                "completed": self.completed,
+                "shed": self.shed,
+                "tokens_out": self.tokens_out,
+                "queue_depth_peak": self.queue_depth_peak,
+                "queue_depth": self.queue_depth.summary(),
+                "slot_occupancy": self.occupancy.summary(),
+                "ttft_ms": ms(self.ttft),
+                "per_token_ms": ms(self.per_token),
+            }
+
+    def publish(self, writer, step: int) -> None:
+        """Emit the current state into a ``utils/summary.SummaryWriter``."""
+        with self._lock:
+            scalars = {
+                "serve/completed": float(self.completed),
+                "serve/shed": float(self.shed),
+                "serve/tokens_out": float(self.tokens_out),
+                "serve/queue_depth_peak": float(self.queue_depth_peak),
+                "serve/ttft_p99_ms": self.ttft.percentile(99) * 1e3,
+                "serve/per_token_p50_ms": self.per_token.percentile(50) * 1e3,
+            }
+            hists = {
+                "serve/ttft_s": self.ttft.values(),
+                "serve/per_token_s": self.per_token.values(),
+                "serve/queue_depth": self.queue_depth.values(),
+                "serve/slot_occupancy": self.occupancy.values(),
+            }
+        writer.add_scalars(scalars, step)
+        for tag, vals in hists.items():
+            if vals.size:
+                writer.add_histogram(tag, vals, step)

@@ -1,0 +1,394 @@
+"""FCFS scheduler: iteration-level join/leave + typed admission control.
+
+The scheduler is the single thread that owns the engine. Each ``step()``
+is one serving iteration in the Orca sense:
+
+  1. **shed** queued requests whose deadline passed while waiting,
+  2. **admit** queued requests into free slots FCFS (prefill + first
+     token — TTFT is measured here), releasing immediately if the first
+     token already finishes the request,
+  3. **decode** one engine round over every active slot,
+  4. **complete** slots the round finished and free them — the very next
+     ``step()`` refills those slots from the queue.
+
+So a finished request's slot is recycled at TOKEN granularity, never
+waiting for the rest of the batch: that is the whole continuous-batching
+win over run-to-completion batching.
+
+Load-shed is deterministic and TYPED — callers always get a
+:class:`Completion` or a :class:`Rejection` with a machine-readable
+``reason`` (``queue_full`` at submit, ``deadline`` at admission sweep,
+``invalid`` for malformed params, ``shutting_down`` at stop). Nothing in
+this module blocks indefinitely: ``submit`` either rejects synchronously
+or enqueues, and ``PendingRequest.result(timeout)`` is the only wait.
+
+Deadlines govern QUEUE WAIT only: a request admitted before its deadline
+runs to completion (mid-flight eviction would waste the prefill it
+already paid for — the expensive part; shedding is for work not yet
+started).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from distributed_tensorflow_tpu.serve.engine import SlotEngine
+
+__all__ = ["Request", "Completion", "Rejection", "PendingRequest", "Scheduler"]
+
+
+@dataclass(frozen=True)
+class Request:
+    """One generation request. ``deadline_s`` is a RELATIVE queue-wait
+    budget from submit time (None = wait forever); see the module
+    docstring for why it only sheds while queued."""
+
+    prompt: tuple
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
+    eos_id: int | None = None
+    deadline_s: float | None = None
+    request_id: str = ""
+
+
+@dataclass(frozen=True)
+class Completion:
+    request_id: str
+    tokens: tuple  # generated tokens only (prompt excluded), eos included
+    ttft_s: float
+    latency_s: float
+    finish_reason: str  # "length" | "eos"
+
+
+@dataclass(frozen=True)
+class Rejection:
+    request_id: str
+    reason: str  # "queue_full" | "deadline" | "invalid" | "shutting_down"
+    detail: str = ""
+
+
+@dataclass
+class PendingRequest:
+    """Submit-side handle: ``result(timeout)`` blocks until the scheduler
+    posts a Completion or Rejection (never a hang under shed — every
+    terminal path posts exactly once)."""
+
+    request: Request
+    submitted_at: float
+    _event: threading.Event = field(default_factory=threading.Event)
+    _outcome: Completion | Rejection | None = None
+
+    def finish(self, outcome: Completion | Rejection) -> None:
+        self._outcome = outcome
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> Completion | Rejection:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request.request_id!r} not finished "
+                f"within {timeout}s"
+            )
+        assert self._outcome is not None
+        return self._outcome
+
+
+class _InFlight:
+    """Host-side accumulation for a request occupying a slot."""
+
+    __slots__ = ("pending", "tokens", "started_at", "ttft_s")
+
+    def __init__(self, pending, first_token, started_at, ttft_s):
+        self.pending = pending
+        self.tokens = [int(first_token)]
+        self.started_at = started_at
+        self.ttft_s = ttft_s
+
+
+class Scheduler:
+    """FCFS continuous-batching scheduler over one :class:`SlotEngine`.
+
+    ``submit()`` is thread-safe (the HTTP server calls it from handler
+    threads); the engine is driven only from ``step()`` /
+    ``run_until_idle()`` / the ``start()`` background loop — one driver at
+    a time by contract.
+    """
+
+    def __init__(
+        self,
+        engine: SlotEngine,
+        *,
+        max_queue_depth: int = 64,
+        metrics=None,
+        clock=time.monotonic,
+    ):
+        if max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {max_queue_depth}"
+            )
+        self.engine = engine
+        self.max_queue_depth = int(max_queue_depth)
+        self.metrics = metrics
+        self.clock = clock
+        self._queue: deque[PendingRequest] = deque()
+        self._lock = threading.Lock()  # guards _queue and _accepting only
+        self._accepting = True
+        self._inflight: dict[int, _InFlight] = {}
+        self._ids = itertools.count()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- submit side (any thread) -----------------------------------------
+
+    def submit(self, request: Request) -> PendingRequest:
+        """Enqueue or reject NOW. The returned handle always terminates."""
+        now = self.clock()
+        pending = PendingRequest(request=request, submitted_at=now)
+        if not request.request_id:
+            request = Request(
+                **{**request.__dict__, "request_id": f"r{next(self._ids)}"}
+            )
+            pending.request = request
+        err = self._validate(request)
+        if err is not None:
+            pending.finish(Rejection(request.request_id, "invalid", err))
+            self._count_shed()
+            return pending
+        with self._lock:
+            if not self._accepting:
+                pending.finish(
+                    Rejection(request.request_id, "shutting_down",
+                              "scheduler is stopping")
+                )
+                self._count_shed()
+                return pending
+            if len(self._queue) >= self.max_queue_depth:
+                pending.finish(
+                    Rejection(
+                        request.request_id, "queue_full",
+                        f"queue depth {len(self._queue)} >= "
+                        f"{self.max_queue_depth}",
+                    )
+                )
+                self._count_shed()
+                return pending
+            self._queue.append(pending)
+            depth = len(self._queue)
+        if self.metrics is not None:
+            self.metrics.record_queue_depth(depth)
+        return pending
+
+    def _validate(self, r: Request) -> str | None:
+        e = self.engine
+        p = len(r.prompt)
+        if p < 1:
+            return "empty prompt"
+        if p > e.prefill_len:
+            return f"prompt length {p} > prefill_len {e.prefill_len}"
+        if r.max_new_tokens < 1:
+            return f"max_new_tokens {r.max_new_tokens} < 1"
+        if p + r.max_new_tokens > e.max_len:
+            return (
+                f"prompt {p} + {r.max_new_tokens} new > max_len {e.max_len}"
+            )
+        if r.deadline_s is not None and r.deadline_s < 0:
+            return f"negative deadline_s {r.deadline_s}"
+        return None
+
+    def _count_shed(self) -> None:
+        if self.metrics is not None:
+            self.metrics.record_shed()
+
+    # -- engine-driver side (one thread) ----------------------------------
+
+    def step(self) -> int:
+        """One serving iteration (shed → admit → decode → complete).
+        Returns the number of requests completed this iteration."""
+        now = self.clock()
+        self._shed_expired(now)
+        self._admit(now)
+        if self.metrics is not None:
+            self.metrics.record_occupancy(1.0 - self.engine.free_slots
+                                          / self.engine.slots)
+        if self.engine.active_count == 0:
+            return 0
+        t0 = self.clock()
+        toks, valid, done = self.engine.step()
+        round_s = self.clock() - t0
+        produced = 0
+        for k in range(toks.shape[0]):
+            for slot, fl in self._inflight.items():
+                if valid[k, slot]:
+                    fl.tokens.append(int(toks[k, slot]))
+                    produced += 1
+        if self.metrics is not None:
+            self.metrics.record_round(round_s, produced)
+        completed = 0
+        for slot in np.nonzero(done)[0]:
+            self._complete(int(slot))
+            completed += 1
+        return completed
+
+    def _shed_expired(self, now: float) -> None:
+        with self._lock:
+            queue = list(self._queue)
+            self._queue.clear()
+            keep = []
+            for pending in queue:
+                r = pending.request
+                if (r.deadline_s is not None
+                        and now - pending.submitted_at > r.deadline_s):
+                    pending.finish(
+                        Rejection(
+                            r.request_id, "deadline",
+                            f"queued {now - pending.submitted_at:.3f}s > "
+                            f"deadline {r.deadline_s}s",
+                        )
+                    )
+                    self._count_shed()
+                else:
+                    keep.append(pending)
+            self._queue.extend(keep)
+
+    def _admit(self, now: float) -> None:
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return
+                slot = self.engine.acquire_slot()
+                if slot is None:
+                    return
+                pending = self._queue.popleft()
+            r = pending.request
+            try:
+                first, finished = self.engine.start(
+                    slot, r.prompt,
+                    max_new_tokens=r.max_new_tokens,
+                    temperature=r.temperature, top_k=r.top_k,
+                    top_p=r.top_p, seed=r.seed, eos_id=r.eos_id,
+                )
+            except Exception as exc:  # _validate should prevent this
+                self.engine.release(slot)
+                pending.finish(Rejection(r.request_id, "invalid", str(exc)))
+                self._count_shed()
+                continue
+            done_at = self.clock()
+            ttft = done_at - pending.submitted_at
+            if self.metrics is not None:
+                self.metrics.record_ttft(ttft)
+            fl = _InFlight(pending, first, done_at, ttft)
+            if finished:
+                self.engine.release(slot)
+                self._finish_completion(fl, done_at)
+            else:
+                self._inflight[slot] = fl
+
+    def _complete(self, slot: int) -> None:
+        fl = self._inflight.pop(slot)
+        self.engine.release(slot)
+        self._finish_completion(fl, self.clock())
+
+    def _finish_completion(self, fl: _InFlight, now: float) -> None:
+        r = fl.pending.request
+        reason = (
+            "eos"
+            if r.eos_id is not None and fl.tokens
+            and fl.tokens[-1] == r.eos_id
+            else "length"
+        )
+        fl.pending.finish(
+            Completion(
+                request_id=r.request_id,
+                tokens=tuple(fl.tokens),
+                ttft_s=fl.ttft_s,
+                latency_s=now - fl.pending.submitted_at,
+                finish_reason=reason,
+            )
+        )
+        if self.metrics is not None:
+            self.metrics.record_completed()
+
+    def run_until_idle(self, max_steps: int | None = None) -> int:
+        """Drive ``step()`` until queue and slots are empty; returns total
+        completions. ``max_steps`` bounds runaway loops in tests."""
+        total = 0
+        steps = 0
+        while True:
+            with self._lock:
+                queued = len(self._queue)
+            if queued == 0 and not self._inflight:
+                return total
+            total += self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"not idle after {max_steps} steps "
+                    f"({queued} queued, {len(self._inflight)} in flight)"
+                )
+
+    # -- background loop (serve_lm) ---------------------------------------
+
+    def start(self, poll_s: float = 0.001) -> None:
+        """Run the serving loop on a daemon thread (the HTTP server's
+        submit side stays on its own threads)."""
+        if self._thread is not None:
+            raise RuntimeError("scheduler already started")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                with self._lock:
+                    idle = not self._queue
+                if idle and not self._inflight:
+                    self._stop.wait(poll_s)
+                    continue
+                self.step()
+
+        self._thread = threading.Thread(
+            target=loop, name="serve-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop accepting, halt the loop, and shed anything unfinished
+        (typed ``shutting_down``) so no caller is left hanging."""
+        with self._lock:
+            self._accepting = False
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout)
+            self._thread = None
+        with self._lock:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        leftovers.extend(fl.pending for fl in self._inflight.values())
+        for slot in list(self._inflight):
+            del self._inflight[slot]
+            self.engine.release(slot)
+        for pending in leftovers:
+            if not pending.done():
+                pending.finish(
+                    Rejection(pending.request.request_id, "shutting_down",
+                              "scheduler stopped before completion")
+                )
+                self._count_shed()
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def inflight_count(self) -> int:
+        return len(self._inflight)

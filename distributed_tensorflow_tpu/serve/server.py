@@ -1,0 +1,157 @@
+"""Stdlib-only HTTP front end for the serving scheduler.
+
+``http.server.ThreadingHTTPServer`` + JSON bodies — no web framework, the
+same no-new-dependencies stance as the rest of the repo (the TB writer
+speaks raw protobuf, the server speaks raw HTTP). Handler threads only
+``submit()`` and wait on the returned handle; the engine stays owned by
+the scheduler's single driver thread.
+
+Endpoints:
+
+* ``POST /generate`` — body ``{"prompt": [ints], "max_new_tokens": int,
+  "temperature": float, "top_k": int, "top_p": float, "seed": int,
+  "eos_id": int|null, "deadline_s": float|null}`` (prompt may also be a
+  string when the server was built with a codec). Responses map typed
+  scheduler outcomes onto status codes — load-shed is an HTTP answer,
+  never a hang:
+
+  =====================  ====  =========================================
+  outcome                code  body
+  =====================  ====  =========================================
+  Completion             200   request_id, tokens, text?, ttft_ms,
+                               latency_ms, finish_reason
+  Rejection queue_full   429   error="queue_full", detail
+  Rejection deadline     503   error="deadline", detail
+  Rejection shutting...  503   error="shutting_down", detail
+  Rejection invalid      400   error="invalid", detail
+  result timeout         503   error="timeout", detail
+  bad JSON / bad types   400   error="invalid", detail
+  =====================  ====  =========================================
+
+* ``GET /healthz`` — 200 ``{"ok": true, "slots": N, "free_slots": M}``
+* ``GET /metrics`` — 200 ``ServingMetrics.snapshot()`` JSON
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from distributed_tensorflow_tpu.serve.scheduler import Completion, Request
+
+__all__ = ["make_server"]
+
+_REJECTION_STATUS = {
+    "queue_full": 429,
+    "deadline": 503,
+    "shutting_down": 503,
+    "invalid": 400,
+}
+
+
+def _parse_request(body: dict, codec) -> Request:
+    prompt = body.get("prompt")
+    if isinstance(prompt, str):
+        if codec is None:
+            raise ValueError("string prompt needs a server-side codec")
+        prompt = codec.encode(prompt)
+    if not isinstance(prompt, (list, tuple)) or not prompt:
+        raise ValueError("prompt must be a non-empty list of token ids")
+    if not all(isinstance(t, int) and not isinstance(t, bool) for t in prompt):
+        raise ValueError("prompt tokens must be ints")
+    eos_id = body.get("eos_id")
+    deadline = body.get("deadline_s")
+    return Request(
+        prompt=tuple(prompt),
+        max_new_tokens=int(body.get("max_new_tokens", 16)),
+        temperature=float(body.get("temperature", 0.0)),
+        top_k=int(body.get("top_k", 0)),
+        top_p=float(body.get("top_p", 0.0)),
+        seed=int(body.get("seed", 0)),
+        eos_id=None if eos_id is None else int(eos_id),
+        deadline_s=None if deadline is None else float(deadline),
+        request_id=str(body.get("request_id", "")),
+    )
+
+
+def make_server(
+    scheduler,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    *,
+    request_timeout_s: float = 60.0,
+    codec=None,
+) -> ThreadingHTTPServer:
+    """Build (not start) the HTTP server; caller runs ``serve_forever()``
+    and owns scheduler start/stop. ``port=0`` binds an ephemeral port
+    (tests read ``server.server_address``)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        # Serving logs go through metrics, not per-request stderr lines.
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, code: int, payload: dict) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {
+                    "ok": True,
+                    "slots": scheduler.engine.slots,
+                    "free_slots": scheduler.engine.free_slots,
+                    "queue_depth": scheduler.queue_depth,
+                })
+            elif self.path == "/metrics":
+                snap = (scheduler.metrics.snapshot()
+                        if scheduler.metrics is not None else {})
+                self._send(200, snap)
+            else:
+                self._send(404, {"error": "not_found", "detail": self.path})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, {"error": "not_found", "detail": self.path})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                request = _parse_request(body, codec)
+            except (ValueError, TypeError, json.JSONDecodeError) as exc:
+                self._send(400, {"error": "invalid", "detail": str(exc)})
+                return
+            pending = scheduler.submit(request)
+            try:
+                outcome = pending.result(timeout=request_timeout_s)
+            except TimeoutError as exc:
+                self._send(503, {"error": "timeout", "detail": str(exc)})
+                return
+            if isinstance(outcome, Completion):
+                payload = {
+                    "request_id": outcome.request_id,
+                    "tokens": list(outcome.tokens),
+                    "ttft_ms": outcome.ttft_s * 1e3,
+                    "latency_ms": outcome.latency_s * 1e3,
+                    "finish_reason": outcome.finish_reason,
+                }
+                if codec is not None:
+                    payload["text"] = codec.decode(list(outcome.tokens))
+                self._send(200, payload)
+            else:
+                self._send(
+                    _REJECTION_STATUS.get(outcome.reason, 500),
+                    {
+                        "error": outcome.reason,
+                        "detail": outcome.detail,
+                        "request_id": outcome.request_id,
+                    },
+                )
+
+    return ThreadingHTTPServer((host, port), Handler)

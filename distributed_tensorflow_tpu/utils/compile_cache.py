@@ -68,6 +68,16 @@ def _configure_tpu_vmem_budget() -> None:
     try:
         from jax._src.xla_bridge import backends_are_initialized
     except ImportError:
+        import warnings
+
+        warnings.warn(
+            "jax._src.xla_bridge.backends_are_initialized is gone in this "
+            "jax version; skipping the scoped-VMEM budget raise "
+            f"({_SCOPED_VMEM_FLAG} stays at the XLA default — expect a few "
+            "MFU points on TPU). Set LIBTPU_INIT_ARGS yourself to restore "
+            "it, and update _configure_tpu_vmem_budget for this jax.",
+            stacklevel=3,
+        )
         return
     if backends_are_initialized():
         return

@@ -426,3 +426,42 @@ def test_flash_fused_matches_two_pass(monkeypatch, causal):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
         )
+
+
+@pytest.mark.parametrize("window", [None, 24])
+def test_flash_packed_rope_fallback_grads_match_fused(monkeypatch, window):
+    """The packed-qkv backward's FALLBACK branch with in-kernel rope: when
+    the fused one-pass kernel doesn't fit (forced via the scratch limit),
+    the packed backward unpacks to BSHD with the rope rotation applied and
+    must rotate the resulting dq/dk BACK before regrouping — same packed
+    cotangent as the fused in-kernel path. GQA (4q/2kv) + causal (+ sliding
+    window), per-batch tables: every index-map variant the rotate-back
+    touches."""
+    from distributed_tensorflow_tpu.ops.rope import rope_cos_sin
+
+    b, s, h, kv, d = 2, 64, 4, 2, 16
+    width = (h + 2 * kv) * d
+    r = np.random.default_rng(11)
+    qkv = jnp.asarray(r.standard_normal((b, s, width)), jnp.float32)
+    g_out = jnp.asarray(r.standard_normal((b, s, h * d)), jnp.float32)
+    # Distinct per-batch global positions — the (B, S, half) table shape.
+    positions = jnp.stack([jnp.arange(s), 37 + jnp.arange(s)])
+    cos, sin = rope_cos_sin(positions, d)
+
+    def loss(qkv):
+        return jnp.sum(
+            A.flash_attention_qkv(
+                qkv, h, kv, causal=True, window=window, block_q=16,
+                block_kv=16, interpret=True, rope_cos=cos, rope_sin=sin,
+            )
+            * g_out
+        )
+
+    v_fused, g_fused = jax.value_and_grad(loss)(qkv)
+    monkeypatch.setattr(A, "_FUSED_BWD_SCRATCH_LIMIT", 0)
+    v_fb, g_fb = jax.value_and_grad(loss)(qkv)
+    # The forward is identical (the limit only gates the backward).
+    np.testing.assert_array_equal(np.asarray(v_fused), np.asarray(v_fb))
+    np.testing.assert_allclose(
+        np.asarray(g_fb), np.asarray(g_fused), rtol=1e-4, atol=1e-4
+    )

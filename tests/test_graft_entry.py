@@ -14,6 +14,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -25,6 +27,35 @@ def _bare_env() -> dict:
     }
     env["DTF_COMPILATION_CACHE"] = "0"
     return env
+
+
+_PROBE: list = []
+
+
+def _bare_device_probe_hangs() -> bool:
+    """Probe whether ``jax.devices()`` in a bare env ever returns here.
+
+    On a box where the TPU plugin is installed but its hardware is
+    unreachable, plugin init blocks forever inside
+    ``xla_client.initialize_pjrt_plugin`` (no timeout exists in jax), so the
+    bootstrap's own hardware probe — and tests 1 and 2 below, which
+    reproduce it — would hang the whole suite. Detect that once per module
+    with a short-timeout subprocess and skip; the contract these tests pin
+    can only be exercised where the bare device probe completes. 60s is
+    ~10x the probe's cost when the tunnel is up."""
+    if not _PROBE:
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                cwd=_REPO,
+                env=_bare_env(),
+                capture_output=True,
+                timeout=60,
+            )
+            _PROBE.append(False)
+        except subprocess.TimeoutExpired:
+            _PROBE.append(True)
+    return _PROBE[0]
 
 
 def _run(code: str) -> str:
@@ -41,6 +72,8 @@ def _run(code: str) -> str:
 
 
 def test_bootstrap_bare_process():
+    if _bare_device_probe_hangs():
+        pytest.skip("bare jax.devices() hangs: TPU plugin without reachable hardware")
     out = _run(
         "from __graft_entry__ import _bootstrap_virtual_devices\n"
         "jax = _bootstrap_virtual_devices(4)\n"
@@ -55,6 +88,8 @@ def test_bootstrap_after_backend_already_initialized():
     # The driver (or its harness) may touch jax.devices() before calling the
     # entry point; the bootstrap must recover by clearing the too-small
     # backend and re-selecting CPU.
+    if _bare_device_probe_hangs():
+        pytest.skip("bare jax.devices() hangs: TPU plugin without reachable hardware")
     out = _run(
         "import jax\n"
         "n_before = len(jax.devices())\n"

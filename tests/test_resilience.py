@@ -694,6 +694,29 @@ def test_compilation_cache_gated_off_on_legacy_cpu(tmp_path, monkeypatch):
     assert cc.enable_compilation_cache(str(tmp_path / "xla")) is None
 
 
+def test_vmem_budget_warns_when_jax_private_probe_is_gone(monkeypatch):
+    """The scoped-VMEM raise rides jax._src.xla_bridge.backends_are_initialized
+    (no public probe exists). If a future jax moves it, the budget write is
+    skipped conservatively — but LOUDLY, because silently losing the raise
+    costs MFU on TPU and the operator should learn it from a warning, not a
+    perf regression."""
+    import sys
+    import types
+
+    from distributed_tensorflow_tpu.utils import compile_cache as cc
+
+    monkeypatch.delenv("DTF_SCOPED_VMEM_KIB", raising=False)
+    monkeypatch.delenv("LIBTPU_INIT_ARGS", raising=False)
+    # A module object without the symbol: the from-import raises ImportError.
+    monkeypatch.setitem(
+        sys.modules, "jax._src.xla_bridge",
+        types.ModuleType("jax._src.xla_bridge"),
+    )
+    with pytest.warns(UserWarning, match="backends_are_initialized"):
+        cc._configure_tpu_vmem_budget()
+    assert "LIBTPU_INIT_ARGS" not in os.environ  # write skipped
+
+
 # ---------------------------------------------------------------------------
 # kill-and-resume, 2 real processes (slow)
 # ---------------------------------------------------------------------------

@@ -339,8 +339,11 @@ def test_flash_qkv_inkernel_rope_matches_outside_rotation(window):
 
 def test_flash_qkv_inkernel_rope_batched_tables():
     """(B, S, half) per-batch position tables (the sequence-parallel shard
-    contract: explicit global positions) — parity against per-batch outside
-    rotation."""
+    contract: explicit global positions) — forward AND gradient parity
+    against per-batch outside rotation. The grad check exercises the
+    batched-table index maps inside the fused backward (table rows must
+    track the q tile through the causal clamps) and the in-kernel dq/dk
+    rotate-back with a per-batch leading table index."""
     from distributed_tensorflow_tpu.ops import attention as A
     from distributed_tensorflow_tpu.ops.rope import rope_cos_sin
 
@@ -362,12 +365,24 @@ def test_flash_qkv_inkernel_rope_batched_tables():
             block_q=16, block_kv=16, interpret=True,
         )
 
-    got = A.flash_attention_qkv(
-        qkv, H, KV, causal=True, block_q=16, block_kv=16, interpret=True,
-        rope_cos=cos, rope_sin=sin,
-    )
+    def inkernel(qkv):
+        return A.flash_attention_qkv(
+            qkv, H, KV, causal=True, block_q=16, block_kv=16, interpret=True,
+            rope_cos=cos, rope_sin=sin,
+        )
+
     np.testing.assert_allclose(
-        np.asarray(got), np.asarray(outside(qkv)), rtol=1e-5, atol=1e-5
+        np.asarray(inkernel(qkv)), np.asarray(outside(qkv)),
+        rtol=1e-5, atol=1e-5,
+    )
+    g_out = jnp.asarray(
+        np.random.default_rng(2).standard_normal(qkv[..., : H * dh].shape),
+        jnp.float32,
+    )
+    g_ref = jax.grad(lambda x: jnp.sum(outside(x) * g_out))(qkv)
+    g_in = jax.grad(lambda x: jnp.sum(inkernel(x) * g_out))(qkv)
+    np.testing.assert_allclose(
+        np.asarray(g_in), np.asarray(g_ref), rtol=1e-4, atol=1e-4
     )
 
 

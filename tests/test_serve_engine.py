@@ -1,0 +1,278 @@
+"""Serving engine tests: the three contracts everything else builds on.
+
+1. **Parity** — a request decoded through the slot engine must reproduce
+   ``build_generate_fn`` token-for-token (greedy exactly; sampled via the
+   same fold_in PRNG discipline), whatever slot it lands in and whatever
+   else shares the batch.
+2. **Zero recompiles** — the ISSUE 4 acceptance criterion: >= 32 requests
+   with heterogeneous prompt/output lengths churn through a 4-slot engine
+   and the compiled-program count never moves after warmup.
+3. **Slot isolation/reuse** — freed slots are NOT zeroed, so a new tenant
+   must never read its predecessor's K/V (the write-before-attend
+   invariant in serve/engine.py's module docstring).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models import decoding
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from distributed_tensorflow_tpu.serve import SlotEngine, SlotKVPool
+
+pytestmark = pytest.mark.serve
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    num_heads=4,
+    num_layers=2,
+    d_ff=64,
+    max_seq_len=48,
+    compute_dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = TransformerLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def _drive(engine, requests):
+    """Closed-loop driver; returns {request index: generated tokens}."""
+    pending = list(range(len(requests)))
+    busy: dict[int, int] = {}
+    acc: dict[int, list[int]] = {}
+    results: dict[int, list[int]] = {}
+    while pending or busy:
+        while pending:
+            slot = engine.acquire_slot()
+            if slot is None:
+                break
+            i = pending.pop(0)
+            prompt, kwargs = requests[i]
+            first, finished = engine.start(slot, prompt, **kwargs)
+            acc[i] = [first]
+            if finished:
+                results[i] = acc[i]
+                engine.release(slot)
+            else:
+                busy[slot] = i
+        if busy:
+            toks, valid, done = engine.step()
+            for k in range(toks.shape[0]):
+                for slot, i in busy.items():
+                    if valid[k, slot]:
+                        acc[i].append(int(toks[k, slot]))
+            for slot in list(busy):
+                if done[slot]:
+                    i = busy.pop(slot)
+                    results[i] = acc[i]
+                    engine.release(slot)
+    return results
+
+
+def _reference_greedy(params, prompt, n_new):
+    gen = decoding.build_generate_fn(CFG, n_new, temperature=0.0)
+    out = gen(
+        params, jnp.asarray([prompt], jnp.int32), jax.random.PRNGKey(0)
+    )
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def test_greedy_parity_with_build_generate_fn(params):
+    """Every request through the engine == the sequential decode path,
+    token for token, across heterogeneous prompt/output lengths and
+    whatever slot each request happens to get."""
+    engine = SlotEngine(CFG, params, slots=3, max_len=32, prefill_len=12)
+    rng = np.random.default_rng(0)
+    requests = []
+    for _ in range(7):
+        p = rng.integers(0, CFG.vocab_size, rng.integers(1, 12)).tolist()
+        requests.append((p, {"max_new_tokens": int(rng.integers(2, 8))}))
+    results = _drive(engine, requests)
+    for i, (prompt, kwargs) in enumerate(requests):
+        ref = _reference_greedy(params, prompt, kwargs["max_new_tokens"])
+        assert results[i] == ref, f"request {i} diverged from sequential"
+
+
+def test_zero_recompiles_under_heterogeneous_churn(params):
+    """ISSUE 4 acceptance: >= 32 heterogeneous requests through a 4-slot
+    engine, compiled-program count frozen after warmup."""
+    engine = SlotEngine(CFG, params, slots=4, max_len=48, prefill_len=16,
+                        steps_per_sync=2)
+    compiled = engine.warmup()
+    assert compiled == engine.compile_count()
+    rng = np.random.default_rng(1)
+    requests = []
+    for i in range(32):
+        p = rng.integers(0, CFG.vocab_size, rng.integers(1, 17)).tolist()
+        kwargs = {"max_new_tokens": int(rng.integers(1, 9))}
+        if i % 3 == 1:  # mix sampling configs in — still no new programs
+            kwargs.update(temperature=0.8, top_k=int(rng.integers(2, 10)),
+                          top_p=0.9, seed=i)
+        if i % 5 == 2:
+            kwargs.update(eos_id=int(rng.integers(0, CFG.vocab_size)))
+        requests.append((p, kwargs))
+    results = _drive(engine, requests)
+    assert len(results) == 32
+    for i, (_, kwargs) in enumerate(requests):
+        assert 1 <= len(results[i]) <= kwargs["max_new_tokens"]
+    assert engine.compile_count() == compiled, (
+        "engine recompiled under churn — a shape or dtype leaked into a "
+        "jitted signature"
+    )
+
+
+def test_slot_reuse_isolation(params):
+    """A slot's previous tenant must not influence its next one: the same
+    request gives identical tokens on a fresh engine and on a slot that
+    just hosted a DIFFERENT longer request (stale K/V above the new
+    filled length is never attended)."""
+    probe = [5, 9, 2]
+    fresh = SlotEngine(CFG, params, slots=1, max_len=32, prefill_len=12)
+    want = _drive(fresh, [(probe, {"max_new_tokens": 5})])[0]
+
+    reused = SlotEngine(CFG, params, slots=1, max_len=32, prefill_len=12)
+    noise = np.random.default_rng(2).integers(0, CFG.vocab_size, 11).tolist()
+    _drive(reused, [(noise, {"max_new_tokens": 12})])  # fill slot 0 long
+    got = _drive(reused, [(probe, {"max_new_tokens": 5})])[0]
+    assert got == want
+
+
+def test_per_slot_sampling_params_are_independent(params):
+    """Slots decode with THEIR OWN temperature/top_k/top_p/seed: a greedy
+    request sharing the batch with hot-temperature requests returns the
+    greedy reference exactly."""
+    engine = SlotEngine(CFG, params, slots=4, max_len=32, prefill_len=8)
+    prompt = [3, 1, 4]
+    requests = [(prompt, {"max_new_tokens": 6})]
+    for s in range(3):
+        requests.append(
+            (prompt, {"max_new_tokens": 6, "temperature": 1.5, "top_k": 8,
+                      "top_p": 0.95, "seed": s + 10})
+        )
+    results = _drive(engine, requests)
+    assert results[0] == _reference_greedy(params, prompt, 6)
+
+
+def test_sampled_decode_is_seed_deterministic(params):
+    """Same request + same seed => same tokens, regardless of batch
+    composition (per-slot fold_in streams, not a shared engine key)."""
+    kwargs = {"max_new_tokens": 6, "temperature": 1.0, "top_k": 12,
+              "top_p": 0.9, "seed": 7}
+    alone = SlotEngine(CFG, params, slots=2, max_len=32, prefill_len=8)
+    a = _drive(alone, [([2, 4, 6], dict(kwargs))])[0]
+    crowded = SlotEngine(CFG, params, slots=2, max_len=32, prefill_len=8)
+    b = _drive(
+        crowded,
+        [([2, 4, 6], dict(kwargs)),
+         ([1, 1, 1, 1], {"max_new_tokens": 8, "temperature": 2.0,
+                         "seed": 99})],
+    )[0]
+    assert a == b
+
+
+def test_eos_stops_early_and_budget_caps(params):
+    """eos_id ends a request the step it is sampled; budget caps at
+    max_new_tokens; both release the slot for the next wave."""
+    engine = SlotEngine(CFG, params, slots=1, max_len=32, prefill_len=8)
+    # Use a greedy token that first appears MID-generation as eos, so the
+    # stop provably happens in the decode loop, not at prefill. The tiny
+    # random-init model often fixates on one token, so scan prompts (one
+    # compiled generate fn — fixed prompt length) for a varied output.
+    gen = decoding.build_generate_fn(CFG, 8, temperature=0.0)
+    for a in range(CFG.vocab_size):
+        ref = np.asarray(
+            gen(params, jnp.asarray([[a, 7]], jnp.int32),
+                jax.random.PRNGKey(0))
+        )[0, 2:].tolist()
+        j = next((i for i, t in enumerate(ref) if t != ref[0]), None)
+        if j is not None:
+            break
+    assert j is not None, "no prompt produced a varied greedy output"
+    results = _drive(engine, [([a, 7], {"max_new_tokens": 8,
+                                        "eos_id": ref[j]})])
+    assert results[0] == ref[:j + 1]  # stopped at eos, eos included
+    assert engine.free_slots == 1
+    results = _drive(engine, [([7, 7], {"max_new_tokens": 3})])
+    assert len(results[0]) == 3  # budget cap
+
+
+def test_start_validates_limits(params):
+    engine = SlotEngine(CFG, params, slots=1, max_len=16, prefill_len=8)
+    slot = engine.acquire_slot()
+    with pytest.raises(ValueError, match="at least one token"):
+        engine.start(slot, [], max_new_tokens=2)
+    with pytest.raises(ValueError, match="prefill_len"):
+        engine.start(slot, list(range(9)), max_new_tokens=2)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        engine.start(slot, [1], max_new_tokens=0)
+    with pytest.raises(ValueError, match="max_len"):
+        engine.start(slot, list(range(8)), max_new_tokens=9)
+    engine.release(slot)
+    with pytest.raises(RuntimeError, match="no active slots"):
+        engine.step()
+
+
+def test_kv_pool_alloc_free_adopt(params):
+    """Pool bookkeeping: LIFO alloc, double-free guard, adopt scatters a
+    (1, ...) cache into the right slot row without touching others."""
+    pool = SlotKVPool(CFG, slots=3, max_len=16)
+    assert pool.num_free == 3 and pool.occupancy == 0.0
+    s0, s1 = pool.alloc(), pool.alloc()
+    assert {s0, s1} == {0, 1} and pool.num_free == 1
+    s2 = pool.alloc()
+    assert s2 == 2 and pool.alloc() is None  # exhausted
+    pool.free(s2)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(s2)
+    with pytest.raises(ValueError, match="outside"):
+        pool.free(99)
+    pool.free(s1)
+    assert pool.alloc() == s1  # LIFO: most recently freed first
+    donor = decoding.init_cache(CFG, 1, 16)
+    filled = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, 3), donor["layers"]
+    )
+    before_other = np.asarray(pool.layers[0]["k"][s1])
+    pool.adopt(s0, filled)
+    assert np.all(np.asarray(pool.layers[0]["k"][s0]) == 3)
+    np.testing.assert_array_equal(
+        np.asarray(pool.layers[0]["k"][s1]), before_other
+    )
+    pool.reset(s0)
+    assert np.all(np.asarray(pool.layers[0]["k"][s0]) == 0)
+
+
+def test_sample_logits_batched_matches_static_sampler():
+    """Per-row traced sampling == the static sample_logits filter-for-
+    filter: same key, same temper/top-k/top-p => same token; disabled
+    filters and greedy rows match too."""
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.standard_normal((5, 32)), jnp.float32)
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(5)])
+    cases = [  # (temperature, top_k, top_p) per row; 0 = disabled
+        (0.0, 0, 0.0),     # greedy
+        (1.0, 0, 0.0),     # plain categorical
+        (0.7, 5, 0.0),     # top-k only
+        (1.3, 0, 0.8),     # nucleus only
+        (1.0, 7, 0.6),     # both
+    ]
+    temp = jnp.asarray([c[0] for c in cases], jnp.float32)
+    top_k = jnp.asarray([c[1] for c in cases], jnp.int32)
+    top_p = jnp.asarray([c[2] for c in cases], jnp.float32)
+    batched = decoding.sample_logits_batched(logits, keys, temp, top_k, top_p)
+    for i, (t, k, p) in enumerate(cases):
+        ref = decoding.sample_logits(
+            logits[i:i + 1], keys[i], temperature=t,
+            top_k=k or None, top_p=p or None,
+        )
+        assert int(batched[i]) == int(ref[0]), f"row {i} ({t}, {k}, {p})"

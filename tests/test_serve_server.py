@@ -1,0 +1,194 @@
+"""HTTP front-end tests over a real ephemeral-port server: typed scheduler
+outcomes must surface as status codes (200/400/429/503), and load-shed is
+an HTTP ANSWER, never a hang."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from distributed_tensorflow_tpu.serve import (
+    Request,
+    Scheduler,
+    ServingMetrics,
+    SlotEngine,
+)
+from distributed_tensorflow_tpu.serve.server import make_server
+
+pytestmark = pytest.mark.serve
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    num_heads=4,
+    num_layers=2,
+    d_ff=64,
+    max_seq_len=32,
+    compute_dtype=jnp.float32,
+)
+
+
+def _post(url, payload, timeout=30):
+    """POST JSON; returns (status, parsed body) for 2xx AND error codes."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """Engine + running scheduler + running HTTP server on an OS-chosen
+    port, torn down in order (server first so handlers stop submitting)."""
+    model = TransformerLM(CFG)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = SlotEngine(CFG, params, slots=2, max_len=32, prefill_len=12)
+    metrics = ServingMetrics()
+    sched = Scheduler(engine, max_queue_depth=8, metrics=metrics)
+    server = make_server(sched, port=0, request_timeout_s=30.0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    sched.start(poll_s=0.001)
+    host, port = server.server_address
+    try:
+        yield f"http://{host}:{port}", sched, metrics
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+        sched.stop()
+
+
+def test_generate_roundtrip(stack):
+    base, _, _ = stack
+    status, body = _post(base + "/generate", {
+        "prompt": [3, 1, 4], "max_new_tokens": 5, "request_id": "rt",
+    })
+    assert status == 200
+    assert body["request_id"] == "rt"
+    assert len(body["tokens"]) == 5
+    assert all(0 <= t < CFG.vocab_size for t in body["tokens"])
+    assert body["finish_reason"] == "length"
+    assert body["ttft_ms"] > 0 and body["latency_ms"] >= body["ttft_ms"]
+
+
+def test_generate_matches_direct_submit(stack):
+    """The HTTP path returns exactly what an in-process submit returns."""
+    base, sched, _ = stack
+    direct = sched.submit(
+        Request(prompt=(9, 2, 7), max_new_tokens=4)
+    ).result(timeout=30)
+    _, body = _post(base + "/generate",
+                    {"prompt": [9, 2, 7], "max_new_tokens": 4})
+    assert tuple(body["tokens"]) == direct.tokens
+
+
+def test_invalid_requests_get_400(stack):
+    base, _, _ = stack
+    cases = [
+        {"prompt": []},                                    # empty
+        {"prompt": "text"},                                # string, no codec
+        {"prompt": [1, "a"]},                              # non-int token
+        {"prompt": [1], "max_new_tokens": 0},              # scheduler invalid
+        {"prompt": list(range(13)), "max_new_tokens": 2},  # > prefill_len
+    ]
+    for payload in cases:
+        status, body = _post(base + "/generate", payload)
+        assert status == 400, payload
+        assert body["error"] == "invalid"
+        assert body["detail"]
+    status, body = _post(base + "/generate", {"prompt": [1],
+                                              "deadline_s": -2.0})
+    assert (status, body["error"]) == (400, "invalid")
+
+
+def test_not_found_and_bad_json(stack):
+    base, _, _ = stack
+    status, body = _post(base + "/nope", {"prompt": [1]})
+    assert (status, body["error"]) == (404, "not_found")
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(base + "/nope", timeout=10)
+    assert exc_info.value.code == 404
+    req = urllib.request.Request(
+        base + "/generate", data=b"{not json",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            status = resp.status
+    except urllib.error.HTTPError as err:
+        status, body = err.code, json.loads(err.read())
+    assert (status, body["error"]) == (400, "invalid")
+
+
+def test_healthz_and_metrics(stack):
+    base, _, metrics = stack
+    status, body = _get(base + "/healthz")
+    assert status == 200
+    assert body["ok"] is True and body["slots"] == 2
+    assert 0 <= body["free_slots"] <= 2 and body["queue_depth"] >= 0
+
+    _post(base + "/generate", {"prompt": [5], "max_new_tokens": 3})
+    status, snap = _get(base + "/metrics")
+    assert status == 200
+    assert snap["completed"] >= 1
+    assert snap["ttft_ms"]["count"] >= 1
+    # The endpoint serves the SAME metrics object the scheduler writes to.
+    assert metrics.snapshot()["completed"] >= snap["completed"]
+
+
+def test_queue_full_returns_429():
+    """Sized-to-overflow: a scheduler that is NOT being driven, queue depth
+    1 — the second HTTP submit must get a synchronous 429, not block."""
+    model = TransformerLM(CFG)
+    params = model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = SlotEngine(CFG, params, slots=1, max_len=32, prefill_len=12)
+    sched = Scheduler(engine, max_queue_depth=1)
+    server = make_server(sched, port=0, request_timeout_s=30.0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    host, port = server.server_address
+    base = f"http://{host}:{port}"
+    try:
+        sched.submit(Request(prompt=(1,), max_new_tokens=2))  # fills queue
+        status, body = _post(base + "/generate",
+                             {"prompt": [2], "max_new_tokens": 2}, timeout=10)
+        assert (status, body["error"]) == (429, "queue_full")
+        assert "queue depth" in body["detail"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+        sched.stop()  # sheds the queued filler typed — no hang
+
+
+def test_shutting_down_returns_503(stack):
+    """After scheduler.stop(), submits surface as 503 shutting_down. Runs
+    LAST against the shared stack (it kills its scheduler)."""
+    base, sched, _ = stack
+    sched.stop()
+    status, body = _post(base + "/generate",
+                         {"prompt": [1], "max_new_tokens": 2}, timeout=10)
+    assert (status, body["error"]) == (503, "shutting_down")

@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Load generator for the serving stack: closed- and open-loop arrival.
+
+Two modes of driving, two modes of arrival:
+
+* ``--url http://host:port`` hits a running ``tools/serve_lm.py`` over
+  HTTP. Without ``--url`` it self-serves: builds the demo-weight stack
+  in-process (same wiring via ``serve_lm.build_stack``) and submits
+  straight to the scheduler — no network, which is what CI wants.
+* Closed loop (default): ``--concurrency`` workers, each submitting its
+  next request the moment the previous one finishes — measures capacity.
+  Open loop (``--rate R``): requests fire on a Poisson-ish fixed schedule
+  of R req/s REGARDLESS of completions — measures behavior past
+  saturation, where admission control must shed rather than build an
+  unbounded backlog (the classic closed-loop blind spot).
+
+Every request is accounted for exactly once: completed, shed (typed
+rejection / HTTP 4xx-5xx with a structured body), or errored (transport
+failure, malformed answer — the "dropped without a shed response" bucket).
+``--smoke`` exits nonzero if that last bucket is non-empty or nothing
+completed, making "no request ever hangs or vanishes" a CI property.
+
+Reports p50/p95/p99 TTFT (self-serve mode measures true
+submit-to-first-token; HTTP mode approximates TTFT with full-response
+latency for shorter outputs), aggregate tok/s, and shed counts, as JSON
+on the last stdout line.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _percentiles(xs):
+    if not xs:
+        return {"p50": 0.0, "p95": 0.0, "p99": 0.0}
+    xs = sorted(xs)
+
+    def pick(q):
+        i = min(len(xs) - 1, max(0, round(q / 100 * (len(xs) - 1))))
+        return xs[i]
+
+    return {"p50": pick(50), "p95": pick(95), "p99": pick(99)}
+
+
+class _Accounting:
+    """Every submitted request lands in exactly one bucket."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.completed = 0
+        self.shed = 0
+        self.errored = 0
+        self.tokens = 0
+        self.ttft_s = []
+        self.latency_s = []
+        self.shed_reasons = {}
+
+    def complete(self, ttft_s, latency_s, n_tokens):
+        with self.lock:
+            self.completed += 1
+            self.tokens += n_tokens
+            self.ttft_s.append(ttft_s)
+            self.latency_s.append(latency_s)
+
+    def reject(self, reason):
+        with self.lock:
+            self.shed += 1
+            self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+
+    def error(self):
+        with self.lock:
+            self.errored += 1
+
+
+def _http_submit(url, payload, timeout_s, acct):
+    import urllib.error
+    import urllib.request
+
+    t0 = time.monotonic()
+    req = urllib.request.Request(
+        url + "/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            body = json.loads(resp.read())
+        acct.complete(
+            body.get("ttft_ms", 0.0) / 1e3,
+            time.monotonic() - t0,
+            len(body.get("tokens", ())),
+        )
+    except urllib.error.HTTPError as e:
+        try:
+            reason = json.loads(e.read()).get("error", f"http_{e.code}")
+        except Exception:
+            reason = f"http_{e.code}"
+        # A structured 4xx/5xx IS the shed response — typed, not dropped.
+        acct.reject(reason)
+    except Exception:
+        acct.error()
+
+
+def _sched_submit(scheduler, payload, timeout_s, acct):
+    from distributed_tensorflow_tpu.serve.scheduler import Completion, Request
+
+    pending = scheduler.submit(Request(
+        prompt=tuple(payload["prompt"]),
+        max_new_tokens=payload["max_new_tokens"],
+        temperature=payload.get("temperature", 0.0),
+        top_k=payload.get("top_k", 0),
+        top_p=payload.get("top_p", 0.0),
+        seed=payload.get("seed", 0),
+        deadline_s=payload.get("deadline_s"),
+    ))
+    try:
+        outcome = pending.result(timeout=timeout_s)
+    except TimeoutError:
+        acct.error()
+        return
+    if isinstance(outcome, Completion):
+        acct.complete(outcome.ttft_s, outcome.latency_s, len(outcome.tokens))
+    else:
+        acct.reject(outcome.reason)
+
+
+def run_load(
+    submit_one,
+    *,
+    num_requests,
+    concurrency,
+    rate,
+    make_payload,
+    timeout_s,
+):
+    """Drive ``submit_one(payload)`` for ``num_requests`` requests.
+    ``rate`` > 0 switches to open loop at that many req/s."""
+    acct = _Accounting()
+    threads = []
+    t_start = time.monotonic()
+    if rate and rate > 0:
+        # Open loop: fixed schedule, one thread per in-flight request; late
+        # completions never delay the next arrival.
+        for i in range(num_requests):
+            target = t_start + i / rate
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(
+                target=submit_one, args=(make_payload(i), timeout_s, acct),
+                daemon=True,
+            )
+            th.start()
+            threads.append(th)
+    else:
+        idx_lock = threading.Lock()
+        next_idx = [0]
+
+        def worker():
+            while True:
+                with idx_lock:
+                    i = next_idx[0]
+                    if i >= num_requests:
+                        return
+                    next_idx[0] += 1
+                submit_one(make_payload(i), timeout_s, acct)
+
+        for _ in range(max(1, concurrency)):
+            th = threading.Thread(target=worker, daemon=True)
+            th.start()
+            threads.append(th)
+    for th in threads:
+        th.join(timeout_s + 30.0)
+    wall_s = time.monotonic() - t_start
+    return acct, wall_s
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--url", default="",
+        help="serve_lm endpoint; empty = self-serve demo weights in-process",
+    )
+    parser.add_argument("--num_requests", type=int, default=32)
+    parser.add_argument(
+        "--concurrency", type=int, default=8,
+        help="closed-loop worker count (ignored with --rate)",
+    )
+    parser.add_argument(
+        "--rate", type=float, default=0.0,
+        help="open-loop arrival rate in req/s (0 = closed loop)",
+    )
+    parser.add_argument("--prompt_len", type=int, default=8)
+    parser.add_argument("--max_new_tokens", type=int, default=16)
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument(
+        "--deadline_s", type=float, default=0.0,
+        help="per-request queue-wait deadline (0 = none)",
+    )
+    parser.add_argument("--timeout_s", type=float, default=60.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="CI gate: exit nonzero if any request was dropped without a "
+        "typed shed response, or nothing completed",
+    )
+    # Self-serve engine shape (ignored with --url).
+    parser.add_argument("--slots", type=int, default=4)
+    parser.add_argument("--seq_len", type=int, default=64)
+    parser.add_argument("--steps_per_sync", type=int, default=1)
+    args, _ = parser.parse_known_args(argv)
+
+    import random
+
+    rng = random.Random(args.seed)
+
+    def make_payload(i):
+        # Heterogeneous prompt/output lengths: the serving engine's whole
+        # point is that this mix shares one compiled program.
+        p = rng.randint(1, max(1, args.prompt_len))
+        n = rng.randint(1, max(1, args.max_new_tokens))
+        payload = {
+            "prompt": [rng.randint(0, 255) for _ in range(p)],
+            "max_new_tokens": n,
+            "temperature": args.temperature,
+            "seed": i,
+        }
+        if args.deadline_s > 0:
+            payload["deadline_s"] = args.deadline_s
+        return payload
+
+    scheduler = None
+    if args.url:
+        def submit_one(payload, timeout_s, acct):
+            _http_submit(args.url.rstrip("/"), payload, timeout_s, acct)
+    else:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        import jax.numpy as jnp
+
+        from distributed_tensorflow_tpu.config import ServeConfig
+        from distributed_tensorflow_tpu.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+        from serve_lm import build_stack
+
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=64, num_heads=4, num_layers=2, d_ff=128,
+            max_seq_len=args.seq_len, compute_dtype=jnp.float32,
+        )
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        serve_cfg = ServeConfig(
+            slots=args.slots,
+            serve_max_len=args.seq_len,
+            prefill_len=max(args.prompt_len, args.seq_len // 2),
+            steps_per_sync=args.steps_per_sync,
+        )
+        engine, scheduler, metrics, server = build_stack(serve_cfg, cfg, params)
+        server.server_close()  # wiring only — loadgen submits directly
+        scheduler.start()
+
+        def submit_one(payload, timeout_s, acct):
+            _sched_submit(scheduler, payload, timeout_s, acct)
+
+    acct, wall_s = run_load(
+        submit_one,
+        num_requests=args.num_requests,
+        concurrency=args.concurrency,
+        rate=args.rate,
+        make_payload=make_payload,
+        timeout_s=args.timeout_s,
+    )
+    if scheduler is not None:
+        scheduler.stop()
+
+    accounted = acct.completed + acct.shed + acct.errored
+    report = {
+        "num_requests": args.num_requests,
+        "completed": acct.completed,
+        "shed": acct.shed,
+        "shed_reasons": acct.shed_reasons,
+        "dropped_without_shed": acct.errored + (args.num_requests - accounted),
+        "wall_s": round(wall_s, 4),
+        "throughput_tok_s": round(acct.tokens / wall_s, 2) if wall_s > 0 else 0.0,
+        "ttft_ms": {
+            k: round(v * 1e3, 3) for k, v in _percentiles(acct.ttft_s).items()
+        },
+        "latency_ms": {
+            k: round(v * 1e3, 3)
+            for k, v in _percentiles(acct.latency_s).items()
+        },
+        "mode": "open" if args.rate > 0 else "closed",
+    }
+    print(json.dumps(report))
+    if args.smoke:
+        if report["dropped_without_shed"] > 0:
+            print(
+                f"SMOKE FAIL: {report['dropped_without_shed']} request(s) "
+                "dropped without a typed shed response",
+                file=sys.stderr,
+            )
+            return 1
+        if acct.completed == 0:
+            print("SMOKE FAIL: no request completed", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

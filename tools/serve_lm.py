@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Serve a TransformerLM over HTTP with continuous batching.
+
+Loads a ``tools/train_lm.py`` params bundle (or ``--demo`` random-init
+weights for smoke runs), warms up the slot engine (both jitted programs
+compile before the port opens — no first-request compile stall), and runs
+the ``serve/`` stack: FCFS scheduler on a background thread, stdlib HTTP
+front end, TTFT/per-token metrics (optionally published to TensorBoard).
+
+Example:
+  python tools/serve_lm.py --model lm.msgpack --port 8000 --slots 8
+  curl -s localhost:8000/generate -d '{"prompt": [7,8,9], "max_new_tokens": 16}'
+  curl -s localhost:8000/metrics
+
+Byte-level bundles (vocab 256) also accept ``{"prompt": "text"}`` and
+return decoded ``"text"`` alongside token ids.
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+class _ByteCodec:
+    """String prompt <-> byte-level token ids for vocab-256 models."""
+
+    def encode(self, text):
+        from distributed_tensorflow_tpu.data.text import encode_text
+
+        return [int(t) for t in encode_text(text)]
+
+    def decode(self, tokens):
+        from distributed_tensorflow_tpu.data.text import decode_tokens
+
+        import numpy as np
+
+        return decode_tokens(np.asarray(tokens, np.int32))
+
+
+def build_stack(serve_cfg, cfg, params):
+    """(engine, scheduler, metrics, http server) — warmed up, not started.
+    Factored out so tests and loadgen --self-serve drive the same wiring
+    as the CLI."""
+    from distributed_tensorflow_tpu.serve import (
+        Scheduler,
+        ServingMetrics,
+        SlotEngine,
+    )
+    from distributed_tensorflow_tpu.serve.server import make_server
+
+    engine = SlotEngine(
+        cfg,
+        params,
+        slots=serve_cfg.slots,
+        max_len=serve_cfg.serve_max_len or None,
+        prefill_len=serve_cfg.prefill_len or None,
+        steps_per_sync=serve_cfg.steps_per_sync,
+    )
+    engine.warmup()
+    metrics = ServingMetrics()
+    scheduler = Scheduler(
+        engine,
+        max_queue_depth=serve_cfg.max_queue_depth,
+        metrics=metrics,
+    )
+    codec = _ByteCodec() if cfg.vocab_size == 256 else None
+    server = make_server(
+        scheduler,
+        serve_cfg.host,
+        serve_cfg.port,
+        request_timeout_s=serve_cfg.request_timeout_s,
+        codec=codec,
+    )
+    return engine, scheduler, metrics, server
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="lm.msgpack")
+    parser.add_argument(
+        "--demo", action="store_true",
+        help="serve random-init weights (no bundle needed; smoke/loadgen)",
+    )
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--vocab_size", type=int, default=256)
+    parser.add_argument("--d_model", type=int, default=128)
+    parser.add_argument("--num_heads", type=int, default=4)
+    parser.add_argument("--num_layers", type=int, default=4)
+    parser.add_argument("--d_ff", type=int, default=512)
+    parser.add_argument(
+        "--kv_cache_dtype", default="", choices=("", "int8"),
+        help="KV-pool storage dtype ('' = compute dtype)",
+    )
+    args, rest = parser.parse_known_args(argv)
+
+    from distributed_tensorflow_tpu.config import ServeConfig, parse_flags
+
+    serve_cfg = parse_flags(ServeConfig, argv=rest)
+
+    import jax
+    import jax.numpy as jnp
+
+    if args.demo:
+        from distributed_tensorflow_tpu.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=args.vocab_size,
+            d_model=args.d_model,
+            num_heads=args.num_heads,
+            num_layers=args.num_layers,
+            d_ff=args.d_ff,
+            max_seq_len=args.seq_len,
+            compute_dtype=jnp.float32,
+        )
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+    else:
+        from distributed_tensorflow_tpu.train.checkpoint import load_lm_bundle
+
+        try:
+            cfg, params, _ = load_lm_bundle(
+                args.model,
+                fallback_shapes={
+                    "vocab_size": args.vocab_size,
+                    "d_model": args.d_model,
+                    "num_heads": args.num_heads,
+                    "num_layers": args.num_layers,
+                    "d_ff": args.d_ff,
+                    "max_seq_len": args.seq_len,
+                },
+            )
+        except ValueError as e:
+            sys.exit(str(e))
+    if args.kv_cache_dtype:
+        from dataclasses import replace
+
+        cfg = replace(cfg, kv_cache_dtype=args.kv_cache_dtype)
+
+    engine, scheduler, metrics, server = build_stack(serve_cfg, cfg, params)
+    host, port = server.server_address
+    print(
+        f"serving on http://{host}:{port}  slots={engine.slots} "
+        f"max_len={engine.max_len} prefill_len={engine.prefill_len} "
+        f"compiled={engine.compile_count()}",
+        flush=True,
+    )
+
+    writer = None
+    pub_step = [0]
+    if serve_cfg.serve_log_dir:
+        from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+
+        writer = SummaryWriter(serve_cfg.serve_log_dir)
+
+        def publish_loop():
+            while True:
+                time.sleep(serve_cfg.metrics_interval_s)
+                pub_step[0] += 1
+                metrics.publish(writer, pub_step[0])
+                writer.flush()
+
+        threading.Thread(
+            target=publish_loop, name="serve-metrics", daemon=True
+        ).start()
+
+    scheduler.start()
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        scheduler.stop()
+        if writer is not None:
+            metrics.publish(writer, pub_step[0] + 1)
+            writer.close()
+        print("serve_lm: shut down cleanly", flush=True)
+
+
+if __name__ == "__main__":
+    main()
