@@ -77,6 +77,11 @@ class MnistTrainConfig:
     data_dir: str = field(default="MNIST_data", metadata={"help": "idx .gz directory"})
     log_dir: str = field(default="./logs", metadata={"help": "summaries + autosave ckpts"})
     model_dir: str = field(default="./model", metadata={"help": "final checkpoint dir"})
+    obs_dir: str = field(
+        default="",
+        metadata={"help": "observability output dir (flight-recorder crash "
+                          "dumps + metrics JSONL); empty disables dumps"},
+    )
     training_steps: int = 10000
     batch_size: int = 100
     model: str = field(
@@ -417,6 +422,11 @@ class ServeConfig:
     serve_log_dir: str = field(
         default="",
         metadata={"help": "if set, publish serving metrics to TB events here"},
+    )
+    obs_dir: str = field(
+        default="",
+        metadata={"help": "observability output dir (flight-recorder crash "
+                          "dumps + metrics JSONL); empty disables dumps"},
     )
     metrics_interval_s: float = field(
         default=10.0, metadata={"help": "TB publish period"}

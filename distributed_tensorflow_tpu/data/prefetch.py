@@ -12,13 +12,22 @@ bounded queue: batch assembly and the HBM transfer for step *k+depth* overlap
 the device computation of step *k*. Because JAX dispatch is already
 asynchronous, a queue depth of 2 is enough to keep the TPU busy; deeper queues
 only add HBM pressure (each queued batch is resident on device).
+
+Consumer starvation is MEASURED here, not inferred: ``__next__`` times how
+long it blocks on the queue and records it (plus the queue depth it found)
+into the obs registry — ``data_wait_seconds_total`` is the exact data-wait
+slice of the train loop's step-time decomposition. An empty queue at dequeue
+means the input pipeline, not the device, is the bottleneck.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator
+
+from distributed_tensorflow_tpu import obs
 
 __all__ = [
     "Prefetcher",
@@ -38,6 +47,14 @@ class Prefetcher:
     ``place_fn``  — host→device placement, e.g. ``lambda b: shard_batch(b, mesh)``;
                     runs on the worker thread so the transfer overlaps compute.
     ``depth``     — max device-resident batches queued ahead (≥1).
+    ``registry``  — obs metrics registry to record starvation into (defaults
+                    to the process registry; pass a private one in tests).
+
+    ``starvation_seconds`` accumulates the total time the CONSUMER spent
+    blocked in ``__next__`` waiting for a batch — the host-input slice of
+    step time. The same quantity goes into the registry's
+    ``data_wait_seconds_total`` counter, and the queue depth found at each
+    dequeue into the ``data_queue_depth`` histogram.
 
     Exceptions raised by ``source``/``place_fn`` propagate to the consumer at
     the next ``__next__``. Use as a context manager (or call :meth:`close`) to
@@ -49,10 +66,20 @@ class Prefetcher:
         source: Iterable[Any],
         place_fn: Callable[[Any], Any] | None = None,
         depth: int = 2,
+        registry=None,
     ):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._place = place_fn if place_fn is not None else (lambda x: x)
+        reg = registry if registry is not None else obs.get_registry()
+        self._wait_total = reg.counter(
+            "data_wait_seconds_total",
+            "Seconds the training thread blocked waiting for input batches.")
+        self._depth_hist = reg.histogram(
+            "data_queue_depth",
+            "Prefetch queue depth found at each dequeue (0 = starved).",
+            buckets=(0.0, 1.0, 2.0, 4.0, 8.0))
+        self.starvation_seconds = 0.0
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._done = False
@@ -90,7 +117,16 @@ class Prefetcher:
     def __next__(self) -> Any:
         if self._done:  # sentinel is enqueued once; don't block on a drained queue
             raise StopIteration
-        item = self._q.get()
+        self._depth_hist.observe(float(self._q.qsize()))
+        try:
+            # Fast path: batch already staged — zero measured wait.
+            item = self._q.get_nowait()
+        except queue.Empty:
+            t0 = time.perf_counter()
+            item = self._q.get()
+            waited = time.perf_counter() - t0
+            self.starvation_seconds += waited
+            self._wait_total.inc(waited)
         if item is _SENTINEL:
             self._done = True
             if self._error is not None:

@@ -1,0 +1,137 @@
+"""Lightweight span tracing (Dapper-style, in-process).
+
+``with span("checkpoint_save", step=120):`` measures a region with BOTH
+clocks — wall (``time.time``, for correlating against logs and other hosts)
+and monotonic (``time.monotonic``, for durations that survive NTP steps) —
+and records the closed span into the flight recorder ring buffer
+(:mod:`~distributed_tensorflow_tpu.obs.recorder`), so the last N spans are
+what a crash dump ships.
+
+Nesting is tracked per thread: a span opened inside another span carries its
+``parent_id``, so the dump reconstructs the call tree (emergency_shutdown →
+checkpoint_save → …). Span ids are a process-local counter — unique within
+the process, and the recorded ``process`` index disambiguates across a
+multi-host job's per-process dumps.
+
+This is deliberately NOT the XPlane profiler (``utils/profiler.py``): that
+is a sampled, heavyweight device timeline you turn on for a window; spans
+are an always-on, microsecond-cost breadcrumb trail of HOST-side phases.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any
+
+from distributed_tensorflow_tpu.obs import recorder as _recorder
+
+__all__ = ["Span", "span", "trace_event", "current_span"]
+
+_ids = itertools.count(1)
+_local = threading.local()
+
+
+def _process_index() -> int:
+    """jax.process_index() without importing jax at module import time (the
+    obs package must stay importable — and cheap — in non-JAX tooling)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    try:
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — uninitialized backend
+        return 0
+
+
+def _stack() -> list:
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    return stack
+
+
+def current_span() -> "Span | None":
+    """The innermost open span on THIS thread (None outside any span)."""
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+class Span:
+    """One traced region. Context-manager use only — ``__exit__`` closes the
+    span and records it; an exception inside the region is noted on the span
+    (``error`` field) and re-raised."""
+
+    __slots__ = (
+        "name", "attrs", "span_id", "parent_id", "process",
+        "t_wall", "t_mono", "end_mono", "duration_s", "error",
+    )
+
+    def __init__(self, name: str, attrs: dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        self.span_id = next(_ids)
+        parent = current_span()
+        self.parent_id = parent.span_id if parent is not None else 0
+        self.process = _process_index()
+        self.t_wall = 0.0
+        self.t_mono = 0.0
+        self.end_mono = 0.0
+        self.duration_s = 0.0
+        self.error = ""
+
+    def __enter__(self) -> "Span":
+        self.t_wall = time.time()
+        self.t_mono = time.monotonic()
+        _stack().append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.end_mono = time.monotonic()
+        self.duration_s = self.end_mono - self.t_mono
+        if exc is not None:
+            self.error = f"{type(exc).__name__}: {exc}"
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        _recorder.get_recorder().record_span(self)
+        return None  # never swallow
+
+    def to_event(self) -> dict:
+        ev = {
+            "kind": "span",
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "process": self.process,
+            "t_wall": self.t_wall,
+            "t_mono": self.t_mono,
+            "end_mono": self.end_mono,
+            "duration_s": round(self.duration_s, 6),
+        }
+        if self.attrs:
+            ev["attrs"] = self.attrs
+        if self.error:
+            ev["error"] = self.error
+        return ev
+
+
+def span(name: str, **attrs: Any) -> Span:
+    """Open a traced region: ``with span("eval", step=200): ...``"""
+    return Span(name, attrs)
+
+
+def trace_event(name: str, **attrs: Any) -> None:
+    """Record an instantaneous event (no duration) into the flight recorder
+    — preemption requests, vetoes, rollbacks."""
+    parent = current_span()
+    _recorder.get_recorder().record(
+        kind="event",
+        name=name,
+        process=_process_index(),
+        parent_id=parent.span_id if parent is not None else 0,
+        **attrs,
+    )

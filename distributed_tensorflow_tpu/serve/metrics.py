@@ -1,12 +1,18 @@
 """Serving observability: latency histograms + queue/occupancy gauges.
 
-Host-side and allocation-light — metric updates happen on the scheduler's
-hot path (once per engine round, once per request), so they are plain
-appends into bounded deques; percentile math is deferred to ``snapshot()``
-(the /metrics endpoint, the loadgen report, the bench). ``publish()``
-bridges into the repo's own TensorBoard writer (``utils/summary.py``) so a
-serving run's TTFT / per-token latency show up next to the training runs'
-step-time panels in the same stock TensorBoard.
+Built on the unified :mod:`distributed_tensorflow_tpu.obs` registry — every
+instrument here is a registered family in a PRIVATE
+:class:`~distributed_tensorflow_tpu.obs.registry.MetricsRegistry` (exposed as
+``.registry``), which is what ``serve/server.py`` renders at ``GET /metrics``
+in Prometheus text form. A private registry (rather than the process default)
+keeps concurrently-constructed serving stacks — and tests — isolated from
+each other and from the train-side metrics.
+
+The obs ``Histogram`` (re-exported here for compatibility) locks both its
+writes and its read snapshots, which fixes the old crash: the reservoir used
+to be a bare deque that ``ThreadingHTTPServer`` handler threads iterated via
+``np.percentile`` while the scheduler thread appended — a concurrent-append
+``RuntimeError: deque mutated during iteration`` under scrape load.
 
 The two latencies that matter, measured where the SLO is felt:
 
@@ -20,143 +26,140 @@ The two latencies that matter, measured where the SLO is felt:
 from __future__ import annotations
 
 import threading
-from collections import deque
 
-import numpy as np
+from distributed_tensorflow_tpu.obs.registry import (  # noqa: F401  (re-export)
+    Histogram,
+    MetricsRegistry,
+)
 
 __all__ = ["Histogram", "ServingMetrics"]
 
-
-class Histogram:
-    """Bounded reservoir of float observations with percentile readout.
-
-    Keeps the most recent ``maxlen`` samples (deque semantics — serving
-    metrics should reflect CURRENT behavior, not the warmup transient from
-    an hour ago) while ``count``/``total`` keep exact lifetime aggregates.
-    """
-
-    def __init__(self, maxlen: int = 4096):
-        self._samples: deque[float] = deque(maxlen=maxlen)
-        self.count = 0
-        self.total = 0.0
-
-    def observe(self, value: float) -> None:
-        value = float(value)
-        self._samples.append(value)
-        self.count += 1
-        self.total += value
-
-    def percentile(self, q: float) -> float:
-        """q in [0, 100]; 0.0 when no samples have been observed."""
-        if not self._samples:
-            return 0.0
-        return float(np.percentile(np.asarray(self._samples), q))
-
-    def summary(self) -> dict:
-        s = np.asarray(self._samples) if self._samples else np.zeros(1)
-        return {
-            "count": self.count,
-            "mean": self.total / self.count if self.count else 0.0,
-            "p50": float(np.percentile(s, 50)) if self._samples else 0.0,
-            "p95": float(np.percentile(s, 95)) if self._samples else 0.0,
-            "p99": float(np.percentile(s, 99)) if self._samples else 0.0,
-            "max": float(s.max()) if self._samples else 0.0,
-        }
-
-    def values(self) -> np.ndarray:
-        """Current reservoir contents (for SummaryWriter.add_histogram)."""
-        return np.asarray(self._samples, np.float64)
+# Latency ladder for TTFT / per-token: 1 ms – 10 s (the registry default).
+# Queue depth and occupancy get their own scales below.
+_DEPTH_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+_FRAC_BUCKETS = (0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 1.0)
 
 
 class ServingMetrics:
     """One serving process's counters, gauges, and latency histograms.
 
     Thread-safe (the HTTP server's handler threads observe TTFT while the
-    scheduler thread observes round latencies). Units are seconds
-    internally; ``snapshot()`` reports milliseconds for the latency fields
-    because that is the scale humans read SLOs in.
+    scheduler thread observes round latencies) — each instrument carries its
+    own lock. Units are seconds internally; ``snapshot()`` reports
+    milliseconds for the latency fields because that is the scale humans
+    read SLOs in.
     """
 
     def __init__(self, histogram_maxlen: int = 4096):
-        self._lock = threading.Lock()
-        self.ttft = Histogram(histogram_maxlen)
-        self.per_token = Histogram(histogram_maxlen)
-        self.queue_depth = Histogram(histogram_maxlen)
-        self.occupancy = Histogram(histogram_maxlen)
-        self.queue_depth_peak = 0
-        self.completed = 0
-        self.shed = 0
-        self.tokens_out = 0
+        self.registry = MetricsRegistry()
+        r = self.registry
+        n = histogram_maxlen
+        self.ttft = r.histogram(
+            "serve_ttft_seconds",
+            "Time to first token: submit -> first sampled token.", maxlen=n)
+        self.per_token = r.histogram(
+            "serve_per_token_seconds",
+            "Inter-token gap: engine round time / tokens produced.", maxlen=n)
+        self.queue_depth = r.histogram(
+            "serve_queue_depth",
+            "Admission queue depth observed at submit.",
+            maxlen=n, buckets=_DEPTH_BUCKETS)
+        self.occupancy = r.histogram(
+            "serve_slot_occupancy",
+            "Fraction of engine slots busy, observed each round.",
+            maxlen=n, buckets=_FRAC_BUCKETS)
+        self._completed = r.counter(
+            "serve_completed_total", "Requests finished with a result.")
+        self._shed = r.counter(
+            "serve_shed_total", "Requests rejected or dropped.")
+        self._tokens_out = r.counter(
+            "serve_tokens_out_total", "Valid tokens produced.")
+        self._queue_depth_gauge = r.gauge(
+            "serve_queue_depth_current", "Admission queue depth, last seen.")
+        self._queue_depth_peak = r.gauge(
+            "serve_queue_depth_peak", "Max queue depth seen this process.")
+        self._peak_lock = threading.Lock()
 
     # -- recording (scheduler hot path) -----------------------------------
 
     def record_ttft(self, seconds: float) -> None:
-        with self._lock:
-            self.ttft.observe(seconds)
+        self.ttft.observe(seconds)
 
     def record_round(self, seconds: float, tokens: int) -> None:
         """One engine decode round that produced ``tokens`` valid tokens."""
-        with self._lock:
-            self.tokens_out += int(tokens)
-            if tokens > 0:
-                self.per_token.observe(seconds / tokens)
+        if tokens > 0:
+            self._tokens_out.inc(int(tokens))
+            self.per_token.observe(seconds / tokens)
 
     def record_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self.queue_depth.observe(float(depth))
-            self.queue_depth_peak = max(self.queue_depth_peak, int(depth))
+        self.queue_depth.observe(float(depth))
+        self._queue_depth_gauge.set(float(depth))
+        with self._peak_lock:
+            if depth > self._queue_depth_peak.value:
+                self._queue_depth_peak.set(float(depth))
 
     def record_occupancy(self, frac: float) -> None:
-        with self._lock:
-            self.occupancy.observe(float(frac))
+        self.occupancy.observe(float(frac))
 
     def record_completed(self) -> None:
-        with self._lock:
-            self.completed += 1
+        self._completed.inc()
 
     def record_shed(self) -> None:
-        with self._lock:
-            self.shed += 1
+        self._shed.inc()
+
+    # -- counter readout (kept as plain ints for callers/tests) ------------
+
+    @property
+    def completed(self) -> int:
+        return int(self._completed.value)
+
+    @property
+    def shed(self) -> int:
+        return int(self._shed.value)
+
+    @property
+    def tokens_out(self) -> int:
+        return int(self._tokens_out.value)
+
+    @property
+    def queue_depth_peak(self) -> int:
+        return int(self._queue_depth_peak.value)
 
     # -- readout ----------------------------------------------------------
 
     def snapshot(self) -> dict:
-        """JSON-ready view (the /metrics endpoint and loadgen's report)."""
-        with self._lock:
-            def ms(h: Histogram) -> dict:
-                s = h.summary()
-                return {
-                    k: (v * 1e3 if k != "count" else v) for k, v in s.items()
-                }
+        """JSON-ready view (the /metrics.json endpoint, loadgen's report)."""
+        def ms(h) -> dict:
+            s = h.summary()
+            return {k: (v * 1e3 if k != "count" else v) for k, v in s.items()}
 
-            return {
-                "completed": self.completed,
-                "shed": self.shed,
-                "tokens_out": self.tokens_out,
-                "queue_depth_peak": self.queue_depth_peak,
-                "queue_depth": self.queue_depth.summary(),
-                "slot_occupancy": self.occupancy.summary(),
-                "ttft_ms": ms(self.ttft),
-                "per_token_ms": ms(self.per_token),
-            }
+        return {
+            "completed": self.completed,
+            "shed": self.shed,
+            "tokens_out": self.tokens_out,
+            "queue_depth_peak": self.queue_depth_peak,
+            "queue_depth": self.queue_depth.summary(),
+            "slot_occupancy": self.occupancy.summary(),
+            "ttft_ms": ms(self.ttft),
+            "per_token_ms": ms(self.per_token),
+        }
 
     def publish(self, writer, step: int) -> None:
         """Emit the current state into a ``utils/summary.SummaryWriter``."""
-        with self._lock:
-            scalars = {
-                "serve/completed": float(self.completed),
-                "serve/shed": float(self.shed),
-                "serve/tokens_out": float(self.tokens_out),
-                "serve/queue_depth_peak": float(self.queue_depth_peak),
-                "serve/ttft_p99_ms": self.ttft.percentile(99) * 1e3,
-                "serve/per_token_p50_ms": self.per_token.percentile(50) * 1e3,
-            }
-            hists = {
-                "serve/ttft_s": self.ttft.values(),
-                "serve/per_token_s": self.per_token.values(),
-                "serve/queue_depth": self.queue_depth.values(),
-                "serve/slot_occupancy": self.occupancy.values(),
-            }
+        scalars = {
+            "serve/completed": float(self.completed),
+            "serve/shed": float(self.shed),
+            "serve/tokens_out": float(self.tokens_out),
+            "serve/queue_depth_peak": float(self.queue_depth_peak),
+            "serve/ttft_p99_ms": self.ttft.percentile(99) * 1e3,
+            "serve/per_token_p50_ms": self.per_token.percentile(50) * 1e3,
+        }
+        hists = {
+            "serve/ttft_s": self.ttft.values(),
+            "serve/per_token_s": self.per_token.values(),
+            "serve/queue_depth": self.queue_depth.values(),
+            "serve/slot_occupancy": self.occupancy.values(),
+        }
         writer.add_scalars(scalars, step)
         for tag, vals in hists.items():
             if vals.size:

@@ -392,3 +392,18 @@ class Scheduler:
     @property
     def inflight_count(self) -> int:
         return len(self._inflight)
+
+    @property
+    def accepting(self) -> bool:
+        """False once ``stop()`` has begun — new submits get typed
+        ``shutting_down`` rejections (what /healthz reports as 503)."""
+        with self._lock:
+            return self._accepting
+
+    @property
+    def loop_running(self) -> bool:
+        """True while the ``start()`` background loop thread is alive. A
+        never-started scheduler (externally driven via ``step()``) reports
+        False without being unhealthy — healthz treats a DEAD started
+        thread, not an absent one, as a liveness failure."""
+        return self._thread is not None and self._thread.is_alive()

@@ -28,8 +28,17 @@ Endpoints:
   bad JSON / bad types   400   error="invalid", detail
   =====================  ====  =========================================
 
-* ``GET /healthz`` — 200 ``{"ok": true, "slots": N, "free_slots": M}``
-* ``GET /metrics`` — 200 ``ServingMetrics.snapshot()`` JSON
+* ``GET /healthz`` — 200 ``{"ok": true, ...}`` while serving; **503**
+  ``{"ok": false, ...}`` once the scheduler is shutting down (stopped
+  accepting) or its started loop thread has died. The body always reports
+  ``accepting``, ``loop_running``, slots, and queue depth so a probe's
+  failure reason is one curl away.
+* ``GET /metrics`` — 200 Prometheus text exposition
+  (``text/plain; version=0.0.4``) rendered from the ``ServingMetrics``
+  registry: TTFT / per-token histograms, queue depth, occupancy, and
+  completed/shed/tokens counters.
+* ``GET /metrics.json`` — 200 ``ServingMetrics.snapshot()`` JSON (the
+  pre-Prometheus readout, kept for loadgen and humans).
 """
 
 from __future__ import annotations
@@ -37,6 +46,7 @@ from __future__ import annotations
 import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from distributed_tensorflow_tpu.obs import export as obs_export
 from distributed_tensorflow_tpu.serve.scheduler import Completion, Request
 
 __all__ = ["make_server"]
@@ -99,15 +109,39 @@ def make_server(
             self.end_headers()
             self.wfile.write(data)
 
+        def _send_text(self, code: int, text: str) -> None:
+            data = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):
             if self.path == "/healthz":
-                self._send(200, {
-                    "ok": True,
+                accepting = getattr(scheduler, "accepting", True)
+                thread = getattr(scheduler, "_thread", None)
+                # A never-started scheduler (driven externally via step())
+                # is healthy; a STARTED loop whose thread died is not.
+                loop_ok = thread is None or thread.is_alive()
+                ok = bool(accepting and loop_ok)
+                self._send(200 if ok else 503, {
+                    "ok": ok,
+                    "accepting": bool(accepting),
+                    "loop_running": scheduler.loop_running,
                     "slots": scheduler.engine.slots,
                     "free_slots": scheduler.engine.free_slots,
                     "queue_depth": scheduler.queue_depth,
                 })
             elif self.path == "/metrics":
+                if scheduler.metrics is None:
+                    self._send_text(200, "")
+                else:
+                    self._send_text(
+                        200,
+                        obs_export.prometheus_text(scheduler.metrics.registry),
+                    )
+            elif self.path == "/metrics.json":
                 snap = (scheduler.metrics.snapshot()
                         if scheduler.metrics is not None else {})
                 self._send(200, snap)

@@ -32,6 +32,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 from flax import serialization
 
+from distributed_tensorflow_tpu import obs
 from distributed_tensorflow_tpu.utils import faults
 from distributed_tensorflow_tpu.utils.logging import get_logger
 from distributed_tensorflow_tpu.utils.retry import retry_call
@@ -484,49 +485,52 @@ class CheckpointManager:
         """
         t0 = time.perf_counter()
         try:
-            multi = jax.process_count() > 1
-            busy = self._busy()
-            if busy and skip_if_busy:
-                self._warn_busy(step)
-                return False
-            # Duplicate-step guard WITHOUT draining (the old unconditional
-            # wait_until_finished here head-of-line-blocked the caller for
-            # the whole previous write even when this guard made the call a
-            # no-op): hit when a finished job restarts (restore to step N,
-            # zero-iteration loop, forced re-save of N) or when the timed
-            # gate fires on the very last step before the final save.
-            if step in self._issued or step in self._all_steps():
-                if wait:
+            with obs.span("checkpoint_save", step=int(step), wait=bool(wait)):
+                multi = jax.process_count() > 1
+                busy = self._busy()
+                if busy and skip_if_busy:
+                    self._warn_busy(step)
+                    obs.trace_event("ckpt_skip_busy", step=int(step))
+                    return False
+                # Duplicate-step guard WITHOUT draining (the old
+                # unconditional wait_until_finished here head-of-line-blocked
+                # the caller for the whole previous write even when this
+                # guard made the call a no-op): hit when a finished job
+                # restarts (restore to step N, zero-iteration loop, forced
+                # re-save of N) or when the timed gate fires on the very last
+                # step before the final save.
+                if step in self._issued or step in self._all_steps():
+                    if wait:
+                        self._drain_jobs()
+                        if multi:
+                            self.finalize_pending(block=True)
+                        else:
+                            self._mngr.wait_until_finished()
+                    return True
+                if busy:
+                    # Direct (non-gate) callers keep strict ordering: drain
+                    # the previous save before issuing the next.
                     self._drain_jobs()
+                    if multi:
+                        self.finalize_pending(block=True)
+                self._issued.add(step)
+                if not multi and not self.async_snapshot and not wait:
+                    # ckpt_async=0: the pre-pipeline behavior — synchronous
+                    # device→host fetch on this thread, Orbax's own
+                    # background write overlapping training.
+                    self._orbax_write(step, _savable(state))
+                    return True
+                job = self._make_job(step, state, multi)
+                self._enqueue(job)
+                if wait or not self.async_snapshot:
+                    self._drain_jobs()
+                    if job.error is not None:
+                        raise job.error
                     if multi:
                         self.finalize_pending(block=True)
                     else:
                         self._mngr.wait_until_finished()
                 return True
-            if busy:
-                # Direct (non-gate) callers keep strict ordering: drain the
-                # previous save before issuing the next.
-                self._drain_jobs()
-                if multi:
-                    self.finalize_pending(block=True)
-            self._issued.add(step)
-            if not multi and not self.async_snapshot and not wait:
-                # ckpt_async=0: the pre-pipeline behavior — synchronous
-                # device→host fetch on this thread, Orbax's own background
-                # write overlapping training.
-                self._orbax_write(step, _savable(state))
-                return True
-            job = self._make_job(step, state, multi)
-            self._enqueue(job)
-            if wait or not self.async_snapshot:
-                self._drain_jobs()
-                if job.error is not None:
-                    raise job.error
-                if multi:
-                    self.finalize_pending(block=True)
-                else:
-                    self._mngr.wait_until_finished()
-            return True
         finally:
             self.stall_seconds += time.perf_counter() - t0
 
@@ -730,6 +734,7 @@ class CheckpointManager:
                     n += 1
         if n:
             log.warning("vetoed %d queued checkpoint snapshot(s)", n)
+            obs.trace_event("ckpt_veto", cancelled=n)
         return n
 
     def finalize_pending(self, block: bool = False) -> None:

@@ -17,10 +17,13 @@ mesh it runs over. Structure parity with the reference:
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_tensorflow_tpu import obs
 from distributed_tensorflow_tpu.config import MnistTrainConfig
 from distributed_tensorflow_tpu.data.mnist import DataSet, read_data_sets
 from distributed_tensorflow_tpu.data.prefetch import (
@@ -189,6 +192,35 @@ class MnistTrainer:
         self.total_skipped = 0
         self._preempt: resilience.PreemptionGuard | None = None
 
+        # Observability: crash dumps go to cfg.obs_dir when set, and the
+        # step-time decomposition is published into the process registry at
+        # eval boundaries (counters are window DELTAS of the shared
+        # data-wait counter and the checkpoint stall accumulator — the
+        # compute slice is what's left of the window wall time).
+        if getattr(cfg, "obs_dir", ""):
+            obs.set_dump_dir(cfg.obs_dir)
+        reg = obs.get_registry()
+        self._obs_wait = reg.counter(
+            "data_wait_seconds_total",
+            "Seconds the training thread blocked waiting for input batches.")
+        self._obs_compute = reg.counter(
+            "train_compute_seconds_total",
+            "Window wall time minus data-wait and checkpoint stall.")
+        self._obs_stall = reg.counter(
+            "train_ckpt_stall_seconds_total",
+            "Main-thread seconds blocked inside checkpoint save paths.")
+        self._obs_steps = reg.counter(
+            "train_steps_total", "Optimizer steps completed.")
+        self._obs_skipped = reg.counter(
+            "train_skipped_nonfinite_total",
+            "Steps skipped by the non-finite guard.")
+        self._obs_examples_rate = reg.gauge(
+            "train_examples_per_sec",
+            "Global examples/s over the last drained training window.")
+        self._win_t0 = 0.0
+        self._win_wait_base = 0.0
+        self._win_stall_base = 0.0
+
         # Supervisor parity: init-or-restore from logdir (demo2/train.py:166-176).
         from distributed_tensorflow_tpu.train.checkpoint import restore_replicated
 
@@ -251,6 +283,8 @@ class MnistTrainer:
         guard = resilience.PreemptionGuard() if getattr(cfg, "preempt_save", 1) else None
         if guard is not None:
             self._preempt = guard.install()
+        self._reset_window_obs(step)
+        preempted = False
         try:
             while step < num_steps:
                 try:
@@ -263,6 +297,7 @@ class MnistTrainer:
                         "preemption at step %d — emergency checkpoint, then "
                         "clean exit", p.step,
                     )
+                    preempted = True
                     break
                 except resilience.RollbackRequested as rb:
                     self._rollbacks += 1
@@ -282,7 +317,15 @@ class MnistTrainer:
                 guard.uninstall()
             self._preempt = None
         step = int(jax.device_get(self.global_step))
-        self._maybe_save(step, force=True)
+        if preempted:
+            # The emergency-shutdown span wraps the coordinated forced save
+            # so the flight record a preemption ships shows both: the
+            # shutdown envelope and the checkpoint_save span nested in it.
+            with obs.span("emergency_shutdown", step=step, reason="preempt"):
+                self._maybe_save(step, force=True)
+            resilience.dump_flight_record("preempt")
+        else:
+            self._maybe_save(step, force=True)
         if self.is_chief and self.writer:
             self.writer.flush()
         train_time = clock.elapsed
@@ -359,8 +402,38 @@ class MnistTrainer:
         self.opt_state = state["opt_state"]
         self.global_step = state["global_step"]
         timer.mark(int(step))
+        self._reset_window_obs(int(step))
         log.warning("rolled back to checkpoint step %d (%s)", step, rb)
+        obs.trace_event("rollback", from_step=rb.step, to_step=int(step),
+                        bad_windows=rb.bad_windows)
+        resilience.dump_flight_record("rollback")
         return True
+
+    # -- window observability ---------------------------------------------
+
+    def _reset_window_obs(self, step: int) -> None:
+        self._win_t0 = time.perf_counter()
+        self._win_step_base = step
+        self._win_wait_base = self._obs_wait.value
+        self._win_stall_base = self.ckpt.stall_seconds
+
+    def _publish_window_obs(self, step: int, steps_per_sec: float,
+                            window_skipped: int) -> None:
+        """Decompose the window just drained: wall = data-wait + checkpoint
+        stall + (residual) device compute. The wait/stall slices are deltas
+        of their process accumulators, so they are measured, not inferred."""
+        wall = time.perf_counter() - self._win_t0
+        wait_d = max(self._obs_wait.value - self._win_wait_base, 0.0)
+        stall_d = max(self.ckpt.stall_seconds - self._win_stall_base, 0.0)
+        compute = max(wall - wait_d - stall_d, 0.0)
+        self._obs_compute.inc(compute)
+        self._obs_stall.inc(stall_d)
+        self._obs_steps.inc(max(step - self._win_step_base, 0))
+        if window_skipped:
+            self._obs_skipped.inc(window_skipped)
+        if steps_per_sec > 0:
+            self._obs_examples_rate.set(steps_per_sec * self.global_batch)
+        self._reset_window_obs(step)
 
     def _train_loop(self, prefetch, num_steps: int, step: int, timer: StepTimer) -> None:
         cfg = self.cfg
@@ -489,6 +562,7 @@ class MnistTrainer:
             if faults.fire_step("preempt", [step]):
                 self._preempt.request()
             if self._preempt.should_exit(at_boundary):
+                obs.trace_event("preempt_exit", step=step)
                 raise resilience.Preempted(step)
         window_skipped = 0
         if at_boundary:
@@ -505,9 +579,12 @@ class MnistTrainer:
                 )
             else:
                 self._bad_windows = 0
+            rate = timer.steps_per_sec  # 0.0 until the compile window passes
+            # Decompose the drained window BEFORE eval/summary work so the
+            # compute slice covers training dispatches only.
+            self._publish_window_obs(step, rate, window_skipped)
             test_acc, test_loss = self.evaluate(self.datasets.test)
             train_acc, _ = self.evaluate(self.datasets.train, max_examples=10000)
-            rate = timer.steps_per_sec  # 0.0 until the compile window passes
             log.info(
                 "step %d: batch loss %.4f, test acc %.4f, train acc %.4f (%s)",
                 step, float(m["loss"]), test_acc, train_acc,
@@ -565,6 +642,7 @@ class MnistTrainer:
             # drops the partial window — steps AND time — so the next
             # boundary doesn't attribute full-window steps to partial time).
             timer.mark(step)
+            self._reset_window_obs(step)
 
     def _maybe_save(self, step: int, force: bool = False, at_eval_boundary: bool = True) -> bool:
         from distributed_tensorflow_tpu.train.checkpoint import coordinated_maybe_save

@@ -23,6 +23,10 @@ lost up to ``save_model_secs`` of work. Here preemption is first-class:
   saves, and bad eval windows veto queued snapshots
   (``CheckpointManager.veto_pending``) so the chain never advances into the
   divergence.
+* Flight recording: both failure paths call :func:`dump_flight_record`, which
+  writes the obs ring buffer (last-N spans/events — checkpoint saves, the
+  emergency-shutdown span, rollback events) as JSONL into the configured
+  ``--obs_dir``, so a preempted or diverged run ships its own timeline.
 
 Signal handlers only install in the main thread (Python restriction); off
 the main thread the guard degrades to poll-only (tests can still call
@@ -37,9 +41,20 @@ import threading
 import jax
 import numpy as np
 
+from distributed_tensorflow_tpu.obs import recorder as _flight
 from distributed_tensorflow_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+def dump_flight_record(reason: str) -> str | None:
+    """Dump the process flight recorder into the configured obs dump dir
+    (``obs.set_dump_dir`` / ``--obs_dir``). No-op (returns None) when no dump
+    dir is set; best-effort on I/O errors — this runs on failure paths."""
+    path = _flight.dump_to_dir(reason)
+    if path is not None:
+        log.info("flight record (%s) -> %s", reason, path)
+    return path
 
 
 class Preempted(Exception):

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_tensorflow_tpu import obs
 from distributed_tensorflow_tpu.config import RetrainConfig
 from distributed_tensorflow_tpu.data import bottleneck as B
 from distributed_tensorflow_tpu.data import images as I
@@ -227,20 +228,21 @@ class RetrainTrainer:
         per-worker full duplication)."""
         if self.do_distort:
             return 0
-        if self.process_count == 1:
-            return B.cache_bottlenecks(
-                self.extractor, self.image_lists, self.cfg.image_dir, self.cfg.bottleneck_dir
+        with obs.span("cache_all_bottlenecks"):
+            if self.process_count == 1:
+                return B.cache_bottlenecks(
+                    self.extractor, self.image_lists, self.cfg.image_dir, self.cfg.bottleneck_dir
+                )
+            # Stride-sharded caching: process p takes labels p, p+P, p+2P, ...
+            labels = sorted(self.image_lists.keys())
+            mine = {k: self.image_lists[k] for k in labels[self.process_index :: self.process_count]}
+            created = B.cache_bottlenecks(
+                self.extractor, mine, self.cfg.image_dir, self.cfg.bottleneck_dir
             )
-        # Stride-sharded caching: process p takes labels p, p+P, p+2P, ...
-        labels = sorted(self.image_lists.keys())
-        mine = {k: self.image_lists[k] for k in labels[self.process_index :: self.process_count]}
-        created = B.cache_bottlenecks(
-            self.extractor, mine, self.cfg.image_dir, self.cfg.bottleneck_dir
-        )
-        from distributed_tensorflow_tpu.parallel.distributed import barrier
+            from distributed_tensorflow_tpu.parallel.distributed import barrier
 
-        barrier("bottleneck_cache")
-        return created
+            barrier("bottleneck_cache")
+            return created
 
     def _sample(self, how_many: int, category: str):
         cfg = self.cfg
@@ -293,6 +295,12 @@ class RetrainTrainer:
         train_bs = -(-cfg.train_batch_size // self.mesh_size) * self.mesh_size
 
         step = int(jax.device_get(self.global_step))
+        reg = obs.get_registry()
+        obs_steps = reg.counter(
+            "retrain_steps_total", "Head-training optimizer steps completed.")
+        obs_skipped = reg.counter(
+            "retrain_skipped_nonfinite_total",
+            "Head-training steps skipped by the non-finite guard.")
         with resilience.PreemptionGuard() as guard:
             while step < cfg.training_steps:
                 bottlenecks, truths, _ = self._sample(train_bs, "training")
@@ -311,6 +319,7 @@ class RetrainTrainer:
                 if skipped is not None:
                     self._window_skips.append(skipped)
                 step += 1
+                obs_steps.inc()
                 is_last = step == cfg.training_steps
                 at_boundary = step % cfg.eval_step_interval == 0 or is_last
                 if faults.fire_step("preempt", [step]):
@@ -320,7 +329,10 @@ class RetrainTrainer:
                         "preemption at step %d — emergency checkpoint, then "
                         "clean stop", step,
                     )
-                    self._maybe_save(step, force=True)
+                    with obs.span("emergency_shutdown", step=step,
+                                  reason="preempt"):
+                        self._maybe_save(step, force=True)
+                    resilience.dump_flight_record("preempt")
                     break
                 window_skipped = 0
                 if at_boundary:
@@ -330,6 +342,7 @@ class RetrainTrainer:
                     )))
                     self.total_skipped += window_skipped
                     if window_skipped:
+                        obs_skipped.inc(window_skipped)
                         self._bad_windows += 1
                         log.warning(
                             "eval window ending at step %d skipped %d "
@@ -366,6 +379,9 @@ class RetrainTrainer:
                                 "step %d after %d bad window(s)",
                                 rb_step, cfg.rollback_bad_windows,
                             )
+                            obs.trace_event("rollback", from_step=step,
+                                            to_step=int(rb_step))
+                            resilience.dump_flight_record("rollback")
                             step = int(rb_step)
                             continue
                 # Bad windows don't advance the checkpoint chain (rollback
@@ -430,18 +446,19 @@ class RetrainTrainer:
         """Params bundle + labels txt (frozen-graph export parity,
         ``retrain1/retrain.py:470-475``)."""
         cfg = self.cfg
-        export_inference_bundle(
-            cfg.output_graph,
-            jax.device_get(self.params),
-            labels=list(self.image_lists.keys()),
-            labels_path=cfg.output_labels,
-            metadata={
-                "model": "BottleneckHead",
-                "num_classes": self.class_count,
-                "final_tensor_name": cfg.final_tensor_name,
-                "bottleneck_size": iv3.BOTTLENECK_SIZE,
-            },
-        )
+        with obs.span("export", path=cfg.output_graph):
+            export_inference_bundle(
+                cfg.output_graph,
+                jax.device_get(self.params),
+                labels=list(self.image_lists.keys()),
+                labels_path=cfg.output_labels,
+                metadata={
+                    "model": "BottleneckHead",
+                    "num_classes": self.class_count,
+                    "final_tensor_name": cfg.final_tensor_name,
+                    "bottleneck_size": iv3.BOTTLENECK_SIZE,
+                },
+            )
         log.info("exported %s and %s", cfg.output_graph, cfg.output_labels)
         if cfg.export_stablehlo:
             from distributed_tensorflow_tpu.train.checkpoint import export_frozen_classifier

@@ -1,0 +1,488 @@
+"""Observability subsystem tests: registry instruments, concurrent
+scrape-while-write safety (the race that crashed the old serve Histogram),
+Prometheus text round-trip through the minimal parser, span tracing, the
+flight-recorder ring, data-pipeline starvation accounting, and the
+acceptance-criterion end-to-end: a DTT_FAULT-injected preemption leaves a
+valid JSONL flight record containing the checkpoint-save and
+emergency-shutdown spans."""
+
+import json
+import math
+import os
+import threading
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu import obs
+from distributed_tensorflow_tpu.obs import export as obs_export
+from distributed_tensorflow_tpu.obs import recorder as obs_recorder
+from distributed_tensorflow_tpu.obs.registry import (
+    Histogram,
+    MetricsRegistry,
+    NullRegistry,
+)
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs_state():
+    """Every test gets a fresh flight recorder and no dump dir; the process
+    default registry is swapped for a fresh one so cross-test metric names
+    never collide."""
+    prev_recorder = obs.get_recorder()
+    prev_dump_dir = obs_recorder.get_dump_dir()
+    prev_registry = obs.get_registry()
+    obs.set_recorder(obs_recorder.FlightRecorder())
+    obs.set_dump_dir("")
+    obs.set_registry(MetricsRegistry())
+    yield
+    obs.set_recorder(prev_recorder)
+    obs.set_dump_dir(prev_dump_dir)
+    obs.set_registry(prev_registry)
+
+
+# ---------------------------------------------------------------------------
+# registry instruments
+# ---------------------------------------------------------------------------
+
+
+def test_counter_monotonic_and_rejects_negative():
+    reg = MetricsRegistry()
+    c = reg.counter("steps_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value == 3.5
+
+
+def test_gauge_set_inc_dec():
+    g = MetricsRegistry().gauge("depth")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3.0
+
+
+def test_histogram_percentiles_and_lifetime_counts():
+    h = Histogram(maxlen=8, buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 4 and h.total == pytest.approx(55.55)
+    assert h.percentile(0) == pytest.approx(0.05)
+    assert h.percentile(100) == pytest.approx(50.0)
+    # Cumulative bucket semantics; 50.0 only lands in the implicit +Inf.
+    assert h.buckets() == [(0.1, 1), (1.0, 2), (10.0, 3)]
+    s = h.summary()
+    assert s["count"] == 4 and s["max"] == 50.0
+    assert s["mean"] == pytest.approx(55.55 / 4)
+
+
+def test_histogram_reservoir_bounded_but_lifetime_exact():
+    h = Histogram(maxlen=4, buckets=(100.0,))
+    for i in range(10):
+        h.observe(float(i))
+    assert h.count == 10  # lifetime, not reservoir
+    assert list(h.values()) == [6.0, 7.0, 8.0, 9.0]  # most recent maxlen
+
+
+def test_registry_idempotent_and_kind_conflict():
+    reg = MetricsRegistry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x")
+
+
+def test_labeled_family_children():
+    reg = MetricsRegistry()
+    fam = reg.counter("req_total", labels=("verb",))
+    fam.labels("get").inc(2)
+    fam.labels(verb="post").inc()
+    assert fam.labels("get").value == 2.0
+    assert fam.labels("post").value == 1.0
+    with pytest.raises(ValueError):
+        fam.inc()  # labeled family has no unlabeled proxy
+    with pytest.raises(ValueError):
+        fam.labels("a", "b")  # wrong arity
+
+
+def test_null_registry_is_inert():
+    reg = NullRegistry()
+    c = reg.counter("a")
+    h = reg.histogram("b")
+    c.inc(5)
+    h.observe(1.0)
+    assert c.value == 0.0 and h.count == 0
+    assert h.labels("anything") is h
+    assert h.summary()["p99"] == 0.0
+    assert reg.collect() == []
+
+
+def test_obs_disable_enable_swaps_process_registry():
+    obs.disable()
+    assert isinstance(obs.get_registry(), NullRegistry)
+    obs.get_registry().counter("ignored").inc()
+    obs.enable()
+    assert isinstance(obs.get_registry(), MetricsRegistry)
+
+
+# ---------------------------------------------------------------------------
+# satellite (a): scrape-while-write hammer — the old serve Histogram race
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_hammer_concurrent_observe_and_read():
+    """A writer thread observes continuously while the reader loops every
+    read path (percentile / summary / values / buckets). The old deque-based
+    Histogram died here with 'deque mutated during iteration'."""
+    h = Histogram(maxlen=256)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            try:
+                h.observe(i % 100 * 1e-3)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            i += 1
+
+    th = threading.Thread(target=writer, daemon=True)
+    th.start()
+    deadline = time.monotonic() + 1.0
+    while time.monotonic() < deadline:
+        h.percentile(99)
+        h.summary()
+        h.values()
+        h.buckets()
+    stop.set()
+    th.join(5.0)
+    assert not errors
+    assert h.count > 0
+
+
+def test_serving_metrics_hammer_scrape_while_record():
+    """Same contract one level up: ServingMetrics snapshot + Prometheus
+    render while a recorder thread hammers every record_* path."""
+    from distributed_tensorflow_tpu.serve.metrics import ServingMetrics
+
+    m = ServingMetrics(histogram_maxlen=128)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            try:
+                m.record_ttft(0.01)
+                m.record_round(0.002, tokens=3)
+                m.record_occupancy(0.5)
+                m.record_queue_depth(i % 7)
+                m.record_completed()
+                m.record_shed()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            i += 1
+
+    th = threading.Thread(target=writer, daemon=True)
+    th.start()
+    deadline = time.monotonic() + 1.0
+    while time.monotonic() < deadline:
+        m.snapshot()
+        obs_export.prometheus_text(m.registry)
+    stop.set()
+    th.join(5.0)
+    assert not errors
+    snap = m.snapshot()
+    assert snap["completed"] > 0 and snap["ttft_ms"]["count"] > 0
+
+
+# ---------------------------------------------------------------------------
+# satellite (c): Prometheus text round-trips through the minimal parser
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_round_trip_all_three_kinds():
+    reg = MetricsRegistry()
+    reg.counter("jobs_total", "jobs", labels=("kind",)).labels("a").inc(3)
+    reg.counter("jobs_total").labels("b").inc(1)
+    reg.gauge("queue_depth", "depth").set(7)
+    h = reg.histogram("latency_seconds", "lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+
+    text = obs_export.prometheus_text(reg)
+    assert "# TYPE jobs_total counter" in text
+    assert "# TYPE queue_depth gauge" in text
+    assert "# TYPE latency_seconds histogram" in text
+
+    samples = obs_export.parse_prometheus_text(text)
+    by = {}
+    for s in samples:
+        by[(s["name"], tuple(sorted(s["labels"].items())))] = s["value"]
+
+    assert by[("jobs_total", (("kind", "a"),))] == 3
+    assert by[("jobs_total", (("kind", "b"),))] == 1
+    assert by[("queue_depth", ())] == 7
+    # Histogram series: cumulative buckets, +Inf == _count == lifetime count.
+    assert by[("latency_seconds_bucket", (("le", "0.1"),))] == 1
+    assert by[("latency_seconds_bucket", (("le", "1"),))] == 2
+    inf_key = ("latency_seconds_bucket", (("le", "+Inf"),))
+    assert inf_key in by and by[inf_key] == 3
+    assert by[("latency_seconds_count", ())] == 3
+    assert by[("latency_seconds_sum", ())] == pytest.approx(5.55)
+
+
+def test_prometheus_parser_handles_escapes_and_inf():
+    reg = MetricsRegistry()
+    reg.gauge("g", labels=("path",)).labels('a"b\\c,d').set(1)
+    samples = obs_export.parse_prometheus_text(obs_export.prometheus_text(reg))
+    assert samples[0]["labels"]["path"] == 'a"b\\c,d'
+    inf = obs_export.parse_prometheus_text('x_bucket{le="+Inf"} 4\n')
+    assert inf[0]["labels"]["le"] == "+Inf" and inf[0]["value"] == 4
+    assert math.isinf(
+        obs_export.parse_prometheus_text("y +Inf\n")[0]["value"]
+    )
+
+
+def test_jsonl_snapshot_appends_valid_lines(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("c").inc(2)
+    reg.histogram("h").observe(0.5)
+    path = str(tmp_path / "m.jsonl")
+    obs_export.write_jsonl_snapshot(path, reg)
+    reg.counter("c").inc()
+    obs_export.write_jsonl_snapshot(path, reg)
+    lines = [json.loads(ln) for ln in open(path)]
+    assert len(lines) == 2
+    assert lines[0]["metrics"]["c"]["samples"][0]["value"] == 2
+    assert lines[1]["metrics"]["c"]["samples"][0]["value"] == 3
+    assert lines[0]["metrics"]["h"]["samples"][0]["count"] == 1
+    assert lines[1]["t_wall"] >= lines[0]["t_wall"]
+
+
+# ---------------------------------------------------------------------------
+# span tracing
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_parent_ids_and_clocks():
+    with obs.span("outer", step=1) as outer:
+        assert obs.current_span() is outer
+        with obs.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            obs.trace_event("tick", n=3)
+        time.sleep(0.01)
+    assert obs.current_span() is None
+    assert outer.parent_id == 0
+    assert outer.duration_s >= 0.01
+    assert outer.t_wall > 0 and outer.end_mono >= outer.t_mono
+
+    events = obs.get_recorder().events()
+    names = [e["name"] for e in events]
+    assert names == ["tick", "inner", "outer"]  # close order, event inline
+    tick, inner_ev, outer_ev = events
+    assert tick["kind"] == "event" and tick["parent_id"] == inner.span_id
+    assert inner_ev["kind"] == "span"
+    assert inner_ev["parent_id"] == outer.span_id
+    assert outer_ev["attrs"] == {"step": 1}
+    assert "error" not in outer_ev
+
+
+def test_span_records_error_and_reraises():
+    with pytest.raises(RuntimeError, match="boom"):
+        with obs.span("failing"):
+            raise RuntimeError("boom")
+    assert obs.current_span() is None  # stack unwound
+    (ev,) = obs.get_recorder().events()
+    assert ev["error"] == "RuntimeError: boom"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_ring_evicts_oldest_but_seq_keeps_counting():
+    rec = obs_recorder.FlightRecorder(capacity=3)
+    for i in range(5):
+        rec.record(kind="event", name=f"e{i}")
+    events = rec.events()
+    assert [e["name"] for e in events] == ["e2", "e3", "e4"]
+    assert [e["seq"] for e in events] == [3, 4, 5]  # eviction is visible
+
+
+def test_dump_is_valid_jsonl_and_survives_unserializable_attrs(tmp_path):
+    rec = obs_recorder.FlightRecorder(capacity=8)
+    rec.record(kind="event", name="ok", payload={"x": 1})
+    rec.record(kind="event", name="weird", payload=object())  # default=str
+    path = str(tmp_path / "sub" / "dump.jsonl")  # parent dir created
+    assert rec.dump(path, reason="test") == path
+    lines = [json.loads(ln) for ln in open(path)]
+    header, *events = lines
+    assert header["kind"] == "flight_record"
+    assert header["reason"] == "test"
+    assert header["num_events"] == 2 and header["capacity"] == 8
+    assert [e["name"] for e in events] == ["ok", "weird"]
+
+
+def test_dump_to_dir_disabled_without_dump_dir(tmp_path):
+    obs.get_recorder().record(kind="event", name="x")
+    assert obs_recorder.dump_to_dir("nope") is None
+    obs.set_dump_dir(str(tmp_path))
+    path = obs_recorder.dump_to_dir("some/unsafe reason!")
+    assert path is not None and os.path.exists(path)
+    assert "some_unsafe_reason_" in os.path.basename(path)  # sanitized
+
+
+def test_excepthook_dumps_timeline(tmp_path, capsys):
+    import sys
+
+    obs.set_dump_dir(str(tmp_path))
+    obs.install_excepthook()
+    obs.install_excepthook()  # idempotent
+    with obs.span("doomed"):
+        pass
+    try:
+        raise ValueError("unhandled-test")
+    except ValueError:
+        sys.excepthook(*sys.exc_info())
+    capsys.readouterr()  # swallow the chained default traceback print
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flight_unhandled_exception")]
+    assert len(dumps) == 1
+    lines = [json.loads(ln) for ln in open(tmp_path / dumps[0])]
+    names = [e.get("name") for e in lines[1:]]
+    assert "doomed" in names and "unhandled_exception" in names
+    exc_ev = next(e for e in lines[1:] if e["name"] == "unhandled_exception")
+    assert exc_ev["error"] == "ValueError: unhandled-test"
+
+
+# ---------------------------------------------------------------------------
+# satellite (b): data-pipeline consumer starvation is measured, not inferred
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_slow_source_records_starvation():
+    from distributed_tensorflow_tpu.data.prefetch import Prefetcher
+
+    def slow_source():
+        for i in range(5):
+            time.sleep(0.03)
+            yield i
+
+    reg = MetricsRegistry()
+    with Prefetcher(slow_source(), depth=2, registry=reg) as pf:
+        assert list(pf) == [0, 1, 2, 3, 4]
+        assert pf.starvation_seconds > 0.0
+    wait = reg.counter("data_wait_seconds_total")
+    assert wait.value == pytest.approx(pf.starvation_seconds)
+    # The consumer found an empty queue at least once.
+    depth = reg.histogram("data_queue_depth")
+    assert depth.count == 6  # 5 items + the sentinel dequeue
+    assert depth.percentile(0) == 0.0
+
+
+def test_prefetch_fast_source_near_zero_starvation():
+    from distributed_tensorflow_tpu.data.prefetch import Prefetcher
+
+    reg = MetricsRegistry()
+    with Prefetcher(iter(range(20)), depth=4, registry=reg) as pf:
+        out = []
+        for item in pf:
+            time.sleep(0.005)  # consumer is the bottleneck
+            out.append(item)
+    assert out == list(range(20))
+    # Only the warmup dequeue may block measurably; steady state never does.
+    assert pf.starvation_seconds < 0.05
+    assert reg.counter("data_wait_seconds_total").value == pytest.approx(
+        pf.starvation_seconds
+    )
+
+
+# ---------------------------------------------------------------------------
+# acceptance criterion: preemption leaves a usable flight record
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fault
+def test_preempt_dump_contains_shutdown_and_ckpt_spans(tmp_path):
+    """DTT_FAULT-injected preemption: training stops at the boundary, and the
+    obs_dir receives a valid JSONL flight record whose span timeline includes
+    BOTH the emergency_shutdown span and the checkpoint_save span nested
+    under it, in monotonically increasing order."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.config import MnistTrainConfig
+    from distributed_tensorflow_tpu.data.mnist import read_data_sets
+    from distributed_tensorflow_tpu.models.mnist_cnn import MnistCNN
+    from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+    from distributed_tensorflow_tpu.train.loop import MnistTrainer
+    from distributed_tensorflow_tpu.utils import faults
+
+    datasets = read_data_sets(
+        "/nonexistent", synthetic=True,
+        num_synthetic_train=256, num_synthetic_test=64,
+    )
+    cfg = MnistTrainConfig(
+        data_dir=str(tmp_path / "none"),
+        log_dir=str(tmp_path / "logs"),
+        model_dir=str(tmp_path / "model"),
+        obs_dir=str(tmp_path / "obs"),
+        batch_size=32,
+        learning_rate=1e-3,
+        synthetic_data=True,
+        save_model_secs=3600,
+        training_steps=10,
+        eval_step_interval=5,
+        seed=0,
+    )
+    faults.configure("preempt:step=5")
+    try:
+        trainer = MnistTrainer(
+            cfg,
+            mesh=make_mesh(num_devices=1),
+            datasets=datasets,
+            model=MnistCNN(compute_dtype=jnp.float32, dropout_rate=0.1),
+        )
+        stats = trainer.train()
+    finally:
+        faults.reset()
+    assert stats["steps"] == 5
+    assert trainer.ckpt.latest_step() == 5  # the emergency save happened
+
+    dumps = [f for f in os.listdir(tmp_path / "obs")
+             if f.startswith("flight_preempt")]
+    assert len(dumps) == 1, dumps
+    lines = [json.loads(ln) for ln in open(tmp_path / "obs" / dumps[0])]
+    header, *events = lines
+    assert header["kind"] == "flight_record" and header["reason"] == "preempt"
+    assert header["num_events"] == len(events) > 0
+
+    # Monotonic ordering: seq strictly increases, and span close times
+    # (the order spans are recorded in) never go backwards.
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    spans = [e for e in events if e["kind"] == "span"]
+    ends = [s["end_mono"] for s in spans]
+    assert ends == sorted(ends)
+
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    assert "emergency_shutdown" in by_name, sorted(by_name)
+    assert "checkpoint_save" in by_name, sorted(by_name)
+    shutdown = by_name["emergency_shutdown"][-1]
+    assert shutdown["attrs"]["reason"] == "preempt"
+    # The emergency save is the checkpoint_save nested under the shutdown.
+    nested = [s for s in by_name["checkpoint_save"]
+              if s["parent_id"] == shutdown["span_id"]]
+    assert nested, "emergency save span not parented under emergency_shutdown"
+    # The preempt request itself left its breadcrumb.
+    assert any(e.get("name") == "preempt_exit" for e in events)

@@ -84,6 +84,33 @@ def test_open_loop_2x_overload_sheds_typed(loadgen, capsys):
     assert set(report["shed_reasons"]) <= {"deadline", "queue_full"}
 
 
+@pytest.mark.obs
+def test_report_file_emits_one_parseable_jsonl_record(loadgen, capsys, tmp_path):
+    """--report_file appends exactly one machine-parseable JSONL record per
+    run, carrying the latency percentiles (p50/p95/p99) the obs subsystem
+    promises downstream tooling."""
+    report_path = tmp_path / "loadgen.jsonl"
+    rc = loadgen.main(["--num_requests", "6", "--concurrency", "3",
+                       "--report_file", str(report_path), *_SHAPE])
+    stdout_report = _last_json(capsys)
+    assert rc == 0
+    lines = report_path.read_text().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec == stdout_report  # the file record IS the stdout record
+    for field in ("ttft_ms", "latency_ms"):
+        assert set(rec[field]) == {"p50", "p95", "p99"}
+        assert rec[field]["p99"] >= rec[field]["p95"] >= rec[field]["p50"]
+    assert rec["completed"] == 6
+    assert rec["t_wall"] > 0 and rec["slots"] == 2
+    # A second run APPENDS (trend accumulation), never truncates.
+    rc = loadgen.main(["--num_requests", "2", "--concurrency", "2",
+                       "--report_file", str(report_path), *_SHAPE])
+    capsys.readouterr()
+    assert rc == 0
+    assert len(report_path.read_text().splitlines()) == 2
+
+
 def test_unreachable_url_is_dropped_and_exits_nonzero(loadgen, capsys):
     """Transport failures are NOT typed sheds: they land in
     dropped_without_shed and --smoke must exit 1."""

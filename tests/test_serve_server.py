@@ -50,8 +50,17 @@ def _post(url, payload, timeout=30):
 
 
 def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
+
+
+def _get_text(url, timeout=10):
     with urllib.request.urlopen(url, timeout=timeout) as resp:
-        return resp.status, json.loads(resp.read())
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode()
 
 
 @pytest.fixture(scope="module")
@@ -146,15 +155,50 @@ def test_healthz_and_metrics(stack):
     status, body = _get(base + "/healthz")
     assert status == 200
     assert body["ok"] is True and body["slots"] == 2
+    assert body["accepting"] is True and body["loop_running"] is True
     assert 0 <= body["free_slots"] <= 2 and body["queue_depth"] >= 0
 
     _post(base + "/generate", {"prompt": [5], "max_new_tokens": 3})
-    status, snap = _get(base + "/metrics")
+    status, snap = _get(base + "/metrics.json")
     assert status == 200
     assert snap["completed"] >= 1
     assert snap["ttft_ms"]["count"] >= 1
     # The endpoint serves the SAME metrics object the scheduler writes to.
     assert metrics.snapshot()["completed"] >= snap["completed"]
+
+
+def test_metrics_prometheus_text(stack):
+    """GET /metrics is the Prometheus text exposition: parseable, and
+    covering the latency histograms, queue/occupancy, and the counters."""
+    from distributed_tensorflow_tpu.obs.export import parse_prometheus_text
+
+    base, _, _ = stack
+    _post(base + "/generate", {"prompt": [2, 3], "max_new_tokens": 3})
+    status, ctype, text = _get_text(base + "/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    assert "# TYPE serve_ttft_seconds histogram" in text
+    samples = {s["name"]: s for s in parse_prometheus_text(text)}
+    for name in (
+        "serve_ttft_seconds_count",
+        "serve_ttft_seconds_sum",
+        "serve_per_token_seconds_count",
+        "serve_queue_depth_count",
+        "serve_slot_occupancy_count",
+        "serve_completed_total",
+        "serve_shed_total",
+        "serve_tokens_out_total",
+        "serve_queue_depth_current",
+    ):
+        assert name in samples, f"missing {name} in /metrics"
+    assert samples["serve_completed_total"]["value"] >= 1
+    assert samples["serve_ttft_seconds_count"]["value"] >= 1
+    # Histogram buckets carry the le label and are cumulative.
+    buckets = [s for s in parse_prometheus_text(text)
+               if s["name"] == "serve_ttft_seconds_bucket"]
+    assert buckets and buckets[-1]["labels"]["le"] == "+Inf"
+    counts = [s["value"] for s in buckets]
+    assert counts == sorted(counts)
 
 
 def test_queue_full_returns_429():
@@ -188,7 +232,12 @@ def test_shutting_down_returns_503(stack):
     """After scheduler.stop(), submits surface as 503 shutting_down. Runs
     LAST against the shared stack (it kills its scheduler)."""
     base, sched, _ = stack
+    status, body = _get(base + "/healthz")
+    assert (status, body["ok"]) == (200, True)
     sched.stop()
     status, body = _post(base + "/generate",
                          {"prompt": [1], "max_new_tokens": 2}, timeout=10)
     assert (status, body["error"]) == (503, "shutting_down")
+    status, body = _get(base + "/healthz")
+    assert (status, body["ok"]) == (503, False)
+    assert body["accepting"] is False

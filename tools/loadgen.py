@@ -210,6 +210,12 @@ def main(argv=None):
         help="CI gate: exit nonzero if any request was dropped without a "
         "typed shed response, or nothing completed",
     )
+    parser.add_argument(
+        "--report_file", default="LOADGEN_LAST.jsonl",
+        help="append the machine-parseable report record here as one JSONL "
+             "line (bench.py's BENCH_LAST.json convention — appended, so "
+             "serving-latency trends accumulate across runs; '' disables)",
+    )
     # Self-serve engine shape (ignored with --url).
     parser.add_argument("--slots", type=int, default=4)
     parser.add_argument("--seq_len", type=int, default=64)
@@ -299,8 +305,16 @@ def main(argv=None):
             for k, v in _percentiles(acct.latency_s).items()
         },
         "mode": "open" if args.rate > 0 else "closed",
+        "t_wall": time.time(),
+        "concurrency": args.concurrency,
+        "rate": args.rate,
+        "slots": args.slots,
+        "url": args.url,
     }
     print(json.dumps(report))
+    if args.report_file:
+        with open(args.report_file, "a") as f:
+            f.write(json.dumps(report) + "\n")
     if args.smoke:
         if report["dropped_without_shed"] > 0:
             print(

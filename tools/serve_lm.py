@@ -10,7 +10,13 @@ front end, TTFT/per-token metrics (optionally published to TensorBoard).
 Example:
   python tools/serve_lm.py --model lm.msgpack --port 8000 --slots 8
   curl -s localhost:8000/generate -d '{"prompt": [7,8,9], "max_new_tokens": 16}'
-  curl -s localhost:8000/metrics
+  curl -s localhost:8000/metrics        # Prometheus text exposition
+  curl -s localhost:8000/metrics.json   # JSON summary snapshot
+  curl -s localhost:8000/healthz        # 200 serving / 503 shutting down
+
+With ``--obs_dir DIR``: periodic Prometheus-text + JSONL snapshots of the
+serving registry land in DIR, and any unhandled exception dumps the flight
+recorder's last-N-events timeline there.
 
 Byte-level bundles (vocab 256) also accept ``{"prompt": "text"}`` and
 return decoded ``"text"`` alongside token ids.
@@ -153,19 +159,41 @@ def main(argv=None):
         flush=True,
     )
 
+    obs_export = None
+    if serve_cfg.obs_dir:
+        from distributed_tensorflow_tpu import obs
+        from distributed_tensorflow_tpu.obs import export as obs_export
+
+        obs.set_dump_dir(serve_cfg.obs_dir)
+        obs.install_excepthook()
+
+    def export_obs():
+        if obs_export is None:
+            return
+        obs_export.write_jsonl_snapshot(
+            os.path.join(serve_cfg.obs_dir, "serve_metrics.jsonl"),
+            metrics.registry,
+        )
+        prom_path = os.path.join(serve_cfg.obs_dir, "serve_metrics.prom")
+        with open(prom_path, "w") as f:
+            f.write(obs_export.prometheus_text(metrics.registry))
+
     writer = None
     pub_step = [0]
-    if serve_cfg.serve_log_dir:
-        from distributed_tensorflow_tpu.utils.summary import SummaryWriter
+    if serve_cfg.serve_log_dir or obs_export is not None:
+        if serve_cfg.serve_log_dir:
+            from distributed_tensorflow_tpu.utils.summary import SummaryWriter
 
-        writer = SummaryWriter(serve_cfg.serve_log_dir)
+            writer = SummaryWriter(serve_cfg.serve_log_dir)
 
         def publish_loop():
             while True:
                 time.sleep(serve_cfg.metrics_interval_s)
                 pub_step[0] += 1
-                metrics.publish(writer, pub_step[0])
-                writer.flush()
+                if writer is not None:
+                    metrics.publish(writer, pub_step[0])
+                    writer.flush()
+                export_obs()
 
         threading.Thread(
             target=publish_loop, name="serve-metrics", daemon=True
@@ -182,6 +210,7 @@ def main(argv=None):
         if writer is not None:
             metrics.publish(writer, pub_step[0] + 1)
             writer.close()
+        export_obs()  # final scrape survives the shutdown
         print("serve_lm: shut down cleanly", flush=True)
 
 

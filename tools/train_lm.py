@@ -140,6 +140,12 @@ def main(argv=None):
         "--profile_dir", default="",
         help="write a jax.profiler (TensorBoard XPlane) trace here",
     )
+    parser.add_argument(
+        "--obs_dir", default="",
+        help="observability output dir: per-boundary metrics.jsonl "
+             "snapshots + flight-recorder crash dumps (unhandled "
+             "exceptions dump the last-N-events timeline here)",
+    )
     parser.add_argument("--profile_start_step", type=int, default=5)
     parser.add_argument("--profile_num_steps", type=int, default=3)
     parser.add_argument("--seed", type=int, default=0)
@@ -172,6 +178,19 @@ def main(argv=None):
     from distributed_tensorflow_tpu.parallel import data_parallel as dp, distributed
     from distributed_tensorflow_tpu.parallel.mesh import make_mesh
     from distributed_tensorflow_tpu.utils.timer import StepTimer
+
+    obs = None
+    if args.obs_dir:
+        from distributed_tensorflow_tpu import obs
+        from distributed_tensorflow_tpu.obs import export as obs_export
+
+        obs.set_dump_dir(args.obs_dir)
+        obs.install_excepthook()
+        obs_reg = obs.get_registry()
+        obs_loss = obs_reg.gauge("lm_loss", "Training loss at the last eval boundary.")
+        obs_rate = obs_reg.gauge(
+            "lm_tokens_per_sec", "Tokens/s over the last drained window.")
+        obs_steps = obs_reg.counter("lm_steps_total", "Optimizer steps completed.")
 
     cluster = ClusterConfig(
         worker_hosts=args.worker_hosts,
@@ -477,6 +496,15 @@ def main(argv=None):
                     scalars["mfu"] = mfu
             if writer is not None:
                 writer.add_scalars(scalars, step_now)
+            if obs is not None:
+                obs_loss.set(loss_now)
+                obs_steps.inc(max(step_now - start - int(obs_steps.value), 0))
+                if timer.steps_per_sec > 0:
+                    obs_rate.set(tokens_per_sec)
+                if chief:
+                    obs_export.write_jsonl_snapshot(
+                        os.path.join(args.obs_dir, "metrics.jsonl")
+                    )
             if chief:
                 record = {
                     "step": step_now,
