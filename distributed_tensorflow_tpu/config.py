@@ -80,7 +80,17 @@ class MnistTrainConfig:
     obs_dir: str = field(
         default="",
         metadata={"help": "observability output dir (flight-recorder crash "
-                          "dumps + metrics JSONL); empty disables dumps"},
+                          "dumps + metrics JSONL + per-process fleet "
+                          "snapshots, merged by the chief); empty disables "
+                          "dumps"},
+    )
+    slo: str = field(
+        default="",
+        metadata={
+            "help": "SLO rules evaluated at eval boundaries: 'default' "
+            "(step time, data-wait fraction), 'off'/empty, and/or "
+            "comma-separated 'metric[:agg]>thr[@sustain][#name]' specs"
+        },
     )
     training_steps: int = 10000
     batch_size: int = 100
@@ -284,6 +294,11 @@ class RetrainConfig:
     )
     output_labels: str = "./retrained_labels.txt"
     summaries_dir: str = "./retrain_logs"
+    obs_dir: str = field(
+        default="",
+        metadata={"help": "observability output dir (per-process fleet "
+                          "snapshots, merged by the chief); empty disables"},
+    )
     training_steps: int = 10000
     learning_rate: float = 0.01
     optimizer: str = field(
@@ -430,4 +445,16 @@ class ServeConfig:
     )
     metrics_interval_s: float = field(
         default=10.0, metadata={"help": "TB publish period"}
+    )
+    slo: str = field(
+        default="default",
+        metadata={
+            "help": "SLO rules: 'default' (p99 TTFT, queue depth, "
+            "post-warmup recompiles), 'off', and/or comma-separated "
+            "'metric[:agg]>thr[@sustain][#name]' specs (obs/slo.py)"
+        },
+    )
+    slo_interval_s: float = field(
+        default=1.0,
+        metadata={"help": "SLO monitor evaluation tick period"},
     )

@@ -23,6 +23,18 @@ all report into:
   and a bridge into the repo's own ``SummaryWriter``; wired into
   ``serve/server.py`` as ``/metrics`` and into the tool CLIs via
   ``--obs_dir``.
+* :mod:`aggregate <.aggregate>` — cross-process merge of per-process
+  registries into one fleet view (counters sum, gauges get a ``process``
+  label + min/max/sum rollups, histograms merge buckets exactly), fed by
+  atomic ``fleet_p<i>.json`` snapshots in a shared ``--obs_dir``.
+* :mod:`perf <.perf>` — live MFU / tokens-per-second gauges from the
+  ``utils/flops.py`` math, device-memory watermarks, and the recompile
+  sentinel that turns the serving engine's zero-recompile-after-warmup
+  invariant into an alerting runtime counter.
+* :mod:`slo <.slo>` — declarative SLO rules (selector, aggregation,
+  threshold, sustain window) evaluated on a ticker; sustained breaches
+  bump ``slo_breach_total``, hit the trace + flight-recorder planes, and
+  invoke registered callbacks (the autoscaling/drain hook).
 
 Everything here is stdlib-only on the hot paths (numpy appears only in the
 ``SummaryWriter`` bridge) and costs nothing when disabled: ``disable()``
@@ -31,6 +43,17 @@ instruments are shared no-op singletons — the bench.py overhead gate holds
 the instrumented MNIST step within 1% of that no-op baseline.
 """
 
+from distributed_tensorflow_tpu.obs.aggregate import (
+    FleetAggregator,
+    full_snapshot,
+    merge_snapshots,
+    write_process_snapshot,
+)
+from distributed_tensorflow_tpu.obs.perf import (
+    PerfGauges,
+    RecompileSentinel,
+    update_memory_gauges,
+)
 from distributed_tensorflow_tpu.obs.recorder import (
     FlightRecorder,
     get_recorder,
@@ -47,9 +70,30 @@ from distributed_tensorflow_tpu.obs.registry import (
     get_registry,
     set_registry,
 )
+from distributed_tensorflow_tpu.obs.slo import (
+    SloMonitor,
+    SloRule,
+    default_serving_rules,
+    default_training_rules,
+    parse_slo_flag,
+    parse_slo_spec,
+)
 from distributed_tensorflow_tpu.obs.trace import current_span, span, trace_event
 
 __all__ = [
+    "FleetAggregator",
+    "full_snapshot",
+    "merge_snapshots",
+    "write_process_snapshot",
+    "PerfGauges",
+    "RecompileSentinel",
+    "update_memory_gauges",
+    "SloMonitor",
+    "SloRule",
+    "default_serving_rules",
+    "default_training_rules",
+    "parse_slo_flag",
+    "parse_slo_spec",
     "Counter",
     "Gauge",
     "Histogram",

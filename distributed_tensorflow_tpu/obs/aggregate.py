@@ -1,0 +1,295 @@
+"""Cross-process metric aggregation: N per-process registries, one fleet view.
+
+PR 5 gave every process its own registry and scrape surface; a multi-host
+run therefore exposes N disjoint ``/metrics`` pages a human has to correlate
+by hand. This module merges them with per-kind semantics:
+
+* **counters sum** — ``train_steps_total`` over the fleet is the sum of the
+  per-process totals (same label set → one merged child).
+* **gauges keep process identity** — a gauge is a point-in-time reading, so
+  summing ``train_examples_per_sec`` across processes and ``serve_queue_depth_current``
+  across replicas means different things. The merged family gets a
+  ``process`` label prepended to the original labels (one child per source
+  process) plus ``<name>_min`` / ``<name>_max`` / ``<name>_sum`` rollup
+  gauges over the original label sets, so both the per-replica view and the
+  fleet aggregate are one selector away.
+* **histograms merge exactly where they can** — per-bucket counts and the
+  lifetime count/total are exact lifetime accounting, so identical bucket
+  ladders merge by addition. The bounded reservoirs (recent-percentile
+  readout) are SUBSAMPLED: each process contributes a share of the merged
+  reservoir proportional to its sample count, taken evenly over its
+  reservoir (deterministic — no RNG in the metrics plane). A ladder
+  mismatch (processes running different code) falls back to re-bucketing
+  the reservoirs only; count/total stay exact either way.
+
+Feeding is either **explicit push** (:meth:`FleetAggregator.push` with a
+:func:`full_snapshot` dict — the in-process path a router tier will use) or
+**file-fed** through a shared ``--obs_dir``: every process drops an atomic
+``fleet_p<i>.json`` (:func:`write_process_snapshot`), the chief loads the
+directory and exports the merged registry as Prometheus text + JSON
+(:meth:`FleetAggregator.export`). The file path is what the multi-process
+CPU tests exercise — no network needed, the shared filesystem IS the
+transport, exactly like the checkpoint manifests.
+
+:func:`full_snapshot` exists because :func:`export.registry_snapshot`
+reduces histograms to summary dicts — enough for humans, not enough to
+merge. This one carries the exact bucket counts and the reservoir, i.e.
+everything needed to reconstruct the instrument on the other side.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+from collections import deque
+
+from distributed_tensorflow_tpu.obs import export as _export
+from distributed_tensorflow_tpu.obs.registry import MetricsRegistry
+
+__all__ = [
+    "full_snapshot",
+    "write_process_snapshot",
+    "load_process_snapshots",
+    "merge_snapshots",
+    "FleetAggregator",
+]
+
+_SNAPSHOT_PREFIX = "fleet_p"
+
+
+def _process_index() -> int:
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:  # noqa: BLE001 — uninitialized backend
+            return 0
+    return 0
+
+
+def full_snapshot(registry=None, *, process: int | None = None) -> dict:
+    """Aggregation-grade snapshot: everything needed to merge, per family.
+
+    Counters/gauges carry ``value``; histograms carry the exact
+    ``bucket_les``/``bucket_counts`` (non-cumulative), lifetime
+    ``count``/``total``, the reservoir contents, and ``maxlen``. Label
+    values are stored as lists (JSON has no tuples)."""
+    from distributed_tensorflow_tpu.obs import registry as _registry
+
+    registry = registry if registry is not None else _registry.get_registry()
+    proc = _process_index() if process is None else int(process)
+    out: dict = {
+        "process": proc,
+        "pid": os.getpid(),
+        "t_wall": time.time(),
+        "metrics": {},
+    }
+    for fam in registry.collect():
+        samples = []
+        for label_values, inst in fam.children():
+            entry: dict = {"labels": list(label_values)}
+            if fam.kind == "histogram":
+                with inst._lock:
+                    entry.update(
+                        count=inst.count,
+                        total=inst.total,
+                        bucket_les=list(inst._buckets),
+                        bucket_counts=list(inst._bucket_counts),
+                        reservoir=list(inst._samples),
+                        maxlen=inst._samples.maxlen,
+                    )
+            else:
+                entry["value"] = inst.value
+            samples.append(entry)
+        out["metrics"][fam.name] = {
+            "kind": fam.kind,
+            "help": fam.help,
+            "label_names": list(fam.label_names),
+            "samples": samples,
+        }
+    return out
+
+
+def write_process_snapshot(obs_dir: str, registry=None, *,
+                           process: int | None = None) -> str:
+    """Atomically write this process's :func:`full_snapshot` to
+    ``<obs_dir>/fleet_p<process>.json`` (tmp + rename, so a concurrent
+    chief read never sees a torn file). Returns the path."""
+    snap = full_snapshot(registry, process=process)
+    os.makedirs(obs_dir, exist_ok=True)
+    path = os.path.join(obs_dir, f"{_SNAPSHOT_PREFIX}{snap['process']}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(snap, default=str))
+    os.replace(tmp, path)
+    return path
+
+
+def load_process_snapshots(obs_dir: str) -> list[dict]:
+    """All ``fleet_p*.json`` snapshots in ``obs_dir``, ordered by process
+    index. Torn/unparseable files are skipped (the writer is atomic, but a
+    crashed process may have left a stale ``.tmp``)."""
+    snaps = []
+    for path in sorted(glob.glob(os.path.join(obs_dir, f"{_SNAPSHOT_PREFIX}*.json"))):
+        try:
+            with open(path) as f:
+                snaps.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    snaps.sort(key=lambda s: int(s.get("process", 0)))
+    return snaps
+
+
+def _subsample(values: list[float], k: int) -> list[float]:
+    """Evenly-spaced deterministic pick of k items (all of them if k >= n)."""
+    n = len(values)
+    if k >= n:
+        return list(values)
+    if k <= 0:
+        return []
+    # Even stride over the index range keeps the tail (most recent) samples.
+    return [values[(i * n) // k] for i in range(k)]
+
+
+def _merge_histogram(inst, samples: list[dict]) -> None:
+    """Install the merged state of per-process histogram ``samples`` into a
+    fresh registry ``Histogram`` instance. Exact count/total always; exact
+    bucket addition when every ladder matches the instrument's; reservoirs
+    subsampled proportionally to each process's lifetime count."""
+    count = sum(int(s["count"]) for s in samples)
+    total = sum(float(s["total"]) for s in samples)
+    ladders_match = all(
+        tuple(float(b) for b in s["bucket_les"]) == inst._buckets
+        for s in samples
+    )
+    if ladders_match:
+        bucket_counts = [0] * len(inst._buckets)
+        for s in samples:
+            for i, c in enumerate(s["bucket_counts"]):
+                bucket_counts[i] += int(c)
+    else:
+        # Different code revisions on different processes: re-bucket what we
+        # still have (the reservoirs). Approximate by construction — the
+        # exact per-bucket history of the mismatched ladder is gone.
+        import bisect
+
+        bucket_counts = [0] * len(inst._buckets)
+        for s in samples:
+            for v in s["reservoir"]:
+                i = bisect.bisect_left(inst._buckets, float(v))
+                if i < len(bucket_counts):
+                    bucket_counts[i] += 1
+    maxlen = inst._samples.maxlen
+    weights = [max(int(s["count"]), len(s["reservoir"])) for s in samples]
+    total_w = sum(weights) or 1
+    merged_reservoir: list[float] = []
+    for s, w in zip(samples, weights):
+        share = min(len(s["reservoir"]),
+                    max(1 if s["reservoir"] else 0, (maxlen * w) // total_w))
+        merged_reservoir.extend(_subsample([float(v) for v in s["reservoir"]],
+                                           share))
+    with inst._lock:
+        inst.count = count
+        inst.total = total
+        inst._bucket_counts = bucket_counts
+        inst._samples = deque(merged_reservoir[-maxlen:], maxlen=maxlen)
+
+
+def merge_snapshots(snapshots: list[dict]) -> MetricsRegistry:
+    """Merge per-process :func:`full_snapshot` dicts into one fleet
+    registry (per-kind semantics in the module docstring)."""
+    merged = MetricsRegistry()
+    # name -> kind/help/label_names from the first snapshot that has it;
+    # per (name, labels) accumulation across processes.
+    for name in sorted({n for s in snapshots for n in s["metrics"]}):
+        metas = [(s, s["metrics"][name]) for s in snapshots
+                 if name in s["metrics"]]
+        first = metas[0][1]
+        kind = first["kind"]
+        help_ = first.get("help", "")
+        label_names = tuple(first.get("label_names", ()))
+        if kind == "counter":
+            fam = merged.counter(name, help_, labels=label_names)
+            acc: dict[tuple, float] = {}
+            for _, m in metas:
+                for smp in m["samples"]:
+                    key = tuple(smp["labels"])
+                    acc[key] = acc.get(key, 0.0) + float(smp["value"])
+            for key, v in acc.items():
+                (fam.labels(*key) if label_names else fam._solo()).inc(v)
+        elif kind == "gauge":
+            fam = merged.gauge(name, help_,
+                               labels=("process",) + label_names)
+            rollup: dict[tuple, list[float]] = {}
+            for snap, m in metas:
+                proc = str(snap.get("process", 0))
+                for smp in m["samples"]:
+                    v = float(smp["value"])
+                    fam.labels(proc, *smp["labels"]).set(v)
+                    rollup.setdefault(tuple(smp["labels"]), []).append(v)
+            for suffix, agg in (("min", min), ("max", max), ("sum", sum)):
+                rfam = merged.gauge(
+                    f"{name}_{suffix}",
+                    f"{suffix} of {name} across processes.",
+                    labels=label_names)
+                for key, vals in rollup.items():
+                    inst = rfam.labels(*key) if label_names else rfam._solo()
+                    inst.set(agg(vals))
+        else:  # histogram
+            by_labels: dict[tuple, list[dict]] = {}
+            for _, m in metas:
+                for smp in m["samples"]:
+                    by_labels.setdefault(tuple(smp["labels"]), []).append(smp)
+            any_smp = next(iter(by_labels.values()))[0]
+            fam = merged.histogram(
+                name, help_, labels=label_names,
+                maxlen=int(any_smp.get("maxlen") or 4096),
+                buckets=tuple(float(b) for b in any_smp["bucket_les"]),
+            )
+            for key, smps in by_labels.items():
+                inst = fam.labels(*key) if label_names else fam._solo()
+                _merge_histogram(inst, smps)
+    return merged
+
+
+class FleetAggregator:
+    """Chief-side collector: push or load per-process snapshots, read out
+    the merged fleet registry, export it next to the inputs."""
+
+    def __init__(self):
+        self._snaps: dict[int, dict] = {}
+
+    def push(self, snapshot: dict) -> None:
+        """Explicit-push feed (in-process / future router RPC path). Later
+        pushes for the same process index replace earlier ones."""
+        self._snaps[int(snapshot.get("process", 0))] = snapshot
+
+    def load_dir(self, obs_dir: str) -> int:
+        """File feed: absorb every ``fleet_p*.json`` in ``obs_dir``.
+        Returns how many snapshots are now held."""
+        for snap in load_process_snapshots(obs_dir):
+            self.push(snap)
+        return len(self._snaps)
+
+    @property
+    def num_processes(self) -> int:
+        return len(self._snaps)
+
+    def merged(self) -> MetricsRegistry:
+        snaps = [self._snaps[k] for k in sorted(self._snaps)]
+        return merge_snapshots(snaps)
+
+    def export(self, obs_dir: str) -> MetricsRegistry:
+        """Write the merged registry as ``fleet_merged.prom`` (Prometheus
+        text) and ``fleet_merged.json`` (plain snapshot) into ``obs_dir``;
+        returns the merged registry."""
+        reg = self.merged()
+        os.makedirs(obs_dir, exist_ok=True)
+        with open(os.path.join(obs_dir, "fleet_merged.prom"), "w") as f:
+            f.write(_export.prometheus_text(reg))
+        with open(os.path.join(obs_dir, "fleet_merged.json"), "w") as f:
+            f.write(json.dumps(_export.registry_snapshot(reg), default=str))
+        return reg

@@ -1,0 +1,293 @@
+"""Live performance accounting: MFU/throughput gauges, memory watermarks,
+and the recompile sentinel.
+
+``utils/flops.py`` already knows the model-FLOP and roofline math, but until
+now it only fed offline ``bench.py`` records and boundary stdout prints.
+This module turns the same arithmetic into registry gauges refreshed every
+eval window, so a scraper sees the fleet's compute efficiency live:
+
+* :class:`PerfGauges` — ``train_mfu`` (model FLOPs x steps/s over cluster
+  peak; absent off-TPU, where ``chip_peak_flops`` correctly refuses to
+  invent a denominator), ``tokens_per_second`` / ``examples_per_second``,
+  and ``train_step_seconds`` (the SLO monitor's step-time selector).
+* :func:`update_memory_gauges` — per-device ``bytes_in_use`` /
+  ``peak_bytes_in_use`` watermarks from ``Device.memory_stats()``. The CPU
+  backend returns None there; the gauges are then simply not touched
+  (graceful null — no fake zeros in the scrape).
+* :class:`RecompileSentinel` — the serving engine's zero-recompile-after-
+  warmup invariant was a test-only ``compile_count()`` assert; this makes
+  it an ALERTING runtime metric. Primary signal: a ``jax.monitoring``
+  event-duration listener on ``backend_compile`` events (fires once per
+  XLA compilation). jax 0.4.x has no per-listener unregister (only a
+  global ``clear_event_listeners``), so ONE module-level dispatcher is
+  registered process-wide on first use and forwards to whichever sentinels
+  are currently open — ``close()`` detaches a sentinel without touching
+  the global listener list. Version-guarded fallback: when the monitoring
+  API is missing (or listener mode is explicitly declined), the sentinel
+  counts deltas of an externally-polled compile-cache size
+  (``SlotEngine.compile_count()`` feeds :meth:`RecompileSentinel.poll`
+  every engine round). ``mark_warm()`` draws the line: compile events
+  before it are warmup, events after it increment
+  ``recompile_events_total`` — the metric the default serving SLO rule
+  alerts on (threshold 0: ANY post-warmup compile is a breach).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from distributed_tensorflow_tpu.obs import registry as _registry
+
+__all__ = [
+    "PerfGauges",
+    "update_memory_gauges",
+    "RecompileSentinel",
+    "monitoring_available",
+]
+
+
+# ---------------------------------------------------------------------------
+# throughput / MFU gauges
+# ---------------------------------------------------------------------------
+
+
+class PerfGauges:
+    """Eval-window performance gauges on a registry (process default when
+    ``registry`` is None). Call :meth:`update_window` at each boundary with
+    whatever is known; unknown quantities leave their gauges untouched."""
+
+    def __init__(self, registry=None):
+        reg = registry if registry is not None else _registry.get_registry()
+        self.mfu = reg.gauge(
+            "train_mfu",
+            "Model FLOPs utilization over the last drained window "
+            "(absent off-TPU: no peak to divide by).")
+        self.tokens_rate = reg.gauge(
+            "tokens_per_second", "Global tokens/s over the last window.")
+        self.examples_rate = reg.gauge(
+            "examples_per_second", "Global examples/s over the last window.")
+        self.step_seconds = reg.gauge(
+            "train_step_seconds",
+            "Mean seconds per optimizer step over the last window.")
+
+    def update_window(
+        self,
+        *,
+        steps_per_sec: float,
+        tokens_per_step: int | None = None,
+        examples_per_step: int | None = None,
+        model_cfg=None,
+        batch_size: int | None = None,
+        seq_len: int | None = None,
+        flops_per_step: float | None = None,
+        peak_flops: float | None = None,
+        num_devices: int | None = None,
+    ) -> float | None:
+        """Refresh rates for one drained window; returns the MFU (or None
+        when it cannot be computed — off-TPU, or no model math given).
+
+        MFU numerator: ``flops_per_step`` directly, else
+        ``transformer_train_flops(model_cfg, batch_size, seq_len)``.
+        Denominator: ``peak_flops`` per device (default
+        ``chip_peak_flops()``) x ``num_devices`` (default all)."""
+        if steps_per_sec <= 0:
+            return None  # compile window — rates would be lies
+        self.step_seconds.set(1.0 / steps_per_sec)
+        if tokens_per_step:
+            self.tokens_rate.set(steps_per_sec * tokens_per_step)
+        if examples_per_step:
+            self.examples_rate.set(steps_per_sec * examples_per_step)
+        flops = flops_per_step
+        if flops is None and model_cfg is not None and batch_size:
+            from distributed_tensorflow_tpu.utils.flops import (
+                transformer_train_flops,
+            )
+
+            flops = transformer_train_flops(model_cfg, batch_size, seq_len)
+        if flops is None:
+            return None
+        if peak_flops is None:
+            from distributed_tensorflow_tpu.utils.flops import chip_peak_flops
+
+            peak_flops = chip_peak_flops()
+        if peak_flops is None:
+            return None  # graceful null: no invented denominator
+        if num_devices is None:
+            import jax
+
+            num_devices = len(jax.devices())
+        mfu = flops * steps_per_sec / (peak_flops * max(num_devices, 1))
+        self.mfu.set(mfu)
+        return mfu
+
+
+def update_memory_gauges(registry=None) -> dict:
+    """Refresh per-device HBM watermark gauges from
+    ``Device.memory_stats()``. Returns ``{device_label: stats}`` for the
+    devices that reported; empty on backends (CPU) whose ``memory_stats()``
+    is None or missing — the graceful-null contract: gauges untouched, no
+    zeros invented."""
+    import jax
+
+    reg = registry if registry is not None else _registry.get_registry()
+    in_use = reg.gauge(
+        "device_memory_bytes_in_use",
+        "Live device allocation (memory_stats bytes_in_use).",
+        labels=("device",))
+    peak = reg.gauge(
+        "device_memory_peak_bytes",
+        "High-watermark device allocation this process lifetime.",
+        labels=("device",))
+    limit = reg.gauge(
+        "device_memory_limit_bytes",
+        "Allocator capacity (memory_stats bytes_limit).",
+        labels=("device",))
+    out: dict = {}
+    for dev in jax.local_devices():
+        stats = None
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without the API at all
+            stats = None
+        if not stats:
+            continue
+        label = f"{dev.platform}:{dev.id}"
+        if "bytes_in_use" in stats:
+            in_use.labels(label).set(float(stats["bytes_in_use"]))
+        if "peak_bytes_in_use" in stats:
+            peak.labels(label).set(float(stats["peak_bytes_in_use"]))
+        if "bytes_limit" in stats:
+            limit.labels(label).set(float(stats["bytes_limit"]))
+        out[label] = stats
+    return out
+
+
+# ---------------------------------------------------------------------------
+# recompile sentinel
+# ---------------------------------------------------------------------------
+
+_dispatch_lock = threading.Lock()
+_dispatch_installed = False
+_active_sentinels: list["RecompileSentinel"] = []
+
+
+def monitoring_available() -> bool:
+    """Version guard: does this jax expose the event-duration listener the
+    sentinel's primary signal needs?"""
+    try:
+        from jax import monitoring  # noqa: F401
+
+        return callable(getattr(monitoring, "register_event_duration_secs_listener", None))
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _dispatch(event: str, duration=None, **kw) -> None:
+    # One XLA compilation records exactly one backend_compile duration;
+    # the jaxpr-trace/MLIR-lowering events around it would double count.
+    if "backend_compile" not in event:
+        return
+    with _dispatch_lock:
+        targets = list(_active_sentinels)
+    for s in targets:
+        s._on_compile_event()
+
+
+def _ensure_dispatcher() -> bool:
+    """Register the process-wide listener once (jax 0.4.x cannot unregister
+    a single listener, so it is never removed — it forwards to the
+    currently-open sentinels only)."""
+    global _dispatch_installed
+    with _dispatch_lock:
+        if _dispatch_installed:
+            return True
+        if not monitoring_available():
+            return False
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_dispatch)
+        _dispatch_installed = True
+        return True
+
+
+class RecompileSentinel:
+    """Counts XLA compile events at runtime and alerts on any after warmup.
+
+    Metrics (on ``registry``, process default when None):
+
+    * ``xla_compile_events_total`` — every compile seen since install.
+    * ``recompile_events_total`` — compiles AFTER :meth:`mark_warm`; the
+      zero-recompile invariant says this stays 0 forever, so the default
+      serving SLO rule breaches on value > 0.
+
+    ``mode`` is ``"listener"`` when the jax.monitoring dispatcher is live
+    (process-wide events), ``"poll"`` when falling back to cache-size
+    deltas fed through :meth:`poll`. In listener mode ``poll()`` is a
+    no-op so the two signals never double count.
+    """
+
+    def __init__(self, registry=None, *, use_listener: bool = True):
+        reg = registry if registry is not None else _registry.get_registry()
+        self._compiles = reg.counter(
+            "xla_compile_events_total",
+            "XLA compilations observed by the recompile sentinel.")
+        self._post_warm = reg.counter(
+            "recompile_events_total",
+            "XLA compilations observed AFTER warmup — must stay 0.")
+        self._lock = threading.Lock()
+        self._warm = False
+        self._poll_base: int | None = None
+        self.mode = "poll"
+        if use_listener and _ensure_dispatcher():
+            self.mode = "listener"
+            with _dispatch_lock:
+                _active_sentinels.append(self)
+
+    # -- signal paths -----------------------------------------------------
+
+    def _on_compile_event(self) -> None:
+        with self._lock:
+            warm = self._warm
+        self._compiles.inc()
+        if warm:
+            self._post_warm.inc()
+
+    def poll(self, compile_count: int) -> None:
+        """Fallback feed: an externally-observed monotone compile-cache
+        size (e.g. ``SlotEngine.compile_count()``). Deltas become events.
+        No-op in listener mode (the listener already saw them)."""
+        if self.mode == "listener":
+            return
+        with self._lock:
+            base, self._poll_base = self._poll_base, int(compile_count)
+            warm = self._warm
+        if base is None:
+            return
+        delta = int(compile_count) - base
+        if delta > 0:
+            self._compiles.inc(delta)
+            if warm:
+                self._post_warm.inc(delta)
+
+    def mark_warm(self) -> None:
+        """Everything compiled so far was warmup; anything after this is a
+        recompile (the alert condition)."""
+        with self._lock:
+            self._warm = True
+
+    def close(self) -> None:
+        """Detach from the process-wide dispatcher (the listener itself
+        stays registered — jax 0.4.x has no unregister)."""
+        with _dispatch_lock:
+            if self in _active_sentinels:
+                _active_sentinels.remove(self)
+
+    # -- readout ----------------------------------------------------------
+
+    @property
+    def events_total(self) -> int:
+        return int(self._compiles.value)
+
+    @property
+    def post_warm_total(self) -> int:
+        return int(self._post_warm.value)

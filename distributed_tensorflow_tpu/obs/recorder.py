@@ -154,9 +154,12 @@ _hook_installed = False
 
 
 def install_excepthook() -> None:
-    """Chain a flight-record dump onto ``sys.excepthook`` so any unhandled
-    exception writes its timeline before the process dies. Idempotent; the
-    previous hook (usually the default traceback printer) still runs."""
+    """Chain a flight-record dump onto ``sys.excepthook`` AND
+    ``threading.excepthook`` so any unhandled exception — main thread or a
+    background one (checkpoint snapshot thread, scheduler loop) — writes
+    its timeline before dying. Without the threading hook, a crashing
+    daemon thread evaporates silently with no dump. Idempotent; the
+    previous hooks (usually the default traceback printers) still run."""
     global _hook_installed
     with _recorder_lock:
         if _hook_installed:
@@ -176,3 +179,19 @@ def install_excepthook() -> None:
             prev(exc_type, exc, tb)
 
     sys.excepthook = _hook
+
+    prev_threading = threading.excepthook
+
+    def _thread_hook(args):
+        try:
+            get_recorder().record(
+                kind="event",
+                name="unhandled_thread_exception",
+                thread=getattr(args.thread, "name", None),
+                error=f"{args.exc_type.__name__}: {args.exc_value}",
+            )
+            dump_to_dir("unhandled_thread_exception")
+        finally:
+            prev_threading(args)
+
+    threading.excepthook = _thread_hook

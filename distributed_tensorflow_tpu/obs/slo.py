@@ -1,0 +1,401 @@
+"""Declarative SLO monitor: rules over registry metrics, evaluated on a
+ticker, with sustained-breach semantics and machine-readable status.
+
+A rule is ``(metric selector, aggregation, threshold, direction,
+sustain window)``. Evaluation reads the CURRENT registry state — a gauge's
+value, a counter's total, a histogram's reservoir percentile — compares it
+against the threshold, and runs a tiny state machine per rule:
+
+    ok ──condition holds──▶ pending ──held for sustain_s──▶ breach
+    ▲                                                          │
+    └────────────────condition clears──────────────────────────┘
+
+(``sustain_s=0`` collapses pending: first bad reading breaches.) On the
+ok→breach transition the monitor increments ``slo_breach_total{rule=...}``,
+emits a ``trace_event`` AND a flight-recorder entry (a later crash dump
+shows which SLOs were burning when it happened), and invokes every
+registered callback — the hook the serving-fleet router will use for
+autoscale/drain decisions. Recovery (breach→ok) fires callbacks too, with
+``status="ok"``.
+
+Missing metrics read as ``no_data`` and never breach: a rule about a
+histogram that hasn't seen traffic yet must not page anybody.
+
+Rules come from :func:`default_serving_rules` / :func:`default_training_rules`
+or the ``--slo`` flag's compact spec syntax (:func:`parse_slo_spec`):
+
+    metric[:aggregation][{label=value,...}] >|< threshold [@sustain_s] [#name]
+
+    serve_ttft_seconds:p99>0.5@5      p99 TTFT above 500 ms for 5 s
+    recompile_events_total>0          any post-warmup recompile (instant)
+    train_data_wait_frac>0.5@30       input-bound for 30 s
+
+``evaluate()`` is cheap for value rules and one reservoir sort for
+percentile rules, which is why the production wiring runs it on a ticker
+(~1 Hz) or at eval boundaries, never per step — ``bench_obs_overhead``
+accounts its cost as evaluate_cost/interval of wall time.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+from distributed_tensorflow_tpu.obs import recorder as _recorder
+from distributed_tensorflow_tpu.obs import registry as _registry
+from distributed_tensorflow_tpu.obs import trace as _trace
+
+__all__ = [
+    "SloRule",
+    "SloMonitor",
+    "parse_slo_spec",
+    "parse_slo_flag",
+    "default_serving_rules",
+    "default_training_rules",
+]
+
+_AGGREGATIONS = ("value", "mean", "max", "count", "p50", "p95", "p99")
+
+
+@dataclass
+class SloRule:
+    """One objective: ``aggregation(metric)`` vs ``threshold``.
+
+    ``direction="above"`` breaches when the value exceeds the threshold
+    (latency/queue/error rules); ``"below"`` when it drops under it
+    (throughput floors). ``labels`` restricts a labeled family to children
+    matching every given (name, value) pair; unlabeled rules aggregate
+    over ALL children (sum for counters, max for gauges — the conservative
+    fleet reading)."""
+
+    name: str
+    metric: str
+    threshold: float
+    aggregation: str = "value"
+    direction: str = "above"
+    sustain_s: float = 0.0
+    labels: dict = field(default_factory=dict)
+    description: str = ""
+
+    def __post_init__(self):
+        if self.aggregation not in _AGGREGATIONS:
+            raise ValueError(
+                f"rule {self.name}: unknown aggregation {self.aggregation!r} "
+                f"(choose from {_AGGREGATIONS})")
+        if self.direction not in ("above", "below"):
+            raise ValueError(
+                f"rule {self.name}: direction must be above|below, "
+                f"got {self.direction!r}")
+        if self.sustain_s < 0:
+            raise ValueError(f"rule {self.name}: sustain_s must be >= 0")
+
+
+_SPEC_RE = re.compile(
+    r"^(?P<metric>[A-Za-z_][A-Za-z0-9_]*)"
+    r"(?::(?P<agg>[a-z0-9]+))?"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s*(?P<dir>[<>])\s*"
+    r"(?P<thr>[-+0-9.eE]+)"
+    r"(?:@(?P<sustain>[0-9.]+))?"
+    r"(?:#(?P<name>[A-Za-z0-9_.-]+))?$"
+)
+
+
+def parse_slo_spec(spec: str) -> SloRule:
+    """One compact rule spec → :class:`SloRule` (syntax in the module
+    docstring). Raises ValueError on malformed specs — a typo'd SLO that
+    silently monitors nothing is worse than a crash at startup."""
+    m = _SPEC_RE.match(spec.strip())
+    if not m:
+        raise ValueError(f"malformed SLO spec {spec!r} "
+                         "(want metric[:agg][{k=v}]>threshold[@sustain][#name])")
+    labels = {}
+    if m.group("labels"):
+        for pair in m.group("labels").split(","):
+            k, _, v = pair.partition("=")
+            labels[k.strip()] = v.strip().strip('"')
+    agg = m.group("agg") or "value"
+    return SloRule(
+        name=m.group("name") or f"{m.group('metric')}_{agg}",
+        metric=m.group("metric"),
+        aggregation=agg,
+        threshold=float(m.group("thr")),
+        direction="above" if m.group("dir") == ">" else "below",
+        sustain_s=float(m.group("sustain") or 0.0),
+        labels=labels,
+    )
+
+
+def parse_slo_flag(flag: str, *, defaults=None) -> list:
+    """``--slo`` value → rules. Comma-separated specs; the bare token
+    ``default`` expands to ``defaults`` (a zero-arg callable returning
+    rules); ``off``/empty yields no rules."""
+    rules: list = []
+    for part in (flag or "").split(","):
+        part = part.strip()
+        if not part or part == "off":
+            continue
+        if part == "default":
+            if defaults is not None:
+                rules.extend(defaults())
+            continue
+        rules.append(parse_slo_spec(part))
+    return rules
+
+
+def default_serving_rules(
+    *,
+    ttft_p99_s: float = 0.5,
+    queue_depth: float = 48,
+    sustain_s: float = 5.0,
+) -> list:
+    """The serving SLOs every replica should watch: tail TTFT, queue
+    buildup, and the zero-recompile invariant (threshold 0, instant —
+    one post-warmup compile is already a bug)."""
+    return [
+        SloRule("ttft_p99", "serve_ttft_seconds", ttft_p99_s,
+                aggregation="p99", sustain_s=sustain_s,
+                description="p99 time-to-first-token"),
+        SloRule("queue_depth", "serve_queue_depth_current", queue_depth,
+                sustain_s=sustain_s,
+                description="admission queue backlog"),
+        SloRule("post_warmup_recompiles", "recompile_events_total", 0,
+                description="XLA compiles after engine warmup"),
+    ]
+
+
+def default_training_rules(
+    *,
+    step_seconds: float = 10.0,
+    data_wait_frac: float = 0.5,
+    sustain_s: float = 0.0,
+) -> list:
+    """Training-side SLOs: a step-time ceiling (hung collectives / thrashing
+    show up here first) and an input-bound alarm on the measured data-wait
+    share of the window."""
+    return [
+        SloRule("step_time", "train_step_seconds", step_seconds,
+                sustain_s=sustain_s,
+                description="mean seconds per optimizer step"),
+        SloRule("data_wait", "train_data_wait_frac", data_wait_frac,
+                sustain_s=sustain_s,
+                description="fraction of window blocked on input"),
+    ]
+
+
+class SloMonitor:
+    """Evaluates rules against a registry; keeps per-rule breach state.
+
+    Thread-safe: the ticker thread, an HTTP handler rendering
+    ``/slo.json``, and a manual ``evaluate()`` may interleave. Callbacks
+    run inline on the evaluating thread and must be quick; a raising
+    callback is swallowed (the metrics plane must not take down the
+    serving plane)."""
+
+    def __init__(self, registry=None, rules=(), *, clock=time.monotonic,
+                 recorder=None):
+        self._registry = registry
+        self._clock = clock
+        self._recorder = recorder
+        self._lock = threading.Lock()
+        self._rules: list[SloRule] = []
+        self._state: dict[str, dict] = {}
+        self._callbacks: list = []
+        self._ticker: threading.Thread | None = None
+        self._stop = threading.Event()
+        reg = registry if registry is not None else _registry.get_registry()
+        self._breach_total = reg.counter(
+            "slo_breach_total", "SLO ok->breach transitions.",
+            labels=("rule",))
+        for r in rules:
+            self.add_rule(r)
+
+    # -- configuration ----------------------------------------------------
+
+    def add_rule(self, rule: SloRule) -> None:
+        with self._lock:
+            if any(r.name == rule.name for r in self._rules):
+                raise ValueError(f"duplicate SLO rule name {rule.name!r}")
+            self._rules.append(rule)
+            self._state[rule.name] = {
+                "status": "no_data", "value": None, "since": None,
+                "breaches": 0, "last_transition": None,
+            }
+
+    def add_callback(self, fn) -> None:
+        """``fn(rule: SloRule, status: str, value: float)`` on every
+        ok↔breach transition — the autoscaling/drain hook."""
+        with self._lock:
+            self._callbacks.append(fn)
+
+    @property
+    def rules(self) -> list:
+        with self._lock:
+            return list(self._rules)
+
+    # -- evaluation -------------------------------------------------------
+
+    def _resolve(self, rule: SloRule):
+        """Current aggregated reading for a rule, or None (no data)."""
+        reg = (self._registry if self._registry is not None
+               else _registry.get_registry())
+        fam = None
+        for f in reg.collect():
+            if f.name == rule.metric:
+                fam = f
+                break
+        if fam is None:
+            return None
+        insts = []
+        for label_values, inst in fam.children():
+            if rule.labels:
+                got = dict(zip(fam.label_names, label_values))
+                if any(got.get(k) != v for k, v in rule.labels.items()):
+                    continue
+            insts.append(inst)
+        if not insts:
+            return None
+        if fam.kind == "histogram":
+            if rule.aggregation in ("p50", "p95", "p99"):
+                q = float(rule.aggregation[1:])
+                vals = [i.percentile(q) for i in insts if i.count]
+                return max(vals) if vals else None
+            summaries = [i.summary() for i in insts]
+            total_count = sum(s["count"] for s in summaries)
+            if rule.aggregation == "count":
+                return float(total_count)
+            if total_count == 0:
+                return None
+            if rule.aggregation == "max":
+                return max(s["max"] for s in summaries)
+            # mean / value: lifetime-weighted mean across children.
+            return (sum(s["mean"] * s["count"] for s in summaries)
+                    / total_count)
+        values = [i.value for i in insts]
+        if rule.aggregation == "max":
+            return max(values)
+        if fam.kind == "counter" or rule.aggregation in ("count", "mean"):
+            total = sum(values)
+            return total / len(values) if rule.aggregation == "mean" else total
+        # Gauges aggregate by max: the worst replica is the honest fleet
+        # reading for a threshold alarm.
+        return max(values)
+
+    def evaluate(self) -> dict:
+        """One evaluation pass over every rule; returns :meth:`status`."""
+        now = self._clock()
+        transitions = []
+        with self._lock:
+            rules = list(self._rules)
+        for rule in rules:
+            value = self._resolve(rule)
+            with self._lock:
+                st = self._state[rule.name]
+                if value is None:
+                    if st["status"] not in ("breach",):
+                        st["status"] = "no_data"
+                    st["value"] = None
+                    continue
+                bad = (value > rule.threshold if rule.direction == "above"
+                       else value < rule.threshold)
+                st["value"] = value
+                if bad:
+                    if st["status"] in ("ok", "no_data"):
+                        st["since"] = now
+                        st["status"] = "pending"
+                    if (st["status"] == "pending"
+                            and now - st["since"] >= rule.sustain_s):
+                        st["status"] = "breach"
+                        st["breaches"] += 1
+                        st["last_transition"] = now
+                        transitions.append((rule, "breach", value))
+                else:
+                    if st["status"] == "breach":
+                        st["last_transition"] = now
+                        transitions.append((rule, "ok", value))
+                    st["status"] = "ok"
+                    st["since"] = None
+        for rule, status, value in transitions:
+            self._emit(rule, status, value)
+        return self.status()
+
+    def _emit(self, rule: SloRule, status: str, value: float) -> None:
+        if status == "breach":
+            self._breach_total.labels(rule.name).inc()
+        event = "slo_breach" if status == "breach" else "slo_recovered"
+        _trace.trace_event(
+            event, rule=rule.name, metric=rule.metric,
+            aggregation=rule.aggregation, value=value,
+            threshold=rule.threshold, direction=rule.direction,
+        )
+        rec = (self._recorder if self._recorder is not None
+               else _recorder.get_recorder())
+        rec.record(kind="event", name=event, rule=rule.name,
+                   metric=rule.metric, value=value,
+                   threshold=rule.threshold)
+        with self._lock:
+            callbacks = list(self._callbacks)
+        for fn in callbacks:
+            try:
+                fn(rule, status, value)
+            except Exception:  # noqa: BLE001 — see class docstring
+                pass
+
+    # -- readout ----------------------------------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return any(s["status"] == "breach" for s in self._state.values())
+
+    def status(self) -> dict:
+        """JSON-ready: overall degraded flag + per-rule state (what
+        ``GET /slo.json`` serves)."""
+        with self._lock:
+            rules = {
+                r.name: {
+                    "status": self._state[r.name]["status"],
+                    "value": self._state[r.name]["value"],
+                    "breaches": self._state[r.name]["breaches"],
+                    "metric": r.metric,
+                    "aggregation": r.aggregation,
+                    "threshold": r.threshold,
+                    "direction": r.direction,
+                    "sustain_s": r.sustain_s,
+                    "description": r.description,
+                }
+                for r in self._rules
+            }
+        return {
+            "degraded": any(v["status"] == "breach" for v in rules.values()),
+            "num_rules": len(rules),
+            "rules": rules,
+        }
+
+    # -- ticker -----------------------------------------------------------
+
+    def start(self, interval_s: float = 1.0) -> None:
+        """Evaluate on a daemon thread every ``interval_s`` seconds."""
+        if self._ticker is not None:
+            raise RuntimeError("SLO ticker already started")
+        self._stop.clear()
+
+        def tick():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.evaluate()
+                except Exception:  # noqa: BLE001 — keep ticking
+                    pass
+
+        self._ticker = threading.Thread(
+            target=tick, name="slo-monitor", daemon=True)
+        self._ticker.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if self._ticker is None:
+            return
+        self._stop.set()
+        self._ticker.join(timeout)
+        self._ticker = None

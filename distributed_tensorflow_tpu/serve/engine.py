@@ -75,6 +75,7 @@ class SlotEngine:
         max_len: int | None = None,
         prefill_len: int | None = None,
         steps_per_sync: int = 1,
+        sentinel=None,
     ):
         max_len = int(max_len or cfg.max_seq_len)
         prefill_len = int(prefill_len or max(1, max_len // 2))
@@ -95,6 +96,10 @@ class SlotEngine:
         self.max_len = max_len
         self.prefill_len = prefill_len
         self.steps_per_sync = int(steps_per_sync)
+        # Optional obs.perf.RecompileSentinel: fed the compile-cache size
+        # after warmup and every round, it turns the zero-recompile
+        # invariant into the alerting ``recompile_events_total`` metric.
+        self.sentinel = sentinel
         self.pool = SlotKVPool(cfg, self.slots, max_len)
 
         # Per-slot host registers. Fixed dtypes — the jit signatures (and
@@ -281,6 +286,8 @@ class SlotEngine:
         self.made[slot] = 1
         self.budget[slot] = max_new_tokens
         self.eos[slot] = eos
+        if self.sentinel is not None:
+            self.sentinel.poll(self.compile_count())
         return first, finished
 
     def step(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -316,6 +323,8 @@ class SlotEngine:
         self.cur_tok = np.array(tok)
         self.made = np.array(made)
         done = was_active & ~self.active
+        if self.sentinel is not None:
+            self.sentinel.poll(self.compile_count())
         return np.asarray(toks), np.asarray(valid), done
 
     # -- warmup / zero-recompile accounting -------------------------------
@@ -347,7 +356,14 @@ class SlotEngine:
                 self.release(slot)
             slot = self.acquire_slot()
         self.release(slot)
-        return self.compile_count()
+        n = self.compile_count()
+        if self.sentinel is not None:
+            # Sync the poll base to the warmed cache size, then draw the
+            # warm line: any compile the sentinel sees from here on counts
+            # as recompile_events_total (the SLO-alerting condition).
+            self.sentinel.poll(n)
+            self.sentinel.mark_warm()
+        return n
 
     def compile_count(self) -> int:
         """Total compiled programs across the engine's jitted callables —

@@ -32,7 +32,14 @@ Endpoints:
   ``{"ok": false, ...}`` once the scheduler is shutting down (stopped
   accepting) or its started loop thread has died. The body always reports
   ``accepting``, ``loop_running``, slots, and queue depth so a probe's
-  failure reason is one curl away.
+  failure reason is one curl away. With an SLO monitor attached, the body
+  also carries ``slo: "ok"|"degraded"`` — a sustained breach flips it to
+  ``degraded`` but the status stays 200: an SLO-burning replica is slow,
+  not dead, and killing it under load would make the breach worse. The
+  router drains on ``degraded``; the orchestrator restarts on 503.
+* ``GET /slo.json`` — 200 ``SloMonitor.status()`` (per-rule state, value,
+  threshold, breach count), or ``{"enabled": false}`` when no monitor was
+  attached.
 * ``GET /metrics`` — 200 Prometheus text exposition
   (``text/plain; version=0.0.4``) rendered from the ``ServingMetrics``
   registry: TTFT / per-token histograms, queue depth, occupancy, and
@@ -91,10 +98,12 @@ def make_server(
     *,
     request_timeout_s: float = 60.0,
     codec=None,
+    slo=None,
 ) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server; caller runs ``serve_forever()``
     and owns scheduler start/stop. ``port=0`` binds an ephemeral port
-    (tests read ``server.server_address``)."""
+    (tests read ``server.server_address``). ``slo`` is an optional
+    ``obs.slo.SloMonitor``; the caller owns its ticker lifecycle."""
 
     class Handler(BaseHTTPRequestHandler):
         # Serving logs go through metrics, not per-request stderr lines.
@@ -125,14 +134,25 @@ def make_server(
                 # is healthy; a STARTED loop whose thread died is not.
                 loop_ok = thread is None or thread.is_alive()
                 ok = bool(accepting and loop_ok)
-                self._send(200 if ok else 503, {
+                body = {
                     "ok": ok,
                     "accepting": bool(accepting),
                     "loop_running": scheduler.loop_running,
                     "slots": scheduler.engine.slots,
                     "free_slots": scheduler.engine.free_slots,
                     "queue_depth": scheduler.queue_depth,
-                })
+                }
+                if slo is not None:
+                    # Degraded ≠ dead: still 200 (see module docstring).
+                    body["slo"] = "degraded" if slo.degraded else "ok"
+                self._send(200 if ok else 503, body)
+            elif self.path == "/slo.json":
+                if slo is None:
+                    self._send(200, {"enabled": False})
+                else:
+                    status = slo.status()
+                    status["enabled"] = True
+                    self._send(200, status)
             elif self.path == "/metrics":
                 if scheduler.metrics is None:
                     self._send_text(200, "")

@@ -217,6 +217,18 @@ class MnistTrainer:
         self._obs_examples_rate = reg.gauge(
             "train_examples_per_sec",
             "Global examples/s over the last drained training window.")
+        self._obs_wait_frac = reg.gauge(
+            "train_data_wait_frac",
+            "Data-wait share of the last window's wall time (the "
+            "input-bound alarm the default training SLO watches).")
+        self._perf = obs.PerfGauges(reg)
+        slo_rules = obs.parse_slo_flag(
+            getattr(cfg, "slo", ""),
+            defaults=obs.default_training_rules)
+        # Evaluated at eval boundaries (no ticker thread: the train loop
+        # already has a natural heartbeat, and a wall-clock ticker would
+        # race the window bookkeeping for no fresher data).
+        self._slo = obs.SloMonitor(reg, slo_rules) if slo_rules else None
         self._win_t0 = 0.0
         self._win_wait_base = 0.0
         self._win_stall_base = 0.0
@@ -433,6 +445,27 @@ class MnistTrainer:
             self._obs_skipped.inc(window_skipped)
         if steps_per_sec > 0:
             self._obs_examples_rate.set(steps_per_sec * self.global_batch)
+            self._perf.update_window(
+                steps_per_sec=steps_per_sec,
+                examples_per_step=self.global_batch,
+            )
+        if wall > 0:
+            self._obs_wait_frac.set(wait_d / wall)
+        obs.update_memory_gauges()  # no-op readings on CPU (graceful null)
+        if self._slo is not None:
+            self._slo.evaluate()
+        obs_dir = getattr(self.cfg, "obs_dir", "")
+        if obs_dir:
+            # Fleet plane: every process drops its snapshot; the chief
+            # merges whatever snapshots exist so far into the fleet view.
+            try:
+                obs.write_process_snapshot(obs_dir)
+                if self.is_chief:
+                    agg = obs.FleetAggregator()
+                    if agg.load_dir(obs_dir):
+                        agg.export(obs_dir)
+            except OSError:
+                pass  # observability must never kill the train step
         self._reset_window_obs(step)
 
     def _train_loop(self, prefetch, num_steps: int, step: int, timer: StepTimer) -> None:

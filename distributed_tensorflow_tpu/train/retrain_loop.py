@@ -410,6 +410,17 @@ class RetrainTrainer:
                         self.val_writer.add_scalars(
                             {"accuracy": val_acc, "cross_entropy": val_ce}, step
                         )
+                    obs.update_memory_gauges()
+                    obs_dir = getattr(cfg, "obs_dir", "")
+                    if obs_dir:
+                        try:
+                            obs.write_process_snapshot(obs_dir)
+                            if self.is_chief:
+                                agg = obs.FleetAggregator()
+                                if agg.load_dir(obs_dir):
+                                    agg.export(obs_dir)
+                        except OSError:
+                            pass
         self._maybe_save(step, force=True)
         train_time = clock.elapsed
         log.info("Training time: %.2fs", train_time)

@@ -77,6 +77,22 @@ def test_two_process_async_autosave_deferred_finalize(tmp_path):
         assert "restored checkpoint at step 8" in outs[i], outs[i]
 
 
+def test_two_process_obs_aggregation(tmp_path):
+    """Fleet observability acceptance: two real training processes share an
+    --obs_dir, each drops fleet_p<i>.json snapshots through the live train
+    loop, and the chief's merged registry shows summed counters
+    (train_steps_total 8+8=16), bucket-merged histograms, and per-process
+    gauge children with rollups (asserted inside the worker)."""
+    import json
+
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    _run_workers("mp_obs_agg_worker.py", str(obs_dir), "OBS_AGG_WORKER_{i}_OK")
+    merged = json.loads((obs_dir / "fleet_merged.json").read_text())
+    assert merged["metrics"]["train_steps_total"]["samples"][0]["value"] == 16
+    assert "train_examples_per_sec_sum" in merged["metrics"]
+
+
 def test_demo2_two_process_end_to_end(tmp_path):
     """The full demo2 workload over two real processes (fused steps_per_call
     path): training runs, params stay bitwise-consistent across processes

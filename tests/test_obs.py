@@ -364,6 +364,36 @@ def test_excepthook_dumps_timeline(tmp_path, capsys):
     assert exc_ev["error"] == "ValueError: unhandled-test"
 
 
+def test_threading_excepthook_dumps_on_daemon_thread_crash(tmp_path, capsys):
+    """A daemon thread dying (checkpoint snapshot thread, scheduler loop)
+    must leave a flight-record dump, not evaporate silently — the
+    ``threading.excepthook`` half of ``install_excepthook``."""
+    obs.set_dump_dir(str(tmp_path))
+    obs.install_excepthook()
+    with obs.span("background_work"):
+        pass
+
+    def doomed():
+        raise RuntimeError("thread-crash-test")
+
+    th = threading.Thread(target=doomed, name="doomed-worker", daemon=True)
+    th.start()
+    th.join(10.0)
+    assert not th.is_alive()
+    capsys.readouterr()  # swallow the chained default traceback print
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flight_unhandled_thread_exception")]
+    assert len(dumps) == 1, os.listdir(tmp_path)
+    lines = [json.loads(ln) for ln in open(tmp_path / dumps[0])]
+    events = lines[1:]
+    crash = next(e for e in events
+                 if e.get("name") == "unhandled_thread_exception")
+    assert crash["error"] == "RuntimeError: thread-crash-test"
+    assert crash["thread"] == "doomed-worker"
+    # The pre-crash timeline rode along in the dump.
+    assert any(e.get("name") == "background_work" for e in events)
+
+
 # ---------------------------------------------------------------------------
 # satellite (b): data-pipeline consumer starvation is measured, not inferred
 # ---------------------------------------------------------------------------

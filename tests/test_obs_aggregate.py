@@ -1,0 +1,222 @@
+"""Fleet aggregation tests: per-kind merge semantics (counters sum, gauges
+get process identity + rollups, histograms merge exact bucket ladders and
+subsample reservoirs deterministically), the snapshot file feed with torn
+files, FleetAggregator push/replace/export, and the Prometheus round-trip
+of LABELED histogram families — escaped label values and the implicit
+``+Inf`` bucket — through the minimal parser."""
+
+import json
+import math
+import os
+
+import pytest
+
+from distributed_tensorflow_tpu.obs import aggregate as agg
+from distributed_tensorflow_tpu.obs.export import (
+    parse_prometheus_text,
+    prometheus_text,
+)
+from distributed_tensorflow_tpu.obs.registry import MetricsRegistry
+
+pytestmark = [pytest.mark.obs, pytest.mark.slo]
+
+BUCKETS = (0.1, 0.5, 1.0, 5.0)
+
+
+def _process_registry(proc: int) -> MetricsRegistry:
+    """One fake process's registry: a labeled counter, a gauge, and a
+    histogram with a fixed ladder, all seeded with process-dependent data."""
+    reg = MetricsRegistry()
+    steps = reg.counter("train_steps_total", "steps", labels=("job",))
+    steps.labels("train").inc(8 * (proc + 1))
+    rate = reg.gauge("train_examples_per_sec", "rate")
+    rate.set(10.0 + proc)
+    lat = reg.histogram("step_seconds", "latency", buckets=BUCKETS)
+    for v in (0.05, 0.3, 0.3, 0.7, 2.0):
+        lat.observe(v * (proc + 1))
+    return reg
+
+
+def _snapshots(n: int = 2) -> list[dict]:
+    return [agg.full_snapshot(_process_registry(i), process=i)
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# merge semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counters_sum_per_label_tuple():
+    merged = agg.merge_snapshots(_snapshots(2))
+    fam = merged.counter("train_steps_total", "steps", labels=("job",))
+    children = dict(fam.children())
+    assert children[("train",)].value == 8 + 16
+
+
+def test_gauges_get_process_label_and_rollups():
+    merged = agg.merge_snapshots(_snapshots(2))
+    fam = merged.gauge("train_examples_per_sec", "rate", labels=("process",))
+    children = dict(fam.children())
+    assert children[("0",)].value == 10.0
+    assert children[("1",)].value == 11.0
+    # The fleet aggregate is one selector away: min/max/sum rollups over the
+    # original (here: empty) label set.
+    for suffix, want in (("min", 10.0), ("max", 11.0), ("sum", 21.0)):
+        rfam = merged.gauge(f"train_examples_per_sec_{suffix}", "")
+        assert rfam._solo().value == want, suffix
+
+
+def test_histograms_merge_exact_when_ladders_match():
+    merged = agg.merge_snapshots(_snapshots(2))
+    fam = merged.histogram("step_seconds", "latency", buckets=BUCKETS)
+    inst = fam._solo()
+    assert inst.count == 10
+    # total is the exact sum: per-process observations at 1x and 2x scale.
+    base = 0.05 + 0.3 + 0.3 + 0.7 + 2.0
+    assert inst.total == pytest.approx(base * 3)
+    # buckets() is cumulative over finite les; the last finite bucket holds
+    # everything <= 5.0 (all 10 observations).
+    cum = dict(inst.buckets())
+    assert cum[5.0] == 10
+    # process 0's 0.05 plus process 1's 0.1 (bisect_left puts a value equal
+    # to a bound into that bound's bucket) -> two samples at le=0.1.
+    assert cum[0.1] == 2
+    assert 0.0 < inst.percentile(0.5) <= 5.0
+
+
+def test_histogram_ladder_mismatch_falls_back_to_rebucketing():
+    reg_a = MetricsRegistry()
+    reg_a.histogram("h", "x", buckets=BUCKETS).observe(0.3)
+    reg_b = MetricsRegistry()
+    # Different code revision: different ladder.
+    hb = reg_b.histogram("h", "x", buckets=(1.0, 10.0))
+    hb.observe(0.3)
+    hb.observe(7.0)
+    merged = agg.merge_snapshots([
+        agg.full_snapshot(reg_a, process=0),
+        agg.full_snapshot(reg_b, process=1),
+    ])
+    inst = merged.histogram("h", "x", buckets=BUCKETS)._solo()
+    # count/total stay exact even when buckets are approximated.
+    assert inst.count == 3
+    assert inst.total == pytest.approx(0.3 + 0.3 + 7.0)
+    cum = dict(inst.buckets())
+    # Re-bucketed from the reservoirs: both 0.3s land <= 0.5.
+    assert cum[0.5] == 2
+
+
+def test_reservoir_subsampling_is_proportional_and_deterministic():
+    reg_a = MetricsRegistry()
+    ha = reg_a.histogram("h", "x", buckets=BUCKETS, maxlen=100)
+    for _ in range(300):  # count 300, reservoir capped at 100
+        ha.observe(1.0)
+    reg_b = MetricsRegistry()
+    hb = reg_b.histogram("h", "x", buckets=BUCKETS, maxlen=100)
+    for _ in range(100):
+        hb.observe(2.0)
+    snaps = [agg.full_snapshot(reg_a, process=0),
+             agg.full_snapshot(reg_b, process=1)]
+    inst = agg.merge_snapshots(snaps).histogram(
+        "h", "x", buckets=BUCKETS, maxlen=100)._solo()
+    res = list(inst._samples)
+    assert len(res) == 100
+    # Shares proportional to lifetime counts: 300:100 -> 75:25.
+    assert res.count(1.0) == 75
+    assert res.count(2.0) == 25
+    # No RNG in the metrics plane: merging the same snapshots again yields
+    # the identical reservoir.
+    inst2 = agg.merge_snapshots(snaps).histogram(
+        "h", "x", buckets=BUCKETS, maxlen=100)._solo()
+    assert list(inst2._samples) == res
+
+
+def test_full_snapshot_survives_json_roundtrip():
+    snap = agg.full_snapshot(_process_registry(0), process=0)
+    back = json.loads(json.dumps(snap))
+    merged = agg.merge_snapshots([back])
+    assert merged.counter("train_steps_total", "steps",
+                          labels=("job",)).labels("train").value == 8
+    hist = merged.histogram("step_seconds", "latency", buckets=BUCKETS)._solo()
+    assert hist.count == 5
+
+
+# ---------------------------------------------------------------------------
+# file feed + FleetAggregator
+# ---------------------------------------------------------------------------
+
+
+def test_file_feed_skips_torn_snapshots(tmp_path):
+    for i in range(2):
+        agg.write_process_snapshot(str(tmp_path), _process_registry(i),
+                                   process=i)
+    # A crashed process's half-written file must not poison the chief.
+    (tmp_path / "fleet_p9.json").write_text('{"process": 9, "metr')
+    snaps = agg.load_process_snapshots(str(tmp_path))
+    assert [s["process"] for s in snaps] == [0, 1]
+
+
+def test_fleet_aggregator_push_replaces_and_exports(tmp_path):
+    fleet = agg.FleetAggregator()
+    fleet.push(agg.full_snapshot(_process_registry(0), process=0))
+    fleet.push(agg.full_snapshot(_process_registry(1), process=1))
+    # A later push for the same process replaces, never double-counts.
+    fleet.push(agg.full_snapshot(_process_registry(1), process=1))
+    assert fleet.num_processes == 2
+    reg = fleet.export(str(tmp_path))
+    assert reg.counter("train_steps_total", "steps",
+                       labels=("job",)).labels("train").value == 24
+    prom = (tmp_path / "fleet_merged.prom").read_text()
+    assert 'train_steps_total{job="train"} 24' in prom
+    snap = json.loads((tmp_path / "fleet_merged.json").read_text())
+    assert "train_examples_per_sec_sum" in snap["metrics"]
+
+
+def test_load_dir_then_merged_matches_push(tmp_path):
+    for i in range(2):
+        agg.write_process_snapshot(str(tmp_path), _process_registry(i),
+                                   process=i)
+    fleet = agg.FleetAggregator()
+    assert fleet.load_dir(str(tmp_path)) == 2
+    inst = fleet.merged().histogram("step_seconds", "latency",
+                                    buckets=BUCKETS)._solo()
+    assert inst.count == 10
+
+
+# ---------------------------------------------------------------------------
+# satellite: Prometheus round-trip of labeled histogram families
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_roundtrip_labeled_histogram_with_escapes():
+    reg = MetricsRegistry()
+    fam = reg.histogram("rpc_seconds", "per-route latency",
+                        labels=("route",), buckets=(0.1, 1.0))
+    tricky = 'he said "hi"\nback\\slash'
+    fam.labels(tricky).observe(0.05)
+    fam.labels(tricky).observe(0.5)
+    fam.labels(tricky).observe(99.0)  # beyond the last finite bucket
+    fam.labels("plain").observe(0.5)
+
+    samples = parse_prometheus_text(prometheus_text(reg))
+    tricky_buckets = {s["labels"]["le"]: s["value"] for s in samples
+                     if s["name"] == "rpc_seconds_bucket"
+                     and s["labels"].get("route") == tricky}
+    # Label escaping survived the round-trip, cumulative counts are
+    # monotone, and the implicit +Inf bucket equals the lifetime count.
+    assert tricky_buckets["0.1"] == 1
+    assert tricky_buckets["1"] == 2  # _fmt renders integral floats bare
+    assert tricky_buckets["+Inf"] == 3
+    count = next(s["value"] for s in samples
+                 if s["name"] == "rpc_seconds_count"
+                 and s["labels"]["route"] == tricky)
+    assert count == 3
+    total = next(s["value"] for s in samples
+                 if s["name"] == "rpc_seconds_sum"
+                 and s["labels"]["route"] == tricky)
+    assert total == pytest.approx(0.05 + 0.5 + 99.0)
+    plain = {s["labels"]["le"]: s["value"] for s in samples
+             if s["name"] == "rpc_seconds_bucket"
+             and s["labels"].get("route") == "plain"}
+    assert plain["+Inf"] == 1
+    assert not math.isnan(total)

@@ -201,6 +201,56 @@ def test_metrics_prometheus_text(stack):
     assert counts == sorted(counts)
 
 
+def test_slo_json_disabled_without_monitor(stack):
+    """A server built without an SLO monitor still answers /slo.json —
+    explicitly disabled, not 404 (probes can rely on the endpoint)."""
+    base, _, _ = stack
+    status, body = _get(base + "/slo.json")
+    assert status == 200
+    assert body == {"enabled": False}
+
+
+def test_concurrent_scrape_while_scheduler_mutates(stack):
+    """Hammer GET /metrics.json and GET /metrics from several threads while
+    the scheduler is actively completing requests: every scrape must be a
+    parseable 200 — the scrape path takes instrument locks, never a torn
+    read or a 500."""
+    from distributed_tensorflow_tpu.obs.export import parse_prometheus_text
+
+    base, _, _ = stack
+    failures = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                status, snap = _get(base + "/metrics.json")
+                assert status == 200 and snap["completed"] >= 0
+                status, _, text = _get_text(base + "/metrics")
+                assert status == 200
+                assert parse_prometheus_text(text)
+            except Exception as err:  # noqa: BLE001 — collected for assert
+                failures.append(repr(err))
+                return
+
+    threads = [threading.Thread(target=scraper, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(10):  # scheduler mutates metrics under the scrapes
+            status, body = _post(base + "/generate", {
+                "prompt": [i % CFG.vocab_size, 1], "max_new_tokens": 3,
+            })
+            assert status == 200, body
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not failures, failures
+    assert all(not t.is_alive() for t in threads)
+
+
 def test_queue_full_returns_429():
     """Sized-to-overflow: a scheduler that is NOT being driven, queue depth
     1 — the second HTTP submit must get a synchronous 429, not block."""

@@ -1,0 +1,405 @@
+"""SLO monitor tests: spec parsing, the per-rule breach state machine under
+a fake clock (sustain windows, recovery, no-data semantics), breach side
+effects (counter + flight record + callbacks), the recompile sentinel in
+both poll and listener mode, and the acceptance end-to-end: injected
+latency drives a rule ok -> breach -> ok over a live HTTP stack with
+``GET /slo.json`` and ``/healthz`` reflecting every state."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from distributed_tensorflow_tpu import obs
+from distributed_tensorflow_tpu.obs import recorder as obs_recorder
+from distributed_tensorflow_tpu.obs.registry import MetricsRegistry
+from distributed_tensorflow_tpu.obs.slo import (
+    SloMonitor,
+    SloRule,
+    default_serving_rules,
+    default_training_rules,
+    parse_slo_flag,
+    parse_slo_spec,
+)
+
+pytestmark = [pytest.mark.obs, pytest.mark.slo]
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs_state():
+    """Fresh global recorder/registry per test — trace_event and the
+    default-registry paths must not leak across tests."""
+    prev_recorder = obs.get_recorder()
+    prev_registry = obs.get_registry()
+    obs.set_recorder(obs_recorder.FlightRecorder())
+    obs.set_registry(MetricsRegistry())
+    yield
+    obs.set_recorder(prev_recorder)
+    obs.set_registry(prev_registry)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# rules + spec parsing
+# ---------------------------------------------------------------------------
+
+
+def test_rule_validation_rejects_nonsense():
+    with pytest.raises(ValueError, match="aggregation"):
+        SloRule("r", "m", 1.0, aggregation="p42")
+    with pytest.raises(ValueError, match="direction"):
+        SloRule("r", "m", 1.0, direction="sideways")
+    with pytest.raises(ValueError, match="sustain"):
+        SloRule("r", "m", 1.0, sustain_s=-1)
+
+
+def test_parse_slo_spec_full_and_minimal():
+    r = parse_slo_spec("serve_ttft_seconds:p99>0.5@5#ttft")
+    assert (r.name, r.metric, r.aggregation) == (
+        "ttft", "serve_ttft_seconds", "p99")
+    assert (r.threshold, r.sustain_s, r.direction) == (0.5, 5.0, "above")
+
+    r = parse_slo_spec("recompile_events_total>0")
+    assert r.name == "recompile_events_total_value"
+    assert (r.aggregation, r.sustain_s, r.labels) == ("value", 0.0, {})
+
+    r = parse_slo_spec('hbm_used_bytes{device="tpu:0"}>1e9')
+    assert r.labels == {"device": "tpu:0"}
+    assert r.threshold == 1e9
+
+    r = parse_slo_spec("tokens_per_second:mean<100@30")
+    assert (r.direction, r.aggregation, r.sustain_s) == ("below", "mean", 30.0)
+
+
+@pytest.mark.parametrize("bad", [
+    "", "no_comparator", "m>>1", "m>abc", "m:p99", "1metric>2",
+])
+def test_parse_slo_spec_malformed_raises(bad):
+    with pytest.raises(ValueError):
+        parse_slo_spec(bad)
+
+
+def test_parse_slo_flag_default_off_and_mixed():
+    assert parse_slo_flag("") == []
+    assert parse_slo_flag("off") == []
+    rules = parse_slo_flag("default, my_gauge>3#extra",
+                           defaults=default_serving_rules)
+    names = [r.name for r in rules]
+    assert names[:3] == ["ttft_p99", "queue_depth", "post_warmup_recompiles"]
+    assert names[-1] == "extra"
+    train = parse_slo_flag("default", defaults=default_training_rules)
+    assert [r.metric for r in train] == [
+        "train_step_seconds", "train_data_wait_frac"]
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+
+
+def _monitor(rules, clock=None, recorder=None):
+    reg = MetricsRegistry()
+    mon = SloMonitor(reg, rules, clock=clock or time.monotonic,
+                     recorder=recorder)
+    return reg, mon
+
+
+def test_sustain_window_delays_breach_and_recovery_resets():
+    clock = FakeClock()
+    rec = obs_recorder.FlightRecorder()
+    reg, mon = _monitor(
+        [SloRule("q", "serve_queue_depth_current", 10, sustain_s=5.0)],
+        clock=clock, recorder=rec)
+    gauge = reg.gauge("serve_queue_depth_current", "depth")
+    transitions = []
+    mon.add_callback(lambda rule, status, value: transitions.append(
+        (rule.name, status, value)))
+
+    gauge.set(3)
+    assert mon.evaluate()["rules"]["q"]["status"] == "ok"
+    gauge.set(50)
+    assert mon.evaluate()["rules"]["q"]["status"] == "pending"
+    clock.t += 3.0  # held only 3 of the required 5 seconds
+    assert mon.evaluate()["rules"]["q"]["status"] == "pending"
+    assert not mon.degraded
+    clock.t += 2.5
+    st = mon.evaluate()
+    assert st["rules"]["q"]["status"] == "breach"
+    assert st["degraded"] is True
+    assert mon.degraded
+    # Breach side effects: counter, flight record, callback.
+    breach_ctr = reg.counter("slo_breach_total", "", labels=("rule",))
+    assert breach_ctr.labels("q").value == 1
+    assert any(e.get("name") == "slo_breach" and e.get("rule") == "q"
+               for e in rec.events())
+    assert transitions == [("q", "breach", 50.0)]
+
+    # A dip below threshold clears instantly and fires the recovery hook.
+    gauge.set(2)
+    st = mon.evaluate()
+    assert st["rules"]["q"]["status"] == "ok"
+    assert st["degraded"] is False
+    assert transitions[-1] == ("q", "ok", 2.0)
+    assert any(e.get("name") == "slo_recovered" for e in rec.events())
+    # Re-breach needs the full sustain window again.
+    gauge.set(50)
+    assert mon.evaluate()["rules"]["q"]["status"] == "pending"
+    assert breach_ctr.labels("q").value == 1  # no second increment yet
+
+
+def test_sustain_zero_breaches_on_first_bad_reading():
+    clock = FakeClock()
+    reg, mon = _monitor([SloRule("r", "g", 1.0)], clock=clock)
+    reg.gauge("g", "x").set(5.0)
+    assert mon.evaluate()["rules"]["r"]["status"] == "breach"
+    assert mon.evaluate()["rules"]["r"]["breaches"] == 1  # edge-triggered
+
+
+def test_below_direction_throughput_floor():
+    clock = FakeClock()
+    reg, mon = _monitor(
+        [SloRule("tput", "tokens_per_second", 100.0, direction="below")],
+        clock=clock)
+    g = reg.gauge("tokens_per_second", "x")
+    g.set(500.0)
+    assert mon.evaluate()["rules"]["tput"]["status"] == "ok"
+    g.set(7.0)
+    assert mon.evaluate()["rules"]["tput"]["status"] == "breach"
+
+
+def test_unregistered_metric_reads_no_data_and_never_breaches():
+    reg, mon = _monitor([SloRule("r", "never_registered_metric", 1.0)])
+    st = mon.evaluate()["rules"]["r"]
+    assert (st["status"], st["value"], st["breaches"]) == ("no_data", None, 0)
+
+
+def test_breach_state_survives_no_data_readings():
+    """A rule evaluated against the process-default registry: breach, then
+    the metric vanishes (registry swap = process restart mid-incident) —
+    the breach must NOT silently read as recovered."""
+    mon = SloMonitor(None, [SloRule("r", "g", 1.0)])
+    obs.get_registry().gauge("g", "x").set(9.0)
+    assert mon.evaluate()["rules"]["r"]["status"] == "breach"
+    obs.set_registry(MetricsRegistry())  # metric gone
+    st = mon.evaluate()["rules"]["r"]
+    assert st["status"] == "breach"
+    assert st["value"] is None
+
+
+def test_histogram_p99_rule_and_labeled_counter_sum():
+    clock = FakeClock()
+    reg, mon = _monitor(
+        [SloRule("lat", "rpc_seconds", 0.1, aggregation="p99"),
+         SloRule("errs", "errors_total", 3, labels={"kind": "oom"})],
+        clock=clock)
+    hist = reg.histogram("rpc_seconds", "x")
+    for _ in range(200):
+        hist.observe(0.01)
+    errs = reg.counter("errors_total", "x", labels=("kind",))
+    errs.labels("oom").inc(2)
+    errs.labels("net").inc(50)  # label-filtered out of the rule
+    st = mon.evaluate()["rules"]
+    assert st["lat"]["status"] == "ok"
+    assert st["errs"]["status"] == "ok"
+    for _ in range(50):
+        hist.observe(2.0)  # fat tail: p99 now ~2s
+    errs.labels("oom").inc(5)
+    st = mon.evaluate()["rules"]
+    assert st["lat"]["status"] == "breach"
+    assert st["lat"]["value"] > 0.1
+    assert (st["errs"]["status"], st["errs"]["value"]) == ("breach", 7.0)
+
+
+def test_duplicate_rule_name_and_double_start_raise():
+    reg, mon = _monitor([SloRule("r", "g", 1.0)])
+    with pytest.raises(ValueError, match="duplicate"):
+        mon.add_rule(SloRule("r", "other", 2.0))
+    mon.start(interval_s=30.0)
+    try:
+        with pytest.raises(RuntimeError, match="already started"):
+            mon.start()
+    finally:
+        mon.stop()
+
+
+def test_raising_callback_does_not_break_evaluation():
+    reg, mon = _monitor([SloRule("r", "g", 1.0)])
+    seen = []
+    mon.add_callback(lambda *a: (_ for _ in ()).throw(RuntimeError("boom")))
+    mon.add_callback(lambda rule, status, value: seen.append(status))
+    reg.gauge("g", "x").set(9.0)
+    assert mon.evaluate()["rules"]["r"]["status"] == "breach"
+    assert seen == ["breach"]  # later callbacks still ran
+
+
+def test_ticker_thread_evaluates_without_manual_calls():
+    reg, mon = _monitor([SloRule("r", "g", 1.0)])
+    reg.gauge("g", "x").set(9.0)
+    mon.start(interval_s=0.01)
+    try:
+        deadline = time.monotonic() + 5.0
+        while not mon.degraded and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert mon.degraded
+    finally:
+        mon.stop()
+    assert mon._ticker is None
+
+
+# ---------------------------------------------------------------------------
+# recompile sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_poll_mode_counts_deltas_and_post_warm():
+    reg = MetricsRegistry()
+    s = obs.RecompileSentinel(reg, use_listener=False)
+    assert s.mode == "poll"
+    s.poll(3)  # baseline: pre-existing compiles are not events
+    assert s.events_total == 0
+    s.poll(5)
+    assert s.events_total == 2
+    assert s.post_warm_total == 0  # still warming up
+    s.mark_warm()
+    s.poll(6)
+    assert s.events_total == 3
+    assert s.post_warm_total == 1
+    assert reg.counter("recompile_events_total", "").value == 1
+    s.close()
+
+
+def test_sentinel_listener_mode_sees_real_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    s = obs.RecompileSentinel(reg)
+    if s.mode != "listener":
+        pytest.skip("jax.monitoring listener API unavailable")
+    try:
+        @jax.jit
+        def f(x):
+            return x * 2 + 1
+
+        f(jnp.ones((3,))).block_until_ready()
+        warm = s.events_total
+        assert warm >= 1
+        s.mark_warm()
+        f(jnp.ones((7,))).block_until_ready()  # new shape -> recompile
+        assert s.events_total > warm
+        assert s.post_warm_total >= 1
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: injected latency drives ok -> breach -> ok over live HTTP
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.mark.serve
+def test_slo_json_reflects_breach_and_recovery_over_http():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from distributed_tensorflow_tpu.serve import (
+        Scheduler,
+        ServingMetrics,
+        SlotEngine,
+    )
+    from distributed_tensorflow_tpu.serve.server import make_server
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, num_heads=4, num_layers=2, d_ff=64,
+        max_seq_len=32, compute_dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    engine = SlotEngine(cfg, params, slots=2, max_len=32, prefill_len=12)
+    metrics = ServingMetrics()
+    sched = Scheduler(engine, max_queue_depth=8, metrics=metrics)
+    rec = obs_recorder.FlightRecorder()
+    monitor = SloMonitor(
+        metrics.registry,
+        [SloRule("ttft_p99", "serve_ttft_seconds", 0.05, aggregation="p99")],
+        recorder=rec)
+    transitions = []
+    monitor.add_callback(lambda rule, status, value: transitions.append(
+        (rule.name, status)))
+    server = make_server(sched, port=0, request_timeout_s=30.0, slo=monitor)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    host, port = server.server_address
+    base = f"http://{host}:{port}"
+    try:
+        # No traffic yet: enabled, not degraded, rule has no data.
+        status, body = _get(base + "/slo.json")
+        assert status == 200
+        assert body["enabled"] is True
+        assert body["degraded"] is False
+        assert body["rules"]["ttft_p99"]["status"] == "no_data"
+
+        # Healthy traffic (injected 1 ms TTFTs) -> ok everywhere.
+        for _ in range(50):
+            metrics.ttft.observe(0.001)
+        monitor.evaluate()
+        _, body = _get(base + "/slo.json")
+        assert body["rules"]["ttft_p99"]["status"] == "ok"
+        status, health = _get(base + "/healthz")
+        assert (status, health["slo"]) == (200, "ok")
+
+        # Injected latency regression: p99 shoots past the 50 ms objective.
+        for _ in range(50):
+            metrics.ttft.observe(1.0)
+        monitor.evaluate()
+        status, body = _get(base + "/slo.json")
+        assert status == 200
+        assert body["degraded"] is True
+        rule = body["rules"]["ttft_p99"]
+        assert rule["status"] == "breach"
+        assert rule["value"] > 0.05
+        assert rule["breaches"] == 1
+        # Degraded is an alert, not an outage: healthz stays 200.
+        status, health = _get(base + "/healthz")
+        assert (status, health["ok"], health["slo"]) == (200, True, "degraded")
+        assert metrics.registry.counter(
+            "slo_breach_total", "", labels=("rule",)
+        ).labels("ttft_p99").value == 1
+        assert any(e.get("name") == "slo_breach" for e in rec.events())
+        assert transitions == [("ttft_p99", "breach")]
+
+        # Recovery: the reservoir refills with healthy latencies.
+        for _ in range(metrics.ttft._solo()._samples.maxlen):
+            metrics.ttft.observe(0.001)
+        monitor.evaluate()
+        _, body = _get(base + "/slo.json")
+        assert body["degraded"] is False
+        assert body["rules"]["ttft_p99"]["status"] == "ok"
+        assert body["rules"]["ttft_p99"]["breaches"] == 1
+        _, health = _get(base + "/healthz")
+        assert health["slo"] == "ok"
+        assert transitions[-1] == ("ttft_p99", "ok")
+        assert any(e.get("name") == "slo_recovered" for e in rec.events())
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+        sched.stop()

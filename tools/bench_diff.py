@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Compare BENCH_LAST.json against the newest BENCH_r*.json in one command.
+
+The bench trajectory lives in two shapes: ``BENCH_LAST.json`` is the current
+session's record (headline metric + ``extra_metrics`` list), and the
+``BENCH_r<N>.json`` driver snapshots hold the previous sessions' runs —
+sometimes with a ``parsed`` headline dict, sometimes with ``parsed: null``
+and the metric objects only present as JSON fragments inside the truncated
+``tail`` string. This tool normalizes both shapes, prints per-metric deltas,
+and re-runs ``bench.enforce_floors`` over the current record so a
+``FLOORS`` / ``FRAC_FLOORS`` / ``FRAC_CEILS`` regression exits nonzero —
+the reviewable one-command answer to "did this PR cost us any benched win?".
+
+Note: the gates are the FULL-suite floors. A ``BENCH_SMOKE=1`` record
+(tiny shapes, partial metric set) trips them by design — the nonzero exit
+is the honest answer to "is this record good enough to ship?", same reason
+``bench.enforce_floors`` treats a MISSING floored metric as a violation.
+
+Usage:
+  python tools/bench_diff.py                 # repo-root BENCH files
+  python tools/bench_diff.py --dir /path     # somewhere else
+  python tools/bench_diff.py --last X.json --ref BENCH_r04.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def flatten_last(record: dict) -> list[dict]:
+    """BENCH_LAST.json → flat metric list (headline first)."""
+    out = []
+    if "metric" in record:
+        out.append({k: v for k, v in record.items() if k != "extra_metrics"})
+    out.extend(record.get("extra_metrics") or [])
+    return out
+
+
+def _json_objects_in(text: str) -> list[dict]:
+    """Every parseable ``{"metric": ...}`` object embedded in ``text``.
+
+    The driver truncates ``tail`` from the FRONT, so the first fragment may
+    be clipped mid-object; balanced-brace scanning from each ``{"metric"``
+    start recovers every complete one and skips the torn one."""
+    objs = []
+    start = 0
+    while True:
+        i = text.find('{"metric"', start)
+        if i < 0:
+            break
+        depth, in_str, esc = 0, False, False
+        end = None
+        for j in range(i, len(text)):
+            ch = text[j]
+            if in_str:
+                if esc:
+                    esc = False
+                elif ch == "\\":
+                    esc = True
+                elif ch == '"':
+                    in_str = False
+                continue
+            if ch == '"':
+                in_str = True
+            elif ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    end = j + 1
+                    break
+        if end is None:
+            break
+        try:
+            obj = json.loads(text[i:end])
+            if isinstance(obj, dict) and "metric" in obj:
+                objs.append(obj)
+        except json.JSONDecodeError:
+            pass
+        start = (end if end is not None else i + 1)
+    return objs
+
+
+def metrics_from_run(record: dict) -> list[dict]:
+    """BENCH_r<N>.json → flat metric list. Prefers the structured ``parsed``
+    headline when present, then recovers the rest from the ``tail`` text
+    (deduplicated by name, later fragments win — the tail's final JSON line
+    is the run's complete record)."""
+    by_name: dict[str, dict] = {}
+    parsed = record.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        for m in flatten_last(parsed):
+            by_name[m["metric"]] = m
+    for obj in _json_objects_in(record.get("tail") or ""):
+        by_name[obj["metric"]] = obj
+    return list(by_name.values())
+
+
+def newest_run_file(bench_dir: str) -> str | None:
+    paths = glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))
+
+    def run_no(p):
+        try:
+            return int(json.load(open(p)).get("n", -1))
+        except (OSError, json.JSONDecodeError, ValueError):
+            return -1
+
+    return max(paths, key=run_no) if paths else None
+
+
+def diff_lines(cur: list[dict], ref: list[dict]) -> list[str]:
+    cur_by = {m["metric"]: m for m in cur if "metric" in m}
+    ref_by = {m["metric"]: m for m in ref if "metric" in m}
+    lines = []
+    for name in sorted(cur_by.keys() | ref_by.keys()):
+        c, r = cur_by.get(name), ref_by.get(name)
+        if c is None:
+            lines.append(f"  {name:<45} (dropped; was {r.get('value')})")
+            continue
+        if r is None:
+            lines.append(f"  {name:<45} {c.get('value')} (new)")
+            continue
+        cv, rv = c.get("value"), r.get("value")
+        if not isinstance(cv, (int, float)) or not isinstance(rv, (int, float)):
+            continue
+        delta = cv - rv
+        pct = f" ({delta / rv:+.1%})" if rv else ""
+        unit = c.get("unit", "")
+        lines.append(f"  {name:<45} {rv} -> {cv} {unit}  {delta:+g}{pct}")
+    return lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dir", default=".", help="where the BENCH files live")
+    parser.add_argument("--last", default="", help="override BENCH_LAST.json path")
+    parser.add_argument("--ref", default="", help="override reference BENCH_r*.json")
+    args = parser.parse_args(argv)
+
+    last_path = args.last or os.path.join(args.dir, "BENCH_LAST.json")
+    if not os.path.exists(last_path):
+        print(f"bench_diff: no {last_path}", file=sys.stderr)
+        return 2
+    cur = flatten_last(json.load(open(last_path)))
+
+    ref_path = args.ref or newest_run_file(args.dir)
+    if ref_path:
+        ref = metrics_from_run(json.load(open(ref_path)))
+        print(f"bench_diff: {last_path} vs {ref_path} "
+              f"({len(cur)} vs {len(ref)} metrics)")
+        for line in diff_lines(cur, ref):
+            print(line)
+    else:
+        print(f"bench_diff: {last_path} (no BENCH_r*.json reference found)")
+
+    import bench
+
+    problems = bench.enforce_floors(cur)
+    if problems:
+        print("bench_diff: GATE VIOLATIONS:", file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    print(f"bench_diff: all {len(bench.FLOORS)} floors / "
+          f"{len(bench.FRAC_FLOORS)} frac-floors / "
+          f"{len(bench.FRAC_CEILS)} frac-ceilings hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

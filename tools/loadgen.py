@@ -130,6 +130,47 @@ def _sched_submit(scheduler, payload, timeout_s, acct):
         acct.reject(outcome.reason)
 
 
+def _scrape_health(url, server):
+    """(slo_status_dict | None, recompile_events_total | None) from a live
+    target: HTTP mode scrapes ``/slo.json`` + ``/metrics`` (Prometheus
+    text); self-serve mode reads the in-process monitor/sentinel that
+    ``serve_lm.build_stack`` hung on the server object. Never raises — a
+    server without the endpoints just yields nulls."""
+    if url:
+        import urllib.request
+
+        base = url.rstrip("/")
+        slo = recompiles = None
+        try:
+            with urllib.request.urlopen(base + "/slo.json", timeout=5) as r:
+                slo = json.loads(r.read())
+        except Exception:
+            pass
+        try:
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+                text = r.read().decode()
+            from distributed_tensorflow_tpu.obs.export import (
+                parse_prometheus_text,
+            )
+
+            for sample in parse_prometheus_text(text):
+                if sample["name"] == "recompile_events_total":
+                    recompiles = int(sample["value"])
+        except Exception:
+            pass
+        return slo, recompiles
+    if server is None:
+        return None, None
+    slo = None
+    monitor = getattr(server, "slo_monitor", None)
+    if monitor is not None:
+        slo = monitor.evaluate()  # fresh read — no ticker in loadgen
+        slo["enabled"] = True
+    sentinel = getattr(server, "sentinel", None)
+    recompiles = sentinel.post_warm_total if sentinel is not None else None
+    return slo, recompiles
+
+
 def run_load(
     submit_one,
     *,
@@ -242,6 +283,7 @@ def main(argv=None):
         return payload
 
     scheduler = None
+    server = None
     if args.url:
         def submit_one(payload, timeout_s, acct):
             _http_submit(args.url.rstrip("/"), payload, timeout_s, acct)
@@ -285,6 +327,10 @@ def main(argv=None):
         make_payload=make_payload,
         timeout_s=args.timeout_s,
     )
+    # Scrape server health BEFORE teardown so the report record is
+    # self-describing: was the server SLO-degraded during this run, and did
+    # the engine recompile after warmup (it must not)?
+    slo_status, recompiles = _scrape_health(args.url, server)
     if scheduler is not None:
         scheduler.stop()
 
@@ -305,6 +351,8 @@ def main(argv=None):
             for k, v in _percentiles(acct.latency_s).items()
         },
         "mode": "open" if args.rate > 0 else "closed",
+        "slo": slo_status,
+        "recompile_events_total": recompiles,
         "t_wall": time.time(),
         "concurrency": args.concurrency,
         "rate": args.rate,

@@ -13,6 +13,7 @@ Example:
   curl -s localhost:8000/metrics        # Prometheus text exposition
   curl -s localhost:8000/metrics.json   # JSON summary snapshot
   curl -s localhost:8000/healthz        # 200 serving / 503 shutting down
+  curl -s localhost:8000/slo.json       # per-rule SLO state (--slo flag)
 
 With ``--obs_dir DIR``: periodic Prometheus-text + JSONL snapshots of the
 serving registry land in DIR, and any unhandled exception dumps the flight
@@ -50,7 +51,13 @@ class _ByteCodec:
 def build_stack(serve_cfg, cfg, params):
     """(engine, scheduler, metrics, http server) — warmed up, not started.
     Factored out so tests and loadgen --self-serve drive the same wiring
-    as the CLI."""
+    as the CLI.
+
+    The SLO monitor and recompile sentinel ride along as ``server.slo_monitor``
+    / ``server.sentinel`` attributes (the 4-tuple is a published contract).
+    The caller owns the monitor's ticker (``main()`` starts it; tests call
+    ``evaluate()`` by hand)."""
+    from distributed_tensorflow_tpu import obs
     from distributed_tensorflow_tpu.serve import (
         Scheduler,
         ServingMetrics,
@@ -58,6 +65,12 @@ def build_stack(serve_cfg, cfg, params):
     )
     from distributed_tensorflow_tpu.serve.server import make_server
 
+    metrics = ServingMetrics()
+    # Poll mode on purpose: cache-size deltas are scoped to THIS engine's
+    # programs, while the process-wide jax.monitoring listener would count
+    # unrelated jit compiles (other engines, tests, train steps) as
+    # serving recompiles.
+    sentinel = obs.RecompileSentinel(metrics.registry, use_listener=False)
     engine = SlotEngine(
         cfg,
         params,
@@ -65,14 +78,19 @@ def build_stack(serve_cfg, cfg, params):
         max_len=serve_cfg.serve_max_len or None,
         prefill_len=serve_cfg.prefill_len or None,
         steps_per_sync=serve_cfg.steps_per_sync,
+        sentinel=sentinel,
     )
     engine.warmup()
-    metrics = ServingMetrics()
     scheduler = Scheduler(
         engine,
         max_queue_depth=serve_cfg.max_queue_depth,
         metrics=metrics,
     )
+    slo_rules = obs.parse_slo_flag(
+        getattr(serve_cfg, "slo", "default"),
+        defaults=obs.default_serving_rules)
+    slo_monitor = (obs.SloMonitor(metrics.registry, slo_rules)
+                   if slo_rules else None)
     codec = _ByteCodec() if cfg.vocab_size == 256 else None
     server = make_server(
         scheduler,
@@ -80,7 +98,10 @@ def build_stack(serve_cfg, cfg, params):
         serve_cfg.port,
         request_timeout_s=serve_cfg.request_timeout_s,
         codec=codec,
+        slo=slo_monitor,
     )
+    server.slo_monitor = slo_monitor
+    server.sentinel = sentinel
     return engine, scheduler, metrics, server
 
 
@@ -177,6 +198,12 @@ def main(argv=None):
         prom_path = os.path.join(serve_cfg.obs_dir, "serve_metrics.prom")
         with open(prom_path, "w") as f:
             f.write(obs_export.prometheus_text(metrics.registry))
+        # Fleet plane: mergeable per-process snapshot next to the human
+        # exports, so a shared obs_dir across replicas aggregates.
+        from distributed_tensorflow_tpu.obs import aggregate as obs_aggregate
+
+        obs_aggregate.write_process_snapshot(
+            serve_cfg.obs_dir, metrics.registry)
 
     writer = None
     pub_step = [0]
@@ -200,12 +227,16 @@ def main(argv=None):
         ).start()
 
     scheduler.start()
+    if server.slo_monitor is not None:
+        server.slo_monitor.start(serve_cfg.slo_interval_s)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
         server.shutdown()
+        if server.slo_monitor is not None:
+            server.slo_monitor.stop()
         scheduler.stop()
         if writer is not None:
             metrics.publish(writer, pub_step[0] + 1)

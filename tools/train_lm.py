@@ -142,9 +142,16 @@ def main(argv=None):
     )
     parser.add_argument(
         "--obs_dir", default="",
-        help="observability output dir: per-boundary metrics.jsonl "
-             "snapshots + flight-recorder crash dumps (unhandled "
-             "exceptions dump the last-N-events timeline here)",
+        help="observability output dir: per-boundary metrics.jsonl + "
+             "per-process fleet_p<i>.json snapshots (chief merges them to "
+             "fleet_merged.prom/json) + flight-recorder crash dumps "
+             "(unhandled exceptions dump the last-N-events timeline here)",
+    )
+    parser.add_argument(
+        "--slo", default="",
+        help="SLO rules evaluated at eval boundaries (needs --obs_dir): "
+             "'default' (step time, data-wait), 'off', and/or "
+             "comma-separated 'metric[:agg]>thr[@sustain][#name]' specs",
     )
     parser.add_argument("--profile_start_step", type=int, default=5)
     parser.add_argument("--profile_num_steps", type=int, default=3)
@@ -191,6 +198,10 @@ def main(argv=None):
         obs_rate = obs_reg.gauge(
             "lm_tokens_per_sec", "Tokens/s over the last drained window.")
         obs_steps = obs_reg.counter("lm_steps_total", "Optimizer steps completed.")
+        obs_perf = obs.PerfGauges(obs_reg)
+        slo_rules = obs.parse_slo_flag(
+            args.slo, defaults=obs.default_training_rules)
+        slo_monitor = obs.SloMonitor(obs_reg, slo_rules) if slo_rules else None
 
     cluster = ClusterConfig(
         worker_hosts=args.worker_hosts,
@@ -501,10 +512,27 @@ def main(argv=None):
                 obs_steps.inc(max(step_now - start - int(obs_steps.value), 0))
                 if timer.steps_per_sec > 0:
                     obs_rate.set(tokens_per_sec)
+                    # Live MFU/roofline plane: the same arithmetic as the
+                    # stdout record above, but as scrape-able gauges
+                    # (train_mfu stays unset off-TPU — graceful null).
+                    obs_perf.update_window(
+                        steps_per_sec=timer.steps_per_sec,
+                        tokens_per_step=args.batch_size * args.seq_len,
+                        examples_per_step=args.batch_size,
+                        model_cfg=cfg if args.parallelism != "ep" else None,
+                        batch_size=args.batch_size,
+                    )
+                obs.update_memory_gauges()
+                if slo_monitor is not None:
+                    slo_monitor.evaluate()
+                obs.write_process_snapshot(args.obs_dir)
                 if chief:
                     obs_export.write_jsonl_snapshot(
                         os.path.join(args.obs_dir, "metrics.jsonl")
                     )
+                    agg = obs.FleetAggregator()
+                    if agg.load_dir(args.obs_dir):
+                        agg.export(args.obs_dir)
             if chief:
                 record = {
                     "step": step_now,
