@@ -458,3 +458,62 @@ class ServeConfig:
         default=1.0,
         metadata={"help": "SLO monitor evaluation tick period"},
     )
+    drain_deadline_s: float = field(
+        default=10.0,
+        metadata={
+            "help": "SIGTERM grace: seconds the server keeps finishing "
+            "accepted work (healthz 503, no new submits) before hard stop"
+        },
+    )
+    lane_weights: str = field(
+        default="8,4,1",
+        metadata={
+            "help": "admissions per scheduling cycle for priority lanes "
+            "0 (interactive), 1 (normal), 2 (batch) under contention"
+        },
+    )
+
+    @property
+    def lane_weight_tuple(self) -> tuple:
+        return tuple(int(w) for w in self.lane_weights.split(","))
+
+
+@dataclass
+class FleetConfig:
+    """Router tier over N replicas (``serve/fleet/``,
+    ``tools/serve_fleet.py``). Flag names carry a ``router_``/``fleet_``
+    prefix so they compose with :class:`ServeConfig` in one parser (the
+    launcher forwards the serve flags to every replica)."""
+
+    router_host: str = field(default="127.0.0.1", metadata={"help": "router bind address"})
+    router_port: int = field(
+        default=8100, metadata={"help": "router bind port; 0 = ephemeral"}
+    )
+    num_replicas: int = field(
+        default=2, metadata={"help": "local replicas the launcher spawns"}
+    )
+    probe_interval_s: float = field(
+        default=0.25, metadata={"help": "health-check period per replica"}
+    )
+    up_after: int = field(
+        default=2,
+        metadata={"help": "consecutive healthy probes before down->up"},
+    )
+    down_after: int = field(
+        default=2,
+        metadata={"help": "consecutive failed probes before up->down"},
+    )
+    max_attempts: int = field(
+        default=3,
+        metadata={"help": "dispatch tries per request (1 + failovers)"},
+    )
+    fleet_slo: str = field(
+        default="default",
+        metadata={
+            "help": "router SLO rules: 'default' (fleet_pressure, up-replica "
+            "floor, routed p99 TTFT), 'off', and/or compact specs"
+        },
+    )
+    fleet_slo_interval_s: float = field(
+        default=1.0, metadata={"help": "router SLO evaluation tick period"}
+    )

@@ -73,6 +73,7 @@ from distributed_tensorflow_tpu.obs.registry import (
 from distributed_tensorflow_tpu.obs.slo import (
     SloMonitor,
     SloRule,
+    default_fleet_rules,
     default_serving_rules,
     default_training_rules,
     parse_slo_flag,
@@ -90,6 +91,7 @@ __all__ = [
     "update_memory_gauges",
     "SloMonitor",
     "SloRule",
+    "default_fleet_rules",
     "default_serving_rules",
     "default_training_rules",
     "parse_slo_flag",

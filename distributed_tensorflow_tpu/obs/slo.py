@@ -54,6 +54,7 @@ __all__ = [
     "parse_slo_flag",
     "default_serving_rules",
     "default_training_rules",
+    "default_fleet_rules",
 ]
 
 _AGGREGATIONS = ("value", "mean", "max", "count", "p50", "p95", "p99")
@@ -163,6 +164,30 @@ def default_serving_rules(
                 description="admission queue backlog"),
         SloRule("post_warmup_recompiles", "recompile_events_total", 0,
                 description="XLA compiles after engine warmup"),
+    ]
+
+
+def default_fleet_rules(
+    *,
+    pressure: float = 0.85,
+    min_up_replicas: float = 1,
+    ttft_p99_s: float = 1.0,
+    sustain_s: float = 5.0,
+) -> list:
+    """Fleet-router SLOs over the gauges ``serve/fleet`` maintains:
+    sustained demand beyond up-capacity (the scale-UP signal), the
+    healthy-replica floor (instant — zero up replicas is an outage, not a
+    trend), and routed tail TTFT as the user-visible latency objective."""
+    return [
+        SloRule("fleet_pressure", "fleet_pressure", pressure,
+                sustain_s=sustain_s,
+                description="demand vs up-replica slot capacity"),
+        SloRule("fleet_up_replicas", "fleet_up_replicas", min_up_replicas,
+                direction="below",
+                description="healthy replica floor"),
+        SloRule("fleet_ttft_p99", "fleet_ttft_seconds", ttft_p99_s,
+                aggregation="p99", sustain_s=sustain_s,
+                description="router-observed p99 time-to-first-token"),
     ]
 
 

@@ -1,0 +1,33 @@
+"""Serving fleet: a router tier over N ``serve/server.py`` replicas.
+
+This package is the serving-side analogue of the paper's
+chief-plus-workers cluster: the router is the coordination-only process
+(no model, no accelerator) and each replica is a worker owning its own
+engine, exactly as the TF ``ClusterSpec`` split puts the session-owning
+chief in front of parameter-holding workers.
+
+* :mod:`registry` — replica membership + active health-checking
+  (``/healthz`` poll + ``/metrics`` scrape) with an up→draining→down
+  state machine and flap hysteresis, plus least-loaded ``pick()``.
+* :mod:`router` — HTTP front door: dispatch with bounded failover,
+  unbuffered streaming proxy, fleet gauges / ``/fleet.json`` /
+  ``/metrics``, and SLO wiring over the fleet signals.
+"""
+
+from distributed_tensorflow_tpu.serve.fleet.registry import (
+    ProbeResult,
+    Replica,
+    ReplicaRegistry,
+)
+from distributed_tensorflow_tpu.serve.fleet.router import (
+    FleetRouter,
+    make_router_server,
+)
+
+__all__ = [
+    "ProbeResult",
+    "Replica",
+    "ReplicaRegistry",
+    "FleetRouter",
+    "make_router_server",
+]

@@ -1,0 +1,386 @@
+"""Replica registry: membership, active health-checking, least-loaded pick.
+
+Each replica runs a state machine fed by active probes (and by the
+router's dispatch-path errors, which are just probes that carried
+traffic):
+
+    up ──explicit drain signal (healthz: accepting=false)──▶ draining
+    up ──`down_after` consecutive probe failures──▶ down
+    down/draining ──`up_after` consecutive healthy probes──▶ up
+
+The asymmetry is deliberate. DRAINING transitions immediately on one
+probe: the replica itself said "stop sending" (SIGTERM drain, operator
+action) — that is a signal, not noise, and hysteresis would keep
+dispatching into a closing door. DOWN and the recovery back to UP are
+hysteretic (consecutive-count thresholds) because a single timed-out
+probe or one refused connect under load is often a flap; bouncing a
+replica's membership on every blip would churn the dispatch plane and
+amplify load spikes (every flap shifts traffic onto the survivors).
+
+A probe is one ``GET /healthz`` (liveness + accepting/draining + slot
+counts) plus one ``GET /metrics`` scrape for the point-in-time gauges
+(`serve_queue_depth_current`, `serve_slot_occupancy_current`,
+`serve_shed_total`) — the same text exposition any Prometheus would
+read, so the router needs no private replica API. A replica whose
+/healthz answers but whose /metrics fails still counts as alive; the
+scrape just keeps its last-known load figures.
+
+``pick(exclude=...)`` is the dispatch policy: the UP replica (not backed
+off via Retry-After) with the lowest load score
+
+    score = router-tracked inflight + queue_depth + occupancy * slots
+
+i.e. work the router already sent but hasn't finished, work queued at
+the replica, and work occupying slots right now. Probes refresh the
+scraped terms asynchronously; the inflight term is updated synchronously
+by the router, which is what keeps two concurrent dispatches from both
+seeing the same "emptiest" replica.
+
+Everything here is injectable for tests: ``probe`` (no HTTP needed),
+``clock``, and the obs registry receiving the fleet gauges.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+
+from distributed_tensorflow_tpu.obs.export import parse_prometheus_text
+
+__all__ = ["ProbeResult", "Replica", "ReplicaRegistry"]
+
+_STATE_VALUE = {"down": 0.0, "draining": 1.0, "up": 2.0}
+
+
+@dataclass
+class ProbeResult:
+    """One health-check observation of one replica."""
+
+    ok: bool                 # the replica answered /healthz at all
+    accepting: bool = False  # it will take new work (healthz body)
+    draining: bool = False   # explicit drain signal from the replica
+    slots: int = 0
+    queue_depth: int = 0
+    occupancy: float = 0.0
+    shed_total: float = 0.0
+    detail: str = ""
+
+
+@dataclass
+class Replica:
+    """Router-side view of one serving process. Mutable fields are
+    guarded by the owning registry's lock."""
+
+    replica_id: str
+    base_url: str
+    state: str = "down"  # until the first healthy probe says otherwise
+    inflight: int = 0    # dispatches the router has not seen finish
+    backoff_until: float = 0.0
+    ok_streak: int = 0
+    fail_streak: int = 0
+    last: ProbeResult = field(default_factory=lambda: ProbeResult(ok=False))
+    dispatched_total: int = 0
+    error_total: int = 0
+
+    def load_score(self) -> float:
+        return (self.inflight + self.last.queue_depth
+                + self.last.occupancy * self.last.slots)
+
+
+def http_probe(base_url: str, timeout_s: float = 2.0) -> ProbeResult:
+    """The default probe: GET /healthz (+ /metrics gauges, best-effort)."""
+    try:
+        try:
+            with urllib.request.urlopen(
+                    base_url + "/healthz", timeout=timeout_s) as resp:
+                body = json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            # 503 IS an answer: alive but not accepting (draining/stopping).
+            body = json.loads(err.read())
+    except Exception as exc:  # noqa: BLE001 — any transport failure = down
+        return ProbeResult(ok=False, detail=repr(exc))
+    result = ProbeResult(
+        ok=True,
+        accepting=bool(body.get("accepting", False)),
+        draining=bool(body.get("draining", not body.get("accepting", False))),
+        slots=int(body.get("slots", 0)),
+        queue_depth=int(body.get("queue_depth", 0)),
+        occupancy=(1.0 - body.get("free_slots", 0) / body["slots"]
+                   if body.get("slots") else 0.0),
+    )
+    try:
+        with urllib.request.urlopen(
+                base_url + "/metrics", timeout=timeout_s) as resp:
+            samples = parse_prometheus_text(resp.read().decode())
+        for s in samples:
+            if s["name"] == "serve_queue_depth_current":
+                result.queue_depth = int(s["value"])
+            elif s["name"] == "serve_slot_occupancy_current":
+                result.occupancy = float(s["value"])
+            elif s["name"] == "serve_shed_total":
+                result.shed_total = float(s["value"])
+    except Exception:  # noqa: BLE001 — healthz already proved liveness
+        pass
+    return result
+
+
+class ReplicaRegistry:
+    """Thread-safe replica membership + health state + fleet gauges.
+
+    ``registry`` is the obs MetricsRegistry the fleet gauges land in
+    (the router serves it at its own ``/metrics``); ``probe`` replaces
+    the HTTP prober in unit tests."""
+
+    def __init__(
+        self,
+        targets=(),
+        *,
+        registry=None,
+        probe=None,
+        up_after: int = 2,
+        down_after: int = 2,
+        probe_timeout_s: float = 2.0,
+        clock=time.monotonic,
+    ):
+        if up_after < 1 or down_after < 1:
+            raise ValueError("up_after/down_after must be >= 1")
+        self.up_after = int(up_after)
+        self.down_after = int(down_after)
+        self.clock = clock
+        self._probe = probe or (
+            lambda url: http_probe(url, timeout_s=probe_timeout_s))
+        self._lock = threading.Lock()
+        self._replicas: dict[str, Replica] = {}
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        if registry is None:
+            from distributed_tensorflow_tpu.obs.registry import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.metrics_registry = registry
+        r = registry
+        self._g_state = r.gauge(
+            "fleet_replica_state",
+            "Replica health: 2 up, 1 draining, 0 down.", labels=("replica",))
+        self._g_occupancy = r.gauge(
+            "fleet_replica_occupancy",
+            "Scraped slot occupancy per replica.", labels=("replica",))
+        self._g_queue = r.gauge(
+            "fleet_replica_queue_depth",
+            "Scraped admission queue depth per replica.",
+            labels=("replica",))
+        self._g_inflight = r.gauge(
+            "fleet_replica_inflight",
+            "Router-tracked dispatches awaiting completion.",
+            labels=("replica",))
+        self._g_shed = r.gauge(
+            "fleet_replica_shed_total",
+            "Scraped serve_shed_total per replica (rate = shed rate).",
+            labels=("replica",))
+        self._g_up = r.gauge(
+            "fleet_up_replicas", "Replicas currently in state up.")
+        self._g_pressure = r.gauge(
+            "fleet_pressure",
+            "Outstanding demand / up-replica slot capacity.")
+        self._c_probe_fail = r.counter(
+            "fleet_probe_failures_total",
+            "Probes that did not reach /healthz.", labels=("replica",))
+        for url in targets:
+            self.add(url)
+
+    # -- membership -------------------------------------------------------
+
+    def add(self, base_url: str, replica_id: str | None = None) -> Replica:
+        base_url = base_url.rstrip("/")
+        rid = replica_id or base_url.split("//", 1)[-1]
+        with self._lock:
+            if rid in self._replicas:
+                raise ValueError(f"duplicate replica id {rid!r}")
+            replica = Replica(replica_id=rid, base_url=base_url)
+            self._replicas[rid] = replica
+        return replica
+
+    @property
+    def replicas(self) -> list[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def get(self, replica_id: str) -> Replica | None:
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+    # -- health state machine ---------------------------------------------
+
+    def _apply_probe(self, replica: Replica, result: ProbeResult) -> None:
+        """State transition for one probe observation (lock held)."""
+        replica.last = result
+        if not result.ok:
+            replica.ok_streak = 0
+            replica.fail_streak += 1
+            self._c_probe_fail.labels(replica=replica.replica_id).inc()
+            if (replica.fail_streak >= self.down_after
+                    or replica.state == "draining"):
+                # A draining replica that stops answering is simply gone —
+                # no hysteresis on the way out of a shutdown.
+                replica.state = "down"
+            return
+        replica.fail_streak = 0
+        if result.draining or not result.accepting:
+            # The replica SAID stop: immediate, no hysteresis (docstring).
+            replica.ok_streak = 0
+            replica.state = "draining"
+            return
+        replica.ok_streak += 1
+        if replica.state != "up" and replica.ok_streak >= self.up_after:
+            replica.state = "up"
+
+    def probe_once(self) -> None:
+        """Probe every replica once and refresh the fleet gauges. Probes
+        run outside the lock (they do I/O); state updates inside."""
+        with self._lock:
+            targets = [(r, r.base_url) for r in self._replicas.values()]
+        results = [(replica, self._probe(url)) for replica, url in targets]
+        with self._lock:
+            for replica, result in results:
+                self._apply_probe(replica, result)
+            self._update_gauges_locked()
+
+    def note_dispatch(self, replica: Replica) -> None:
+        with self._lock:
+            replica.inflight += 1
+            replica.dispatched_total += 1
+            self._g_inflight.labels(replica=replica.replica_id).set(
+                float(replica.inflight))
+
+    def note_done(self, replica: Replica) -> None:
+        with self._lock:
+            replica.inflight = max(0, replica.inflight - 1)
+            self._g_inflight.labels(replica=replica.replica_id).set(
+                float(replica.inflight))
+
+    def note_error(self, replica: Replica) -> None:
+        """Dispatch-path connect/transport failure: same evidence as a
+        failed probe, observed with real traffic — feeds the same streak."""
+        with self._lock:
+            replica.error_total += 1
+            replica.ok_streak = 0
+            replica.fail_streak += 1
+            if replica.fail_streak >= self.down_after:
+                replica.state = "down"
+            self._update_gauges_locked()
+
+    def note_backoff(self, replica: Replica, seconds: float) -> None:
+        """Honor a Retry-After: no dispatches to this replica until the
+        advertised horizon (probes continue — backoff is not down)."""
+        with self._lock:
+            replica.backoff_until = max(
+                replica.backoff_until, self.clock() + max(0.0, seconds))
+
+    # -- dispatch policy --------------------------------------------------
+
+    def pick(self, exclude=()) -> Replica | None:
+        """Least-loaded UP replica not excluded and not in backoff."""
+        now = self.clock()
+        with self._lock:
+            candidates = [
+                r for r in self._replicas.values()
+                if r.state == "up" and r.replica_id not in exclude
+                and r.backoff_until <= now
+            ]
+            if not candidates:
+                return None
+            return min(candidates, key=lambda r: (r.load_score(),
+                                                  r.replica_id))
+
+    # -- fleet signals ----------------------------------------------------
+
+    def _update_gauges_locked(self) -> None:
+        up = 0
+        demand = 0.0
+        capacity = 0
+        for r in self._replicas.values():
+            rid = r.replica_id
+            self._g_state.labels(replica=rid).set(_STATE_VALUE[r.state])
+            self._g_occupancy.labels(replica=rid).set(r.last.occupancy)
+            self._g_queue.labels(replica=rid).set(float(r.last.queue_depth))
+            self._g_inflight.labels(replica=rid).set(float(r.inflight))
+            self._g_shed.labels(replica=rid).set(r.last.shed_total)
+            if r.state == "up":
+                up += 1
+                capacity += r.last.slots
+            if r.state in ("up", "draining"):
+                demand += (r.inflight + r.last.queue_depth
+                           + r.last.occupancy * r.last.slots)
+        self._g_up.set(float(up))
+        # No capacity (no up replicas yet, or healthz gave no slot count):
+        # saturate rather than divide by zero — with pending demand that IS
+        # infinite pressure, and the 1e6 sentinel trips any ">" rule while
+        # staying JSON-representable.
+        pressure = demand / capacity if capacity else (1e6 if demand else 0.0)
+        self._g_pressure.set(pressure)
+
+    def fleet_pressure(self) -> float:
+        with self._lock:
+            self._update_gauges_locked()
+            return self._g_pressure.value
+
+    def up_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values()
+                       if r.state == "up")
+
+    def snapshot(self) -> dict:
+        """JSON-ready fleet view (the router's /fleet.json)."""
+        with self._lock:
+            self._update_gauges_locked()
+            return {
+                "up_replicas": int(self._g_up.value),
+                "fleet_pressure": self._g_pressure.value,
+                "replicas": {
+                    r.replica_id: {
+                        "base_url": r.base_url,
+                        "state": r.state,
+                        "inflight": r.inflight,
+                        "queue_depth": r.last.queue_depth,
+                        "occupancy": r.last.occupancy,
+                        "slots": r.last.slots,
+                        "shed_total": r.last.shed_total,
+                        "dispatched_total": r.dispatched_total,
+                        "error_total": r.error_total,
+                        "backoff_s": max(0.0,
+                                         r.backoff_until - self.clock()),
+                        "draining": r.state == "draining",
+                    }
+                    for r in self._replicas.values()
+                },
+            }
+
+    # -- prober thread ----------------------------------------------------
+
+    def start(self, interval_s: float = 0.25) -> None:
+        if self._thread is not None:
+            raise RuntimeError("registry prober already started")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.probe_once()
+                except Exception:  # noqa: BLE001 — prober must not die
+                    pass
+                self._stop.wait(interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="fleet-prober", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout)
+        self._thread = None

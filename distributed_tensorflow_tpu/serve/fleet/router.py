@@ -1,0 +1,354 @@
+"""Fleet router: health-aware dispatch with bounded failover and an
+unbuffered streaming proxy.
+
+The router is deliberately model-free — it owns sockets and counters,
+never tensors — so one router instance fronts any number of replicas
+without competing with them for the accelerator (the paper's chief does
+exactly this: coordination on a device-less process).
+
+Dispatch contract per request:
+
+1. ``pick()`` the least-loaded UP replica, excluding ones already tried
+   for THIS request and ones inside a Retry-After backoff window.
+2. Proxy the request. Three outcomes:
+
+   * **forwarded** — the replica answered with a non-retryable status
+     (200, 400, …): relay status/body verbatim, tagged with
+     ``X-Replica`` / ``X-Attempts`` so loadgen can attribute.
+   * **retryable** — connect/transport error before any response, or a
+     429/503 answer: count a failover, honor any ``Retry-After`` by
+     backing the replica off, and try a DIFFERENT replica, up to
+     ``max_attempts`` total. Transport errors also feed the registry's
+     failure streak (traffic is a probe that costs nothing extra).
+   * **aborted** — the replica died MID-STREAM after bytes already
+     reached the client. Never retried: generation is non-idempotent
+     (a different replica would re-sample a different continuation and
+     the client has already seen a prefix), so the router closes the
+     connection and lets the truncated stream signal the failure.
+     ``fleet_stream_aborted_total`` counts these.
+
+3. Budget exhausted → relay the LAST retryable answer (its Retry-After
+   included) or a synthesized 503 ``no_upstream`` when nothing was
+   reachable; either way ``fleet_shed_total`` counts a routed shed.
+
+Streaming is proxied unbuffered: each ``read1()`` chunk from the replica
+is written + flushed to the client immediately, so the router adds no
+token batching and TTFT measured at the router (first body chunk of the
+SSE leg) is the figure a real user would see. Non-streaming TTFT is
+taken from the replica's own ``ttft_ms`` field (queue wait + prefill),
+which keeps ``fleet_ttft_seconds`` populated in both modes.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from distributed_tensorflow_tpu.obs import export as obs_export
+
+__all__ = ["FleetRouter", "make_router_server"]
+
+# Statuses worth trying on another replica: overload (429), and the
+# unavailable family (503 deadline/shutting_down/timeout). Everything
+# else is either success or the client's own fault — relay verbatim.
+_RETRYABLE_STATUS = frozenset({429, 503})
+
+_HOP_HEADERS = ("content-type", "retry-after")
+
+
+class _Forwarded(Exception):
+    """Internal flow control: the client has its answer."""
+
+
+class FleetRouter:
+    """Dispatch + proxy over a :class:`ReplicaRegistry`. Stateless per
+    request apart from the registry's inflight accounting; safe to call
+    from many HTTP handler threads at once."""
+
+    def __init__(
+        self,
+        registry,
+        *,
+        max_attempts: int = 3,
+        connect_timeout_s: float = 2.0,
+        read_timeout_s: float = 120.0,
+        clock=time.monotonic,
+    ):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.registry = registry
+        self.max_attempts = int(max_attempts)
+        self.connect_timeout_s = connect_timeout_s
+        self.read_timeout_s = read_timeout_s
+        self.clock = clock
+        r = registry.metrics_registry
+        self._c_dispatch = r.counter(
+            "fleet_dispatch_total", "Requests sent to a replica.",
+            labels=("replica",))
+        self._c_failover = r.counter(
+            "fleet_failover_total",
+            "Dispatch attempts retried on a different replica.")
+        self._c_shed = r.counter(
+            "fleet_shed_total",
+            "Requests the router answered 503 for (budget exhausted or "
+            "no up replica).")
+        self._c_stream_abort = r.counter(
+            "fleet_stream_aborted_total",
+            "Streams cut after bytes reached the client (never retried).")
+        self._h_ttft = r.histogram(
+            "fleet_ttft_seconds",
+            "Router-observed time to first token.")
+        self._h_latency = r.histogram(
+            "fleet_latency_seconds",
+            "Router-observed full-response latency.")
+
+    # -- attempt mechanics -------------------------------------------------
+
+    def _open(self, replica, body: bytes):
+        """One upstream POST /generate. Returns (conn, resp); raises
+        OSError-family on transport failure before a response exists."""
+        parsed = urllib.parse.urlsplit(replica.base_url)
+        conn = http.client.HTTPConnection(
+            parsed.hostname, parsed.port, timeout=self.connect_timeout_s)
+        try:
+            conn.request("POST", "/generate", body=body,
+                         headers={"Content-Type": "application/json"})
+            conn.sock.settimeout(self.read_timeout_s)
+            return conn, conn.getresponse()
+        except Exception:
+            conn.close()
+            raise
+
+    def _relay(self, handler, replica, attempt: int, resp,
+               started_at: float, streaming: bool) -> None:
+        """Forward a non-retryable upstream response to the client.
+        Raises _Forwarded when the client has been fully answered; lets
+        transport exceptions escape BEFORE the first forwarded byte so
+        the caller may retry, and converts mid-stream failures into an
+        aborted (closed, never retried) connection."""
+        ctype = resp.getheader("Content-Type", "application/json")
+        is_stream = streaming and ctype.startswith("text/event-stream")
+        if not is_stream:
+            data = resp.read()  # may raise -> still retryable, 0 bytes sent
+            handler.send_response(resp.status)
+            for name in _HOP_HEADERS:
+                value = resp.getheader(name)
+                if value is not None:
+                    handler.send_header(name.title(), value)
+            handler.send_header("Content-Length", str(len(data)))
+            handler.send_header("X-Replica", replica.replica_id)
+            handler.send_header("X-Attempts", str(attempt + 1))
+            handler.end_headers()
+            handler.wfile.write(data)
+            if resp.status == 200:
+                self._h_latency.observe(self.clock() - started_at)
+                try:
+                    ttft_ms = json.loads(data).get("ttft_ms")
+                    if ttft_ms is not None:
+                        self._h_ttft.observe(float(ttft_ms) / 1e3)
+                except (ValueError, AttributeError):
+                    pass
+            raise _Forwarded()
+        # SSE leg: headers first, then chunk-by-chunk, flush per chunk.
+        # The FIRST read happens before we commit the client response, so
+        # an upstream that accepted the socket but died pre-token is still
+        # retryable.
+        first = resp.read1(65536)  # may raise / be b"" -> retryable
+        if not first:
+            raise ConnectionError(
+                f"{replica.replica_id}: empty stream before first token")
+        handler.send_response(200)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("X-Replica", replica.replica_id)
+        handler.send_header("X-Attempts", str(attempt + 1))
+        handler.end_headers()
+        handler.wfile.write(first)
+        handler.wfile.flush()
+        self._h_ttft.observe(self.clock() - started_at)
+        try:
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                handler.wfile.write(chunk)
+                handler.wfile.flush()
+        except (OSError, http.client.HTTPException):
+            # Partial stream: the client already holds a prefix — close,
+            # count, and make sure nobody upstack retries (non-idempotent).
+            self._c_stream_abort.inc()
+            self.registry.note_error(replica)
+            try:
+                handler.wfile.flush()
+            except OSError:
+                pass
+            handler.close_connection = True
+            raise _Forwarded()
+        self._h_latency.observe(self.clock() - started_at)
+        raise _Forwarded()
+
+    @staticmethod
+    def _retry_after_s(resp) -> float | None:
+        value = resp.getheader("Retry-After")
+        if value is None:
+            return None
+        try:
+            return float(value)
+        except ValueError:
+            return None
+
+    # -- the dispatch loop -------------------------------------------------
+
+    def dispatch(self, handler, body: bytes, *, streaming: bool) -> None:
+        """Route one /generate to the fleet; always answers the client."""
+        started_at = self.clock()
+        tried: set[str] = set()
+        last_error = None  # (status, body_bytes, retry_after | None)
+        for attempt in range(self.max_attempts):
+            replica = self.registry.pick(exclude=tried)
+            if replica is None:
+                break
+            tried.add(replica.replica_id)
+            if attempt > 0:
+                self._c_failover.inc()
+            self._c_dispatch.labels(replica=replica.replica_id).inc()
+            self.registry.note_dispatch(replica)
+            conn = None
+            try:
+                try:
+                    conn, resp = self._open(replica, body)
+                except (OSError, http.client.HTTPException) as exc:
+                    self.registry.note_error(replica)
+                    last_error = (
+                        503,
+                        json.dumps({"error": "upstream_unreachable",
+                                    "replica": replica.replica_id,
+                                    "detail": repr(exc)}).encode(),
+                        None,
+                    )
+                    continue
+                if resp.status in _RETRYABLE_STATUS:
+                    retry_after = self._retry_after_s(resp)
+                    if retry_after is not None:
+                        self.registry.note_backoff(replica, retry_after)
+                    last_error = (resp.status, resp.read(), retry_after)
+                    continue
+                try:
+                    self._relay(handler, replica, attempt, resp,
+                                started_at, streaming)
+                except (OSError, http.client.HTTPException) as exc:
+                    # Died before any byte reached the client: retryable.
+                    self.registry.note_error(replica)
+                    last_error = (
+                        503,
+                        json.dumps({"error": "upstream_died",
+                                    "replica": replica.replica_id,
+                                    "detail": repr(exc)}).encode(),
+                        None,
+                    )
+                    continue
+            except _Forwarded:
+                return
+            finally:
+                self.registry.note_done(replica)
+                if conn is not None:
+                    conn.close()
+        # Budget exhausted or no pickable replica.
+        self._c_shed.inc()
+        if last_error is not None:
+            status, data, retry_after = last_error
+        else:
+            status, data, retry_after = 503, json.dumps({
+                "error": "no_upstream",
+                "detail": "no healthy replica available",
+            }).encode(), None
+        handler.send_response(status)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.send_header("Retry-After",
+                            str(max(1, int(retry_after or 1))))
+        handler.send_header("X-Attempts", str(len(tried)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+
+def make_router_server(
+    router: FleetRouter,
+    host: str = "127.0.0.1",
+    port: int = 8100,
+    *,
+    slo=None,
+) -> ThreadingHTTPServer:
+    """The router's own HTTP front door (mirrors ``serve/server.py``'s
+    surface so loadgen and probes work unchanged against a router URL):
+    ``POST /generate`` (dispatched), ``GET /healthz`` (200 iff >=1 up
+    replica), ``GET /fleet.json``, ``GET /metrics`` (fleet gauges +
+    router counters, Prometheus text), ``GET /slo.json``."""
+    registry = router.registry
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, code: int, payload: dict) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                snap = registry.snapshot()
+                ok = snap["up_replicas"] >= 1
+                self._send(200 if ok else 503, {
+                    "ok": ok,
+                    "role": "router",
+                    "up_replicas": snap["up_replicas"],
+                    "fleet_pressure": snap["fleet_pressure"],
+                })
+            elif self.path == "/fleet.json":
+                self._send(200, registry.snapshot())
+            elif self.path == "/metrics":
+                text = obs_export.prometheus_text(registry.metrics_registry)
+                data = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            elif self.path == "/slo.json":
+                if slo is None:
+                    self._send(200, {"enabled": False})
+                else:
+                    status = slo.status()
+                    status["enabled"] = True
+                    self._send(200, status)
+            else:
+                self._send(404, {"error": "not_found", "detail": self.path})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, {"error": "not_found", "detail": self.path})
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            try:
+                parsed = json.loads(body or b"{}")
+                streaming = bool(isinstance(parsed, dict)
+                                 and parsed.get("stream", False))
+            except ValueError:
+                streaming = False  # replica will answer 400 either way
+            try:
+                router.dispatch(self, body, streaming=streaming)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client left mid-proxy; nothing to answer
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server.slo_monitor = slo
+    return server

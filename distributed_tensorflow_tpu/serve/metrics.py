@@ -78,6 +78,15 @@ class ServingMetrics:
             "serve_queue_depth_current", "Admission queue depth, last seen.")
         self._queue_depth_peak = r.gauge(
             "serve_queue_depth_peak", "Max queue depth seen this process.")
+        # Point-in-time gauges the fleet router scrapes for least-loaded
+        # dispatch (histograms summarize history; dispatch needs "now").
+        self._occupancy_gauge = r.gauge(
+            "serve_slot_occupancy_current",
+            "Fraction of engine slots busy, last observed round.")
+        self._lane_depth = r.gauge(
+            "serve_lane_depth_current",
+            "Queued requests per priority lane, last seen at submit.",
+            labels=("lane",))
         self._peak_lock = threading.Lock()
 
     # -- recording (scheduler hot path) -----------------------------------
@@ -100,6 +109,11 @@ class ServingMetrics:
 
     def record_occupancy(self, frac: float) -> None:
         self.occupancy.observe(float(frac))
+        self._occupancy_gauge.set(float(frac))
+
+    def record_lane_depths(self, depths) -> None:
+        for lane, depth in enumerate(depths):
+            self._lane_depth.labels(lane=str(lane)).set(float(depth))
 
     def record_completed(self) -> None:
         self._completed.inc()

@@ -1,12 +1,13 @@
-"""FCFS scheduler: iteration-level join/leave + typed admission control.
+"""Lane-scheduled continuous batching: priority lanes + per-client
+weighted fairness + typed admission control.
 
 The scheduler is the single thread that owns the engine. Each ``step()``
 is one serving iteration in the Orca sense:
 
   1. **shed** queued requests whose deadline passed while waiting,
-  2. **admit** queued requests into free slots FCFS (prefill + first
-     token — TTFT is measured here), releasing immediately if the first
-     token already finishes the request,
+  2. **admit** queued requests into free slots (prefill + first token —
+     TTFT is measured here), releasing immediately if the first token
+     already finishes the request,
   3. **decode** one engine round over every active slot,
   4. **complete** slots the round finished and free them — the very next
      ``step()`` refills those slots from the queue.
@@ -15,22 +16,50 @@ So a finished request's slot is recycled at TOKEN granularity, never
 waiting for the rest of the batch: that is the whole continuous-batching
 win over run-to-completion batching.
 
+Admission order (PR 7, replacing pure FCFS): requests queue into one of
+three **priority lanes** (0 = interactive, 1 = normal, 2 = batch) drained
+by weighted interleave — under contention lane k gets ``lane_weights[k]``
+admissions per cycle, so batch traffic cannot starve interactive traffic
+and interactive bursts cannot starve batch forever. Within a lane,
+**per-client deficit round-robin** (keyed on ``Request.client_id``,
+optionally weighted) prevents one chatty client from monopolizing the
+lane: each client's requests stay FIFO, but admissions rotate across
+clients in proportion to their weight. A single anonymous client on one
+lane degrades exactly to FCFS — the pre-PR-7 behavior and what the
+existing order tests pin.
+
 Load-shed is deterministic and TYPED — callers always get a
 :class:`Completion` or a :class:`Rejection` with a machine-readable
 ``reason`` (``queue_full`` at submit, ``deadline`` at admission sweep,
 ``invalid`` for malformed params, ``shutting_down`` at stop). Nothing in
 this module blocks indefinitely: ``submit`` either rejects synchronously
-or enqueues, and ``PendingRequest.result(timeout)`` is the only wait.
+or enqueues, and ``PendingRequest.result(timeout)`` /
+``stream_events(timeout)`` are the only waits.
 
 Deadlines govern QUEUE WAIT only: a request admitted before its deadline
 runs to completion (mid-flight eviction would waste the prefill it
 already paid for — the expensive part; shedding is for work not yet
-started).
+started). Fairness does not change what a deadline means — it changes
+WHICH request is admitted next, and the shed sweep still measures every
+queued request's own wait.
+
+Streaming (``Request.stream=True``): the handle grows a per-request event
+queue the scheduler feeds as tokens materialize — the first token at
+admission, then one batch per engine round — ending with the terminal
+outcome. ``serve/server.py`` turns those events into SSE; every terminal
+path (completion, shed, stop) closes the stream, so a streaming consumer
+can never hang either.
+
+Draining (``begin_drain``): stop accepting (``/healthz`` flips 503) while
+the loop keeps serving queued + in-flight work — the graceful half of
+shutdown the fleet router relies on: a draining replica finishes what it
+accepted and receives nothing new.
 """
 
 from __future__ import annotations
 
 import itertools
+import queue as _queue
 import threading
 import time
 from collections import deque
@@ -40,14 +69,30 @@ import numpy as np
 
 from distributed_tensorflow_tpu.serve.engine import SlotEngine
 
-__all__ = ["Request", "Completion", "Rejection", "PendingRequest", "Scheduler"]
+__all__ = [
+    "Request",
+    "Completion",
+    "Rejection",
+    "PendingRequest",
+    "Scheduler",
+    "NUM_LANES",
+    "DEFAULT_LANE_WEIGHTS",
+]
+
+# Lane 0 = interactive, 1 = normal (default), 2 = batch/background.
+NUM_LANES = 3
+# Admissions per weighted-interleave cycle under full contention: 8:4:1.
+DEFAULT_LANE_WEIGHTS = (8, 4, 1)
 
 
 @dataclass(frozen=True)
 class Request:
     """One generation request. ``deadline_s`` is a RELATIVE queue-wait
     budget from submit time (None = wait forever); see the module
-    docstring for why it only sheds while queued."""
+    docstring for why it only sheds while queued. ``priority`` picks the
+    lane (0 interactive … 2 batch), ``client_id`` the fairness key
+    (empty = one shared anonymous client), ``stream`` requests
+    per-token delivery through the handle."""
 
     prompt: tuple
     max_new_tokens: int = 16
@@ -58,6 +103,9 @@ class Request:
     eos_id: int | None = None
     deadline_s: float | None = None
     request_id: str = ""
+    priority: int = 1
+    client_id: str = ""
+    stream: bool = False
 
 
 @dataclass(frozen=True)
@@ -80,16 +128,28 @@ class Rejection:
 class PendingRequest:
     """Submit-side handle: ``result(timeout)`` blocks until the scheduler
     posts a Completion or Rejection (never a hang under shed — every
-    terminal path posts exactly once)."""
+    terminal path posts exactly once). For ``stream`` requests,
+    ``stream_events(timeout)`` yields ``("tokens", [ints])`` batches as
+    the engine produces them and always terminates with
+    ``("done", outcome)`` — the same no-hang contract, per token."""
 
     request: Request
     submitted_at: float
     _event: threading.Event = field(default_factory=threading.Event)
     _outcome: Completion | Rejection | None = None
+    _stream_q: _queue.Queue | None = None
 
     def finish(self, outcome: Completion | Rejection) -> None:
         self._outcome = outcome
+        if self._stream_q is not None:
+            self._stream_q.put(("done", outcome))
         self._event.set()
+
+    def push_tokens(self, tokens) -> None:
+        """Feed freshly produced tokens to a streaming consumer (no-op
+        for non-streaming handles)."""
+        if self._stream_q is not None and tokens:
+            self._stream_q.put(("tokens", [int(t) for t in tokens]))
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -103,21 +163,143 @@ class PendingRequest:
         assert self._outcome is not None
         return self._outcome
 
+    def stream_events(self, timeout: float | None = None):
+        """Yield ``("tokens", [ints])`` then a final ``("done", outcome)``.
+        ``timeout`` bounds the gap between consecutive events; exceeding
+        it raises TimeoutError rather than hanging the consumer."""
+        if self._stream_q is None:
+            raise RuntimeError(
+                "stream_events() on a non-streaming request "
+                "(submit with Request(stream=True))"
+            )
+        while True:
+            try:
+                kind, payload = self._stream_q.get(timeout=timeout)
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"request {self.request.request_id!r}: no stream event "
+                    f"within {timeout}s"
+                ) from None
+            yield kind, payload
+            if kind == "done":
+                return
 
-class _InFlight:
-    """Host-side accumulation for a request occupying a slot."""
 
-    __slots__ = ("pending", "tokens", "started_at", "ttft_s")
+class _FairQueue:
+    """Priority lanes + per-client deficit round-robin (DRR).
 
-    def __init__(self, pending, first_token, started_at, ttft_s):
-        self.pending = pending
-        self.tokens = [int(first_token)]
-        self.started_at = started_at
-        self.ttft_s = ttft_s
+    NOT thread-safe — the Scheduler's lock guards every call. Each lane
+    holds per-client FIFO deques plus a service ring; ``pop`` first picks
+    a lane by weighted interleave (credits refilled when the nonempty
+    lanes run dry), then the lane's next client by DRR: a client's
+    deficit grows by its weight each ring pass and each admission costs
+    1, so admissions converge to weight-proportional shares while each
+    client's own requests stay strictly FIFO."""
+
+    def __init__(self, lane_weights=DEFAULT_LANE_WEIGHTS, client_weights=None):
+        if len(lane_weights) != NUM_LANES or any(w < 1 for w in lane_weights):
+            raise ValueError(
+                f"lane_weights must be {NUM_LANES} integers >= 1, "
+                f"got {lane_weights!r}"
+            )
+        self.lane_weights = tuple(int(w) for w in lane_weights)
+        self.client_weights = dict(client_weights or {})
+        if any(w <= 0 for w in self.client_weights.values()):
+            raise ValueError(
+                f"client weights must be > 0, got {self.client_weights!r}"
+            )
+        self._queues = [dict() for _ in range(NUM_LANES)]  # cid -> deque
+        self._rings = [deque() for _ in range(NUM_LANES)]  # service order
+        self._deficits = [dict() for _ in range(NUM_LANES)]
+        self._credits = list(self.lane_weights)
+        self._len = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    def depths(self) -> tuple[int, ...]:
+        return tuple(
+            sum(len(q) for q in lane.values()) for lane in self._queues
+        )
+
+    def _weight(self, client_id: str) -> float:
+        return float(self.client_weights.get(client_id, 1.0))
+
+    def push(self, pending: PendingRequest) -> None:
+        lane = pending.request.priority
+        cid = pending.request.client_id
+        qs = self._queues[lane]
+        if cid not in qs:
+            qs[cid] = deque()
+            self._rings[lane].append(cid)
+            self._deficits[lane][cid] = 0.0
+        qs[cid].append(pending)
+        self._len += 1
+
+    def _drop_client(self, lane: int, cid: str) -> None:
+        del self._queues[lane][cid]
+        del self._deficits[lane][cid]
+        self._rings[lane].remove(cid)
+
+    def _pop_lane(self, lane: int) -> PendingRequest:
+        ring = self._rings[lane]
+        qs = self._queues[lane]
+        defs = self._deficits[lane]
+        while True:
+            cid = ring[0]
+            if defs[cid] >= 1.0:
+                defs[cid] -= 1.0
+                q = qs[cid]
+                pending = q.popleft()
+                if not q:
+                    # A departing client forfeits its remaining deficit —
+                    # rejoining starts fresh (no banking idle credit).
+                    self._drop_client(lane, cid)
+                elif defs[cid] < 1.0:
+                    ring.rotate(-1)
+                return pending
+            defs[cid] += self._weight(cid)
+            ring.rotate(-1)
+
+    def pop(self) -> PendingRequest | None:
+        if self._len == 0:
+            return None
+        nonempty = [i for i in range(NUM_LANES) if self._queues[i]]
+        lane = next((i for i in nonempty if self._credits[i] > 0), None)
+        if lane is None:
+            self._credits = list(self.lane_weights)
+            lane = nonempty[0]
+        self._credits[lane] -= 1
+        self._len -= 1
+        return self._pop_lane(lane)
+
+    def remove_if(self, pred) -> list[PendingRequest]:
+        """Remove (and return) every queued request matching ``pred`` —
+        the deadline shed sweep. Per-client FIFO order is preserved."""
+        removed = []
+        for lane in range(NUM_LANES):
+            qs = self._queues[lane]
+            for cid in list(qs):
+                kept = deque()
+                for pending in qs[cid]:
+                    if pred(pending):
+                        removed.append(pending)
+                    else:
+                        kept.append(pending)
+                if kept:
+                    qs[cid] = kept
+                else:
+                    self._drop_client(lane, cid)
+        self._len -= len(removed)
+        return removed
+
+    def drain_all(self) -> list[PendingRequest]:
+        return self.remove_if(lambda _: True)
 
 
 class Scheduler:
-    """FCFS continuous-batching scheduler over one :class:`SlotEngine`.
+    """Lane-scheduled continuous-batching scheduler over one
+    :class:`SlotEngine`.
 
     ``submit()`` is thread-safe (the HTTP server calls it from handler
     threads); the engine is driven only from ``step()`` /
@@ -132,6 +314,8 @@ class Scheduler:
         max_queue_depth: int = 64,
         metrics=None,
         clock=time.monotonic,
+        lane_weights=DEFAULT_LANE_WEIGHTS,
+        client_weights=None,
     ):
         if max_queue_depth < 1:
             raise ValueError(
@@ -141,9 +325,11 @@ class Scheduler:
         self.max_queue_depth = int(max_queue_depth)
         self.metrics = metrics
         self.clock = clock
-        self._queue: deque[PendingRequest] = deque()
-        self._lock = threading.Lock()  # guards _queue and _accepting only
+        self._queue = _FairQueue(lane_weights, client_weights)
+        self._lock = threading.Lock()  # guards _queue and accept/drain state
         self._accepting = True
+        self._draining = False
+        self._drain_deadline: float | None = None
         self._inflight: dict[int, _InFlight] = {}
         self._ids = itertools.count()
         self._thread: threading.Thread | None = None
@@ -155,6 +341,8 @@ class Scheduler:
         """Enqueue or reject NOW. The returned handle always terminates."""
         now = self.clock()
         pending = PendingRequest(request=request, submitted_at=now)
+        if request.stream:
+            pending._stream_q = _queue.Queue()
         if not request.request_id:
             request = Request(
                 **{**request.__dict__, "request_id": f"r{next(self._ids)}"}
@@ -169,7 +357,8 @@ class Scheduler:
             if not self._accepting:
                 pending.finish(
                     Rejection(request.request_id, "shutting_down",
-                              "scheduler is stopping")
+                              "scheduler is draining" if self._draining
+                              else "scheduler is stopping")
                 )
                 self._count_shed()
                 return pending
@@ -183,10 +372,12 @@ class Scheduler:
                 )
                 self._count_shed()
                 return pending
-            self._queue.append(pending)
+            self._queue.push(pending)
             depth = len(self._queue)
+            lane_depths = self._queue.depths()
         if self.metrics is not None:
             self.metrics.record_queue_depth(depth)
+            self.metrics.record_lane_depths(lane_depths)
         return pending
 
     def _validate(self, r: Request) -> str | None:
@@ -204,6 +395,9 @@ class Scheduler:
             )
         if r.deadline_s is not None and r.deadline_s < 0:
             return f"negative deadline_s {r.deadline_s}"
+        if (isinstance(r.priority, bool) or not isinstance(r.priority, int)
+                or not 0 <= r.priority < NUM_LANES):
+            return f"priority {r.priority!r} outside [0, {NUM_LANES})"
         return None
 
     def _count_shed(self) -> None:
@@ -227,11 +421,16 @@ class Scheduler:
         toks, valid, done = self.engine.step()
         round_s = self.clock() - t0
         produced = 0
+        round_toks: dict[int, list] = {}
         for k in range(toks.shape[0]):
             for slot, fl in self._inflight.items():
                 if valid[k, slot]:
-                    fl.tokens.append(int(toks[k, slot]))
+                    tok = int(toks[k, slot])
+                    fl.tokens.append(tok)
+                    round_toks.setdefault(slot, []).append(tok)
                     produced += 1
+        for slot, new in round_toks.items():
+            self._inflight[slot].pending.push_tokens(new)
         if self.metrics is not None:
             self.metrics.record_round(round_s, produced)
         completed = 0
@@ -242,34 +441,30 @@ class Scheduler:
 
     def _shed_expired(self, now: float) -> None:
         with self._lock:
-            queue = list(self._queue)
-            self._queue.clear()
-            keep = []
-            for pending in queue:
-                r = pending.request
-                if (r.deadline_s is not None
-                        and now - pending.submitted_at > r.deadline_s):
-                    pending.finish(
-                        Rejection(
-                            r.request_id, "deadline",
-                            f"queued {now - pending.submitted_at:.3f}s > "
-                            f"deadline {r.deadline_s}s",
-                        )
-                    )
-                    self._count_shed()
-                else:
-                    keep.append(pending)
-            self._queue.extend(keep)
+            expired = self._queue.remove_if(
+                lambda p: (p.request.deadline_s is not None
+                           and now - p.submitted_at > p.request.deadline_s)
+            )
+        for pending in expired:
+            r = pending.request
+            pending.finish(
+                Rejection(
+                    r.request_id, "deadline",
+                    f"queued {now - pending.submitted_at:.3f}s > "
+                    f"deadline {r.deadline_s}s",
+                )
+            )
+            self._count_shed()
 
     def _admit(self, now: float) -> None:
         while True:
             with self._lock:
-                if not self._queue:
+                if not len(self._queue):
                     return
                 slot = self.engine.acquire_slot()
                 if slot is None:
                     return
-                pending = self._queue.popleft()
+                pending = self._queue.pop()
             r = pending.request
             try:
                 first, finished = self.engine.start(
@@ -288,6 +483,7 @@ class Scheduler:
             if self.metrics is not None:
                 self.metrics.record_ttft(ttft)
             fl = _InFlight(pending, first, done_at, ttft)
+            pending.push_tokens([int(first)])
             if finished:
                 self.engine.release(slot)
                 self._finish_completion(fl, done_at)
@@ -349,7 +545,7 @@ class Scheduler:
         def loop():
             while not self._stop.is_set():
                 with self._lock:
-                    idle = not self._queue
+                    idle = not len(self._queue)
                 if idle and not self._inflight:
                     self._stop.wait(poll_s)
                     continue
@@ -359,6 +555,34 @@ class Scheduler:
             target=loop, name="serve-scheduler", daemon=True
         )
         self._thread.start()
+
+    def begin_drain(self, deadline_s: float | None = None) -> None:
+        """Graceful-shutdown phase 1: refuse NEW submits (typed
+        ``shutting_down``; ``/healthz`` flips 503 so the router stops
+        dispatching here) while the loop keeps serving everything already
+        accepted. ``deadline_s`` is advisory — it bounds the Retry-After
+        the server advertises and what ``drain_remaining_s`` reports; the
+        caller (``serve_lm``'s SIGTERM path) decides when to hard-stop."""
+        with self._lock:
+            self._accepting = False
+            self._draining = True
+            self._drain_deadline = (
+                self.clock() + deadline_s if deadline_s is not None else None
+            )
+
+    def drain_remaining_s(self) -> float | None:
+        """Seconds left before the announced drain deadline (None when not
+        draining or no deadline was given; floors at 0.0)."""
+        with self._lock:
+            if not self._draining or self._drain_deadline is None:
+                return None
+            return max(0.0, self._drain_deadline - self.clock())
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            queued = len(self._queue)
+        return queued == 0 and not self._inflight
 
     def stop(self, timeout: float = 5.0) -> None:
         """Stop accepting, halt the loop, and shed anything unfinished
@@ -370,8 +594,7 @@ class Scheduler:
             self._thread.join(timeout)
             self._thread = None
         with self._lock:
-            leftovers = list(self._queue)
-            self._queue.clear()
+            leftovers = self._queue.drain_all()
         leftovers.extend(fl.pending for fl in self._inflight.values())
         for slot in list(self._inflight):
             del self._inflight[slot]
@@ -390,15 +613,26 @@ class Scheduler:
             return len(self._queue)
 
     @property
+    def lane_depths(self) -> tuple[int, ...]:
+        with self._lock:
+            return self._queue.depths()
+
+    @property
     def inflight_count(self) -> int:
         return len(self._inflight)
 
     @property
     def accepting(self) -> bool:
-        """False once ``stop()`` has begun — new submits get typed
-        ``shutting_down`` rejections (what /healthz reports as 503)."""
+        """False once ``begin_drain()`` or ``stop()`` has begun — new
+        submits get typed ``shutting_down`` rejections (what /healthz
+        reports as 503)."""
         with self._lock:
             return self._accepting
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
 
     @property
     def loop_running(self) -> bool:
@@ -407,3 +641,15 @@ class Scheduler:
         False without being unhealthy — healthz treats a DEAD started
         thread, not an absent one, as a liveness failure."""
         return self._thread is not None and self._thread.is_alive()
+
+
+class _InFlight:
+    """Host-side accumulation for a request occupying a slot."""
+
+    __slots__ = ("pending", "tokens", "started_at", "ttft_s")
+
+    def __init__(self, pending, first_token, started_at, ttft_s):
+        self.pending = pending
+        self.tokens = [int(first_token)]
+        self.started_at = started_at
+        self.ttft_s = ttft_s

@@ -10,23 +10,41 @@ Endpoints:
 
 * ``POST /generate`` — body ``{"prompt": [ints], "max_new_tokens": int,
   "temperature": float, "top_k": int, "top_p": float, "seed": int,
-  "eos_id": int|null, "deadline_s": float|null}`` (prompt may also be a
-  string when the server was built with a codec). Responses map typed
-  scheduler outcomes onto status codes — load-shed is an HTTP answer,
-  never a hang:
+  "eos_id": int|null, "deadline_s": float|null, "priority": 0|1|2,
+  "client_id": str, "stream": bool}`` (prompt may also be a string when
+  the server was built with a codec). Responses map typed scheduler
+  outcomes onto status codes — load-shed is an HTTP answer, never a hang:
 
   =====================  ====  =========================================
   outcome                code  body
   =====================  ====  =========================================
   Completion             200   request_id, tokens, text?, ttft_ms,
                                latency_ms, finish_reason
-  Rejection queue_full   429   error="queue_full", detail
-  Rejection deadline     503   error="deadline", detail
-  Rejection shutting...  503   error="shutting_down", detail
+  Rejection queue_full   429   error="queue_full", detail, Retry-After
+  Rejection deadline     503   error="deadline", detail, Retry-After
+  Rejection shutting...  503   error="shutting_down", detail,
+                               drain_deadline_s?, Retry-After
   Rejection invalid      400   error="invalid", detail
   result timeout         503   error="timeout", detail
   bad JSON / bad types   400   error="invalid", detail
   =====================  ====  =========================================
+
+  Every 429/503 carries a ``Retry-After`` header (seconds) sized from
+  what the server knows: queue pressure backs off briefly; a draining
+  replica advertises its remaining drain window so clients (and the
+  fleet router) stop knocking until it is actually gone.
+
+  With ``"stream": true`` the accepted path switches to Server-Sent
+  Events (``text/event-stream``): ``event: token`` frames carrying
+  ``{"tokens": [ints]}`` as each engine round produces them, closed by
+  one ``event: done`` frame with the same JSON a non-streaming 200
+  would have returned (or the rejection object if the request was shed
+  mid-queue). Synchronous rejections still answer plain JSON with the
+  table's status codes — SSE begins only once tokens can flow. Frames
+  are flushed per event and the response deliberately omits
+  Content-Length (HTTP/1.0 close-delimited), so nothing between the
+  engine and the client buffers the stream; TTFT is the wire arrival
+  of the first token frame.
 
 * ``GET /healthz`` — 200 ``{"ok": true, ...}`` while serving; **503**
   ``{"ok": false, ...}`` once the scheduler is shutting down (stopped
@@ -78,6 +96,9 @@ def _parse_request(body: dict, codec) -> Request:
         raise ValueError("prompt tokens must be ints")
     eos_id = body.get("eos_id")
     deadline = body.get("deadline_s")
+    priority = body.get("priority", 1)
+    if isinstance(priority, bool) or not isinstance(priority, int):
+        raise ValueError(f"priority must be an int lane, got {priority!r}")
     return Request(
         prompt=tuple(prompt),
         max_new_tokens=int(body.get("max_new_tokens", 16)),
@@ -88,6 +109,9 @@ def _parse_request(body: dict, codec) -> Request:
         eos_id=None if eos_id is None else int(eos_id),
         deadline_s=None if deadline is None else float(deadline),
         request_id=str(body.get("request_id", "")),
+        priority=priority,
+        client_id=str(body.get("client_id", "")),
+        stream=bool(body.get("stream", False)),
     )
 
 
@@ -110,13 +134,41 @@ def make_server(
         def log_message(self, fmt, *args):
             pass
 
-        def _send(self, code: int, payload: dict) -> None:
+        def _send(self, code: int, payload: dict, headers=None) -> None:
             data = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
+
+        def _send_rejection(self, outcome) -> None:
+            status = _REJECTION_STATUS.get(outcome.reason, 500)
+            body = {
+                "error": outcome.reason,
+                "detail": outcome.detail,
+                "request_id": outcome.request_id,
+            }
+            headers = {}
+            if status in (429, 503):
+                retry_after = 1
+                if outcome.reason == "shutting_down":
+                    remaining = None
+                    drain_fn = getattr(scheduler, "drain_remaining_s", None)
+                    if drain_fn is not None:
+                        remaining = drain_fn()
+                    if remaining is not None:
+                        # Tell callers how long this replica keeps draining
+                        # before it is gone for good.
+                        body["drain_deadline_s"] = round(remaining, 3)
+                        retry_after = max(1, int(remaining) + 1)
+                    else:
+                        # Stopping with no announced deadline: assume gone.
+                        retry_after = 30
+                headers["Retry-After"] = str(retry_after)
+            self._send(status, body, headers)
 
         def _send_text(self, code: int, text: str) -> None:
             data = text.encode()
@@ -141,7 +193,12 @@ def make_server(
                     "slots": scheduler.engine.slots,
                     "free_slots": scheduler.engine.free_slots,
                     "queue_depth": scheduler.queue_depth,
+                    "draining": bool(getattr(scheduler, "draining", False)),
                 }
+                drain_fn = getattr(scheduler, "drain_remaining_s", None)
+                remaining = drain_fn() if drain_fn is not None else None
+                if remaining is not None:
+                    body["drain_remaining_s"] = round(remaining, 3)
                 if slo is not None:
                     # Degraded ≠ dead: still 200 (see module docstring).
                     body["slo"] = "degraded" if slo.degraded else "ok"
@@ -182,30 +239,83 @@ def make_server(
                 self._send(400, {"error": "invalid", "detail": str(exc)})
                 return
             pending = scheduler.submit(request)
+            if request.stream:
+                self._stream_response(pending)
+                return
             try:
                 outcome = pending.result(timeout=request_timeout_s)
             except TimeoutError as exc:
                 self._send(503, {"error": "timeout", "detail": str(exc)})
                 return
             if isinstance(outcome, Completion):
-                payload = {
-                    "request_id": outcome.request_id,
-                    "tokens": list(outcome.tokens),
-                    "ttft_ms": outcome.ttft_s * 1e3,
-                    "latency_ms": outcome.latency_s * 1e3,
-                    "finish_reason": outcome.finish_reason,
-                }
-                if codec is not None:
-                    payload["text"] = codec.decode(list(outcome.tokens))
-                self._send(200, payload)
+                self._send(200, self._completion_payload(outcome))
             else:
-                self._send(
-                    _REJECTION_STATUS.get(outcome.reason, 500),
-                    {
-                        "error": outcome.reason,
-                        "detail": outcome.detail,
-                        "request_id": outcome.request_id,
-                    },
-                )
+                self._send_rejection(outcome)
+
+        def _completion_payload(self, outcome: Completion) -> dict:
+            payload = {
+                "request_id": outcome.request_id,
+                "tokens": list(outcome.tokens),
+                "ttft_ms": outcome.ttft_s * 1e3,
+                "latency_ms": outcome.latency_s * 1e3,
+                "finish_reason": outcome.finish_reason,
+            }
+            if codec is not None:
+                payload["text"] = codec.decode(list(outcome.tokens))
+            return payload
+
+        def _write_event(self, event: str, obj: dict) -> None:
+            frame = f"event: {event}\ndata: {json.dumps(obj)}\n\n".encode()
+            self.wfile.write(frame)
+            self.wfile.flush()  # per-event: nothing downstream may batch
+
+        def _stream_response(self, pending) -> None:
+            """SSE leg of /generate. The first event decides the shape:
+            a synchronous rejection stays a plain JSON error response
+            (clients branch on status, not on stream content); once a
+            token exists we commit to 200 + event-stream and every
+            terminal outcome — including a mid-queue shed — arrives as
+            the final ``done`` frame."""
+            events = pending.stream_events(timeout=request_timeout_s)
+            try:
+                kind, payload = next(events)
+            except TimeoutError as exc:
+                self._send(503, {"error": "timeout", "detail": str(exc)})
+                return
+            if kind == "done" and not isinstance(payload, Completion):
+                self._send_rejection(payload)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("X-Accel-Buffering", "no")
+            # No Content-Length on purpose: HTTP/1.0 close-delimited body,
+            # so proxies cannot wait for "the whole response".
+            self.end_headers()
+            try:
+                while True:
+                    if kind == "tokens":
+                        self._write_event("token", {"tokens": payload})
+                        kind, payload = next(events)
+                        continue
+                    if isinstance(payload, Completion):
+                        self._write_event(
+                            "done", self._completion_payload(payload))
+                    else:
+                        self._write_event("done", {
+                            "error": payload.reason,
+                            "detail": payload.detail,
+                            "request_id": payload.request_id,
+                        })
+                    return
+            except TimeoutError:
+                # Stream went quiet past the deadline: surface in-band,
+                # then close — the truncated stream is the error signal.
+                try:
+                    self._write_event("error", {"error": "timeout"})
+                except OSError:
+                    pass
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client left; the scheduler still finishes the slot
 
     return ThreadingHTTPServer((host, port), Handler)

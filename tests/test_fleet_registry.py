@@ -1,0 +1,220 @@
+"""Replica registry state machine: hysteresis, drain immediacy,
+least-loaded pick, backoff, and the fleet_pressure signal — all with
+injected probes and a fake clock (no HTTP, no jax)."""
+
+import pytest
+
+from distributed_tensorflow_tpu.obs.registry import MetricsRegistry
+from distributed_tensorflow_tpu.serve.fleet import ProbeResult, ReplicaRegistry
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+
+class _Probes:
+    """Scripted probe results per base_url, settable mid-test."""
+
+    def __init__(self):
+        self.results = {}
+
+    def set(self, url, **kw):
+        self.results[url] = ProbeResult(**kw)
+
+    def __call__(self, url):
+        return self.results.get(url, ProbeResult(ok=False, detail="unset"))
+
+
+UP = dict(ok=True, accepting=True, slots=4)
+
+
+@pytest.fixture()
+def fleet():
+    probes = _Probes()
+    clock = [100.0]
+    registry = ReplicaRegistry(
+        ["http://a:1", "http://b:2"],
+        probe=probes,
+        registry=MetricsRegistry(),
+        up_after=2,
+        down_after=2,
+        clock=lambda: clock[0],
+    )
+    return registry, probes, clock
+
+
+def _states(registry):
+    return {r.replica_id: r.state for r in registry.replicas}
+
+
+def test_starts_down_and_needs_up_after_consecutive_oks(fleet):
+    registry, probes, _ = fleet
+    assert _states(registry) == {"a:1": "down", "b:2": "down"}
+    probes.set("http://a:1", **UP)
+    registry.probe_once()
+    # One healthy probe is not enough with up_after=2.
+    assert _states(registry)["a:1"] == "down"
+    assert registry.pick() is None
+    registry.probe_once()
+    assert _states(registry)["a:1"] == "up"
+    assert registry.up_count() == 1
+
+
+def test_single_flap_does_not_take_replica_down(fleet):
+    registry, probes, _ = fleet
+    probes.set("http://a:1", **UP)
+    registry.probe_once()
+    registry.probe_once()
+    assert _states(registry)["a:1"] == "up"
+    # One failed probe: still up (hysteresis), and the ok-streak resets
+    # so recovery needs up_after fresh successes.
+    probes.set("http://a:1", ok=False)
+    registry.probe_once()
+    assert _states(registry)["a:1"] == "up"
+    # Second consecutive failure: down.
+    registry.probe_once()
+    assert _states(registry)["a:1"] == "down"
+    # Recovery is hysteretic too: one good probe isn't enough.
+    probes.set("http://a:1", **UP)
+    registry.probe_once()
+    assert _states(registry)["a:1"] == "down"
+    registry.probe_once()
+    assert _states(registry)["a:1"] == "up"
+
+
+def test_drain_signal_transitions_immediately(fleet):
+    registry, probes, _ = fleet
+    probes.set("http://a:1", **UP)
+    registry.probe_once()
+    registry.probe_once()
+    assert _states(registry)["a:1"] == "up"
+    # The replica SAYS it is draining: one probe flips the state — an
+    # explicit signal gets no hysteresis.
+    probes.set("http://a:1", ok=True, accepting=False, draining=True, slots=4)
+    registry.probe_once()
+    assert _states(registry)["a:1"] == "draining"
+    assert registry.pick() is None  # draining gets no new dispatches
+    # A draining replica that stops answering is gone at once.
+    probes.set("http://a:1", ok=False)
+    registry.probe_once()
+    assert _states(registry)["a:1"] == "down"
+
+
+def test_pick_is_least_loaded_and_respects_exclude(fleet):
+    registry, probes, _ = fleet
+    probes.set("http://a:1", **UP, queue_depth=5, occupancy=1.0)
+    probes.set("http://b:2", **UP, queue_depth=0, occupancy=0.25)
+    registry.probe_once()
+    registry.probe_once()
+    # b: 0 + 0.25*4 = 1 < a: 5 + 4 = 9.
+    assert registry.pick().replica_id == "b:2"
+    assert registry.pick(exclude={"b:2"}).replica_id == "a:1"
+    assert registry.pick(exclude={"a:1", "b:2"}) is None
+
+
+def test_router_inflight_breaks_scrape_ties(fleet):
+    registry, probes, _ = fleet
+    probes.set("http://a:1", **UP)
+    probes.set("http://b:2", **UP)
+    registry.probe_once()
+    registry.probe_once()
+    first = registry.pick()
+    registry.note_dispatch(first)
+    # Scraped load is identical; the router-tracked inflight must steer
+    # the second dispatch to the OTHER replica.
+    second = registry.pick()
+    assert second.replica_id != first.replica_id
+    registry.note_done(first)
+
+
+def test_note_error_feeds_the_down_streak(fleet):
+    registry, probes, _ = fleet
+    probes.set("http://a:1", **UP)
+    registry.probe_once()
+    registry.probe_once()
+    replica = registry.get("a:1")
+    registry.note_error(replica)
+    assert replica.state == "up"  # one error = flap, not down
+    registry.note_error(replica)
+    assert replica.state == "down"
+
+
+def test_backoff_window_excludes_replica_until_horizon(fleet):
+    registry, probes, clock = fleet
+    probes.set("http://a:1", **UP)
+    registry.probe_once()
+    registry.probe_once()
+    replica = registry.get("a:1")
+    registry.note_backoff(replica, 5.0)
+    assert registry.pick() is None  # only up replica is backed off
+    clock[0] += 5.1
+    assert registry.pick().replica_id == "a:1"
+
+
+def test_fleet_pressure_and_snapshot(fleet):
+    registry, probes, _ = fleet
+    # No up replicas, no demand: pressure 0 (nothing to scale for yet).
+    assert registry.fleet_pressure() == 0.0
+    probes.set("http://a:1", **UP, queue_depth=2, occupancy=0.5)
+    probes.set("http://b:2", **UP, queue_depth=0, occupancy=0.0)
+    registry.probe_once()
+    registry.probe_once()
+    # demand = (2 + 0.5*4) + 0 = 4 over capacity 8.
+    assert registry.fleet_pressure() == pytest.approx(0.5)
+    snap = registry.snapshot()
+    assert snap["up_replicas"] == 2
+    assert snap["replicas"]["a:1"]["queue_depth"] == 2
+    assert snap["replicas"]["a:1"]["state"] == "up"
+    # Demand with zero up capacity saturates the signal (scale-up alarm)
+    # instead of dividing by zero.
+    probes.set("http://a:1", ok=True, accepting=False, draining=True,
+               slots=4, queue_depth=2, occupancy=0.5)
+    probes.set("http://b:2", ok=False)
+    registry.probe_once()
+    registry.probe_once()
+    assert registry.fleet_pressure() == 1e6
+
+
+def test_fleet_gauges_land_in_the_obs_registry(fleet):
+    registry, probes, _ = fleet
+    probes.set("http://a:1", **UP, queue_depth=3, occupancy=0.75,
+               shed_total=7.0)
+    registry.probe_once()
+    registry.probe_once()
+    from distributed_tensorflow_tpu.obs.export import (
+        parse_prometheus_text,
+        prometheus_text,
+    )
+
+    text = prometheus_text(registry.metrics_registry)
+    samples = {
+        (s["name"], s["labels"].get("replica")): s["value"]
+        for s in parse_prometheus_text(text)
+    }
+    assert samples[("fleet_replica_state", "a:1")] == 2.0
+    assert samples[("fleet_replica_state", "b:2")] == 0.0
+    assert samples[("fleet_replica_queue_depth", "a:1")] == 3.0
+    assert samples[("fleet_replica_occupancy", "a:1")] == 0.75
+    assert samples[("fleet_replica_shed_total", "a:1")] == 7.0
+    assert samples[("fleet_up_replicas", None)] == 1.0
+    assert ("fleet_pressure", None) in samples
+
+
+def test_default_fleet_rules_cover_the_fleet_gauges(fleet):
+    """At least one default SLO rule watches each core fleet signal, and
+    a dead fleet breaches the up-replica floor instantly."""
+    from distributed_tensorflow_tpu.obs import SloMonitor, default_fleet_rules
+
+    registry, probes, _ = fleet
+    rules = default_fleet_rules()
+    watched = {r.metric for r in rules}
+    assert "fleet_pressure" in watched
+    assert "fleet_up_replicas" in watched
+    registry.probe_once()  # both probes fail -> 0 up
+    monitor = SloMonitor(registry.metrics_registry, rules)
+    status = monitor.evaluate()
+    assert status["rules"]["fleet_up_replicas"]["status"] == "breach"
+    # Bring one replica up: the floor rule recovers.
+    probes.set("http://a:1", **UP)
+    registry.probe_once()
+    registry.probe_once()
+    status = monitor.evaluate()
+    assert status["rules"]["fleet_up_replicas"]["status"] == "ok"

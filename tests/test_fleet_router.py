@@ -1,0 +1,335 @@
+"""Fleet router dispatch: failover budget, Retry-After backoff, verbatim
+relay of non-retryable answers, and the streaming-proxy no-retry rule —
+against scripted stub replicas (stdlib HTTP only, no jax)."""
+
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_tensorflow_tpu.obs.registry import MetricsRegistry
+from distributed_tensorflow_tpu.serve.fleet import (
+    FleetRouter,
+    ProbeResult,
+    ReplicaRegistry,
+    make_router_server,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+
+class StubReplica:
+    """A scripted /generate endpoint. ``mode`` picks the behavior:
+    ok | 503 | 400 | sse | sse_rst (one token then a TCP reset)."""
+
+    def __init__(self, mode="ok", retry_after=None, tokens=3,
+                 delay_s=0.02):
+        self.mode = mode
+        self.retry_after = retry_after
+        self.tokens = tokens
+        self.delay_s = delay_s
+        self.hits = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code, payload, headers=()):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for name, value in headers:
+                    self.send_header(name, value)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_POST(self):
+                outer.hits += 1
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                mode = outer.mode
+                if mode == "503":
+                    headers = ()
+                    if outer.retry_after is not None:
+                        headers = (("Retry-After", str(outer.retry_after)),)
+                    self._json(503, {"error": "shutting_down",
+                                     "detail": "stub drain"}, headers)
+                elif mode == "400":
+                    self._json(400, {"error": "invalid", "detail": "stub"})
+                elif mode == "ok":
+                    self._json(200, {
+                        "request_id": "stub", "tokens": [1, 2, 3],
+                        "ttft_ms": 1.5, "latency_ms": 5.0,
+                        "finish_reason": "length",
+                    })
+                else:  # sse / sse_rst
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.end_headers()
+                    for i in range(outer.tokens):
+                        self.wfile.write(
+                            f"event: token\ndata: {{\"tokens\": [{i}]}}"
+                            "\n\n".encode())
+                        self.wfile.flush()
+                        if mode == "sse_rst":
+                            # Die mid-stream with a RST (not a clean FIN)
+                            # so the proxy sees a transport error after
+                            # bytes were already forwarded.
+                            self.connection.setsockopt(
+                                socket.SOL_SOCKET, socket.SO_LINGER,
+                                struct.pack("ii", 1, 0))
+                            self.connection.close()
+                            return
+                        time.sleep(outer.delay_s)
+                    self.wfile.write(
+                        b'event: done\ndata: {"request_id": "stub", '
+                        b'"tokens": [0, 1, 2], "ttft_ms": 1.0, '
+                        b'"latency_ms": 9.0, "finish_reason": "length"}\n\n')
+                    self.wfile.flush()
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+        host, port = self.server.server_address
+        self.url = f"http://{host}:{port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.thread.join(timeout=5)
+
+
+def _dead_url():
+    """A URL nothing listens on (bound then released port)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def _make_fleet(named_urls, **router_kw):
+    """Registry (all replicas probed up) + router; ids keep dict order so
+    tie-broken picks are deterministic."""
+    registry = ReplicaRegistry(
+        registry=MetricsRegistry(),
+        probe=lambda url: ProbeResult(ok=True, accepting=True, slots=2),
+        up_after=1,
+    )
+    for rid, url in named_urls.items():
+        registry.add(url, replica_id=rid)
+    registry.probe_once()
+    return registry, FleetRouter(registry, **router_kw)
+
+
+def _counter(registry, name):
+    for fam in registry.collect():
+        if fam.name == name:
+            return sum(inst.count if fam.kind == "histogram" else inst.value
+                       for _, inst in fam.children())
+    return 0.0
+
+
+def _post(base, payload, timeout=15):
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), json.loads(err.read())
+
+
+@pytest.fixture()
+def serve_router():
+    """Build a router server over the given replicas; yields a factory,
+    tears every server down after the test."""
+    cleanup = []
+
+    def build(named_urls, **router_kw):
+        registry, router = _make_fleet(named_urls, **router_kw)
+        server = make_router_server(router, port=0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        cleanup.append((server, thread))
+        host, port = server.server_address
+        return f"http://{host}:{port}", registry, router
+
+    yield build
+    for server, thread in cleanup:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
+def test_failover_on_connect_error(serve_router):
+    live = StubReplica(mode="ok")
+    try:
+        # "a-dead" sorts first, so the tie-broken first pick hits the
+        # dead port and the answer must come from the failover.
+        base, registry, _ = serve_router(
+            {"a-dead": _dead_url(), "b-live": live.url})
+        status, headers, body = _post(base, {"prompt": [1]})
+        assert status == 200 and body["tokens"] == [1, 2, 3]
+        assert headers["X-Replica"] == "b-live"
+        assert headers["X-Attempts"] == "2"
+        assert live.hits == 1
+        reg = registry.metrics_registry
+        assert _counter(reg, "fleet_failover_total") == 1
+        assert _counter(reg, "fleet_shed_total") == 0
+        # The dead replica's transport error fed its failure streak.
+        assert registry.get("a-dead").error_total == 1
+    finally:
+        live.close()
+
+
+def test_retry_budget_exhausted_relays_last_503(serve_router):
+    a, b = StubReplica(mode="503", retry_after=7), StubReplica(mode="503")
+    try:
+        base, registry, _ = serve_router(
+            {"a": a.url, "b": b.url}, max_attempts=2)
+        status, headers, body = _post(base, {"prompt": [1]})
+        assert status == 503
+        assert body["error"] == "shutting_down"
+        assert headers["X-Attempts"] == "2"
+        assert "Retry-After" in headers
+        assert a.hits + b.hits == 2  # budget, not a storm
+        reg = registry.metrics_registry
+        assert _counter(reg, "fleet_shed_total") == 1
+        assert _counter(reg, "fleet_failover_total") == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_retry_after_backs_the_replica_off(serve_router):
+    a = StubReplica(mode="503", retry_after=30)
+    b = StubReplica(mode="ok")
+    try:
+        base, registry, _ = serve_router({"a": a.url, "b": b.url})
+        status, headers, _ = _post(base, {"prompt": [1]})
+        assert status == 200 and headers["X-Replica"] == "b"
+        assert registry.get("a").backoff_until > registry.clock()
+        # While backed off, dispatch never knocks on "a" again.
+        _post(base, {"prompt": [2]})
+        assert a.hits == 1
+        assert b.hits == 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_400_is_not_retried(serve_router):
+    a, b = StubReplica(mode="400"), StubReplica(mode="400")
+    try:
+        base, _, _ = serve_router({"a": a.url, "b": b.url})
+        status, headers, body = _post(base, {"prompt": [1]})
+        assert (status, body["error"]) == (400, "invalid")
+        assert headers["X-Attempts"] == "1"
+        assert a.hits + b.hits == 1  # the client's fault travels once
+    finally:
+        a.close()
+        b.close()
+
+
+def test_no_upstream_answers_503(serve_router):
+    base, registry, _ = serve_router({})
+    status, headers, body = _post(base, {"prompt": [1]})
+    assert (status, body["error"]) == (503, "no_upstream")
+    assert "Retry-After" in headers
+    assert _counter(registry.metrics_registry, "fleet_shed_total") == 1
+
+
+def test_streaming_proxies_unbuffered(serve_router):
+    stub = StubReplica(mode="sse", tokens=4, delay_s=0.15)
+    try:
+        base, registry, _ = serve_router({"a": stub.url})
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": [1], "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = time.monotonic()
+        ttft = None
+        saw_done = False
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/event-stream")
+            assert resp.headers["X-Replica"] == "a"
+            for raw in resp:
+                line = raw.decode().rstrip()
+                if line == "event: token" and ttft is None:
+                    ttft = time.monotonic() - t0
+                if line == "event: done":
+                    saw_done = True
+        total = time.monotonic() - t0
+        # Token frames arrive AS PRODUCED: first token lands well before
+        # the stub's 4 x 0.15s production finishes. A buffering proxy
+        # would collapse ttft into total.
+        assert saw_done
+        assert ttft is not None and ttft < total / 2, (ttft, total)
+        reg = registry.metrics_registry
+        assert _counter(reg, "fleet_ttft_seconds") == 1  # observed at first chunk
+        assert _counter(reg, "fleet_stream_aborted_total") == 0
+    finally:
+        stub.close()
+
+
+def test_partial_stream_is_never_retried(serve_router):
+    dying = StubReplica(mode="sse_rst")
+    healthy = StubReplica(mode="sse")
+    try:
+        base, registry, _ = serve_router(
+            {"a-dying": dying.url, "b-healthy": healthy.url})
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": [1], "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        events = []
+        try:
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                for raw in resp:
+                    line = raw.decode().rstrip()
+                    if line.startswith("event: "):
+                        events.append(line[len("event: "):])
+        except (OSError, urllib.error.URLError):
+            pass  # truncation may also surface as a transport error
+        # The client saw a prefix but no terminal frame — and the router
+        # did NOT replay the request on the healthy replica (the client
+        # already consumed non-idempotent output).
+        assert "done" not in events
+        assert healthy.hits == 0
+        reg = registry.metrics_registry
+        assert _counter(reg, "fleet_stream_aborted_total") == 1
+        assert _counter(reg, "fleet_failover_total") == 0
+    finally:
+        dying.close()
+        healthy.close()
+
+
+def test_router_endpoints(serve_router):
+    stub = StubReplica(mode="ok")
+    try:
+        base, _, _ = serve_router({"a": stub.url})
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as resp:
+            health = json.loads(resp.read())
+        assert health["ok"] is True and health["up_replicas"] == 1
+        with urllib.request.urlopen(base + "/fleet.json", timeout=5) as resp:
+            snap = json.loads(resp.read())
+        assert snap["replicas"]["a"]["state"] == "up"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+        for name in ("fleet_pressure", "fleet_up_replicas",
+                     "fleet_replica_state", "fleet_replica_queue_depth",
+                     "fleet_replica_occupancy"):
+            assert name in text, f"missing {name} in router /metrics"
+    finally:
+        stub.close()

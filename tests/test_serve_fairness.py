@@ -1,0 +1,224 @@
+"""Priority lanes + per-client weighted fairness (_FairQueue) and the
+streaming handle contract. Queue-level tests need no engine; the
+admission-order and streaming tests drive a real tiny SlotEngine."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from distributed_tensorflow_tpu.serve import (
+    Completion,
+    Rejection,
+    Request,
+    Scheduler,
+    SlotEngine,
+)
+from distributed_tensorflow_tpu.serve.scheduler import (
+    DEFAULT_LANE_WEIGHTS,
+    NUM_LANES,
+    PendingRequest,
+    _FairQueue,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+
+def _pending(request_id, lane=1, client=""):
+    return PendingRequest(
+        request=Request(prompt=(1,), request_id=request_id,
+                        priority=lane, client_id=client),
+        submitted_at=0.0,
+    )
+
+
+def _pop_ids(q, n=None):
+    out = []
+    while len(q) and (n is None or len(out) < n):
+        out.append(q.pop().request.request_id)
+    return out
+
+
+# -- queue-level ----------------------------------------------------------
+
+
+def test_single_anonymous_client_degrades_to_fcfs():
+    """The pre-PR-7 behavior is a special case, not a casualty: one
+    client, one lane => pure submission order."""
+    q = _FairQueue()
+    for i in range(10):
+        q.push(_pending(f"r{i}"))
+    assert _pop_ids(q) == [f"r{i}" for i in range(10)]
+
+
+def test_lane_weighted_interleave_is_8_4_1():
+    """Under full contention one credit cycle admits 8 interactive, 4
+    normal, 1 batch — batch is throttled but never starved."""
+    q = _FairQueue()
+    for lane in range(NUM_LANES):
+        for i in range(20):
+            q.push(_pending(f"l{lane}-{i}", lane=lane))
+    lanes = [int(rid[1]) for rid in _pop_ids(q, n=13)]
+    assert lanes == [0] * 8 + [1] * 4 + [2] * 1
+    # Next cycle: credits refill, same pattern.
+    lanes = [int(rid[1]) for rid in _pop_ids(q, n=13)]
+    assert lanes == [0] * 8 + [1] * 4 + [2] * 1
+
+
+def test_drained_lanes_do_not_block_the_rest():
+    """Weights cap share under contention only: with lane 0 empty, lanes
+    1 and 2 split the whole admission rate (work conservation)."""
+    q = _FairQueue()
+    for i in range(4):
+        q.push(_pending(f"n{i}", lane=1))
+        q.push(_pending(f"b{i}", lane=2))
+    ids = _pop_ids(q)
+    assert sorted(ids) == sorted([f"n{i}" for i in range(4)]
+                                 + [f"b{i}" for i in range(4)])
+
+
+def test_per_client_drr_equal_weights_round_robin():
+    q = _FairQueue()
+    for i in range(3):
+        q.push(_pending(f"a{i}", client="alice"))
+    for i in range(3):
+        q.push(_pending(f"b{i}", client="bob"))
+    ids = _pop_ids(q)
+    # Admissions rotate across clients; each client's own requests FIFO.
+    assert ids == ["a0", "b0", "a1", "b1", "a2", "b2"]
+
+
+def test_per_client_drr_weighted_shares():
+    """client weight 2 gets two admissions per ring pass to bob's one."""
+    q = _FairQueue(client_weights={"alice": 2.0})
+    for i in range(6):
+        q.push(_pending(f"a{i}", client="alice"))
+        q.push(_pending(f"b{i}", client="bob"))
+    first6 = _pop_ids(q, n=6)
+    assert sum(1 for r in first6 if r.startswith("a")) == 4
+    assert sum(1 for r in first6 if r.startswith("b")) == 2
+    assert [r for r in first6 if r.startswith("a")] == ["a0", "a1", "a2", "a3"]
+
+
+def test_chatty_client_cannot_monopolize_lane():
+    """20 queued from the flood client vs 1 from the quiet one — the quiet
+    client is admitted within one ring pass, not after 20 requests."""
+    q = _FairQueue()
+    for i in range(20):
+        q.push(_pending(f"flood{i}", client="flood"))
+    q.push(_pending("quiet0", client="quiet"))
+    first4 = _pop_ids(q, n=4)
+    assert "quiet0" in first4
+
+
+def test_remove_if_preserves_fifo_and_len():
+    q = _FairQueue()
+    for i in range(6):
+        q.push(_pending(f"r{i}", client="c", lane=i % 2))
+    removed = q.remove_if(lambda p: int(p.request.request_id[1]) % 3 == 0)
+    assert [p.request.request_id for p in removed] == ["r0", "r3"]
+    assert len(q) == 4
+    assert sorted(_pop_ids(q)) == ["r1", "r2", "r4", "r5"]
+    assert len(q) == 0 and q.depths() == (0, 0, 0)
+
+
+def test_bad_weights_rejected():
+    with pytest.raises(ValueError):
+        _FairQueue(lane_weights=(1, 2))  # wrong arity
+    with pytest.raises(ValueError):
+        _FairQueue(lane_weights=(0, 1, 1))  # zero starves a lane forever
+    with pytest.raises(ValueError):
+        _FairQueue(client_weights={"a": 0.0})
+
+
+# -- scheduler-level (real engine) ----------------------------------------
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    num_heads=4,
+    num_layers=2,
+    d_ff=64,
+    max_seq_len=32,
+    compute_dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = TransformerLM(CFG)
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+
+
+def _engine(params, slots=1):
+    return SlotEngine(CFG, params, slots=slots, max_len=32, prefill_len=12)
+
+
+def test_interactive_overtakes_queued_batch(params):
+    """Batch requests submitted FIRST still yield the slot: lane 0 is
+    served before lane 2 under contention (this is the FCFS replacement
+    the fleet needs for priority lanes)."""
+    sched = Scheduler(_engine(params, slots=1), max_queue_depth=16)
+    batch = [
+        sched.submit(Request(prompt=(1, 2), max_new_tokens=2, priority=2,
+                             request_id=f"batch{i}"))
+        for i in range(2)
+    ]
+    inter = [
+        sched.submit(Request(prompt=(3, 4), max_new_tokens=2, priority=0,
+                             request_id=f"inter{i}"))
+        for i in range(2)
+    ]
+    assert sched.run_until_idle(max_steps=200) == 4
+    inter_ttft = [h.result(timeout=1).ttft_s for h in inter]
+    batch_ttft = [h.result(timeout=1).ttft_s for h in batch]
+    assert max(inter_ttft) < min(batch_ttft)
+
+
+def test_invalid_priority_is_typed_rejection(params):
+    sched = Scheduler(_engine(params), max_queue_depth=4)
+    out = sched.submit(
+        Request(prompt=(1,), priority=NUM_LANES)).result(timeout=1)
+    assert isinstance(out, Rejection) and out.reason == "invalid"
+    out = sched.submit(Request(prompt=(1,), priority=True)).result(timeout=1)
+    assert isinstance(out, Rejection) and out.reason == "invalid"
+
+
+def test_streaming_tokens_then_done(params):
+    """A stream handle yields token batches as rounds run and ends with
+    the same Completion result() returns; concatenated stream tokens ==
+    completion tokens."""
+    sched = Scheduler(_engine(params), max_queue_depth=4)
+    pending = sched.submit(
+        Request(prompt=(1, 2, 3), max_new_tokens=5, stream=True))
+    sched.run_until_idle(max_steps=200)
+    events = list(pending.stream_events(timeout=1))
+    kinds = [k for k, _ in events]
+    assert kinds[-1] == "done" and kinds.count("done") == 1
+    assert all(k == "tokens" for k in kinds[:-1]) and len(kinds) > 1
+    streamed = [t for k, p in events if k == "tokens" for t in p]
+    outcome = events[-1][1]
+    assert isinstance(outcome, Completion)
+    assert tuple(streamed) == outcome.tokens
+    assert len(streamed) == 5
+
+
+def test_stream_rejection_still_closes_the_stream(params):
+    """Every terminal path feeds the stream: a synchronous rejection
+    delivers ("done", Rejection) — a streaming consumer can never hang."""
+    sched = Scheduler(_engine(params), max_queue_depth=4)
+    pending = sched.submit(Request(prompt=(), stream=True))  # invalid
+    events = list(pending.stream_events(timeout=1))
+    assert len(events) == 1
+    kind, outcome = events[0]
+    assert kind == "done"
+    assert isinstance(outcome, Rejection) and outcome.reason == "invalid"
+
+
+def test_default_weights_exported():
+    assert DEFAULT_LANE_WEIGHTS == (8, 4, 1)

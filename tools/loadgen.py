@@ -4,9 +4,15 @@
 Two modes of driving, two modes of arrival:
 
 * ``--url http://host:port`` hits a running ``tools/serve_lm.py`` over
-  HTTP. Without ``--url`` it self-serves: builds the demo-weight stack
-  in-process (same wiring via ``serve_lm.build_stack``) and submits
-  straight to the scheduler — no network, which is what CI wants.
+  HTTP; ``--targets a,b,...`` sprays several replicas round-robin or
+  points at one ``tools/serve_fleet.py`` router (whose ``X-Replica`` /
+  ``X-Attempts`` headers feed the report's per-replica attribution and
+  failover counts). ``--stream`` switches HTTP submits to SSE and
+  measures TTFT at the client — the wall arrival of the first token
+  frame, not the replica's self-report. Without a target it
+  self-serves: builds the demo-weight stack in-process (same wiring via
+  ``serve_lm.build_stack``) and submits straight to the scheduler — no
+  network, which is what CI wants.
 * Closed loop (default): ``--concurrency`` workers, each submitting its
   next request the moment the previous one finishes — measures capacity.
   Open loop (``--rate R``): requests fire on a Poisson-ish fixed schedule
@@ -49,7 +55,10 @@ def _percentiles(xs):
 
 
 class _Accounting:
-    """Every submitted request lands in exactly one bucket."""
+    """Every submitted request lands in exactly one bucket. When the
+    target is a fleet router, the X-Replica / X-Attempts response headers
+    additionally attribute each answer to the replica that produced it
+    and count failovers (attempts beyond the first)."""
 
     def __init__(self):
         self.lock = threading.Lock()
@@ -60,6 +69,8 @@ class _Accounting:
         self.ttft_s = []
         self.latency_s = []
         self.shed_reasons = {}
+        self.per_replica = {}
+        self.failovers = 0
 
     def complete(self, ttft_s, latency_s, n_tokens):
         with self.lock:
@@ -77,12 +88,67 @@ class _Accounting:
         with self.lock:
             self.errored += 1
 
+    def attribute(self, headers):
+        """Record routing metadata from a response's headers (no-op for
+        a bare replica, which sends neither header)."""
+        if headers is None:
+            return
+        replica = headers.get("X-Replica")
+        attempts = headers.get("X-Attempts")
+        with self.lock:
+            if replica:
+                self.per_replica[replica] = (
+                    self.per_replica.get(replica, 0) + 1)
+            if attempts:
+                try:
+                    self.failovers += max(0, int(attempts) - 1)
+                except ValueError:
+                    pass
 
-def _http_submit(url, payload, timeout_s, acct):
+
+def _read_sse(resp, t0, acct):
+    """Consume one SSE /generate response. Returns True when a terminal
+    ``done`` frame arrived (the no-silent-drop criterion for streams);
+    TTFT is the wall arrival of the FIRST token frame — the user-visible
+    figure, not the replica's self-report."""
+    event = None
+    ttft = None
+    tokens = 0
+    done = None
+    for raw in resp:
+        line = raw.decode("utf-8", "replace").rstrip("\n\r")
+        if line.startswith("event: "):
+            event = line[len("event: "):]
+        elif line.startswith("data: "):
+            obj = json.loads(line[len("data: "):])
+            if event == "token":
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                tokens += len(obj.get("tokens", ()))
+            elif event == "done":
+                done = obj
+    if done is None:
+        # Stream truncated without a terminal frame: a drop, not a shed.
+        acct.error()
+        return False
+    if "error" in done:
+        acct.reject(done["error"])
+        return True
+    acct.complete(
+        ttft if ttft is not None else time.monotonic() - t0,
+        time.monotonic() - t0,
+        tokens or len(done.get("tokens", ())),
+    )
+    return True
+
+
+def _http_submit(url, payload, timeout_s, acct, stream=False):
     import urllib.error
     import urllib.request
 
     t0 = time.monotonic()
+    if stream:
+        payload = {**payload, "stream": True}
     req = urllib.request.Request(
         url + "/generate",
         data=json.dumps(payload).encode(),
@@ -90,6 +156,11 @@ def _http_submit(url, payload, timeout_s, acct):
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            acct.attribute(resp.headers)
+            ctype = resp.headers.get("Content-Type", "")
+            if ctype.startswith("text/event-stream"):
+                _read_sse(resp, t0, acct)
+                return
             body = json.loads(resp.read())
         acct.complete(
             body.get("ttft_ms", 0.0) / 1e3,
@@ -102,6 +173,7 @@ def _http_submit(url, payload, timeout_s, acct):
         except Exception:
             reason = f"http_{e.code}"
         # A structured 4xx/5xx IS the shed response — typed, not dropped.
+        acct.attribute(e.headers)
         acct.reject(reason)
     except Exception:
         acct.error()
@@ -228,6 +300,16 @@ def main(argv=None):
         "--url", default="",
         help="serve_lm endpoint; empty = self-serve demo weights in-process",
     )
+    parser.add_argument(
+        "--targets", default="",
+        help="comma-separated endpoints — one fleet-router URL, or several "
+        "replica URLs to spray round-robin (supersedes --url when set)",
+    )
+    parser.add_argument(
+        "--stream", action="store_true",
+        help="HTTP mode: request SSE streams and measure TTFT at the "
+        "client (wall arrival of the first token frame)",
+    )
     parser.add_argument("--num_requests", type=int, default=32)
     parser.add_argument(
         "--concurrency", type=int, default=8,
@@ -282,11 +364,20 @@ def main(argv=None):
             payload["deadline_s"] = args.deadline_s
         return payload
 
+    targets = [t.rstrip("/") for t in args.targets.split(",") if t.strip()]
+    if not targets and args.url:
+        targets = [args.url.rstrip("/")]
+
     scheduler = None
     server = None
-    if args.url:
+    if targets:
         def submit_one(payload, timeout_s, acct):
-            _http_submit(args.url.rstrip("/"), payload, timeout_s, acct)
+            # Deterministic round-robin over targets; with one router URL
+            # this degenerates to "always the router", which then does the
+            # real (health-aware) balancing.
+            target = targets[payload.get("seed", 0) % len(targets)]
+            _http_submit(target, payload, timeout_s, acct,
+                         stream=args.stream)
     else:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         import jax
@@ -330,7 +421,8 @@ def main(argv=None):
     # Scrape server health BEFORE teardown so the report record is
     # self-describing: was the server SLO-degraded during this run, and did
     # the engine recompile after warmup (it must not)?
-    slo_status, recompiles = _scrape_health(args.url, server)
+    slo_status, recompiles = _scrape_health(
+        targets[0] if targets else "", server)
     if scheduler is not None:
         scheduler.stop()
 
@@ -358,6 +450,10 @@ def main(argv=None):
         "rate": args.rate,
         "slots": args.slots,
         "url": args.url,
+        "targets": targets,
+        "stream": bool(args.stream),
+        "per_replica": acct.per_replica,
+        "failovers": acct.failovers,
     }
     print(json.dumps(report))
     if args.report_file:

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Launch a serving fleet: router + N local ``serve_lm`` replicas.
+
+The process tree mirrors the paper's chief/worker cluster on one
+machine: this process is the coordination-only router (no model, no
+accelerator) and each replica is a full ``tools/serve_lm.py`` serving
+stack on an OS-assigned port. Replica flags are whatever this launcher
+doesn't recognise — they are forwarded verbatim, so every ``serve_lm``
+knob works per-fleet:
+
+  python tools/serve_fleet.py --num_replicas 2 --router_port 8100 \\
+      --demo --slots 4 --d_model 128 --num_layers 4
+
+  curl -s localhost:8100/generate -d '{"prompt": [7,8,9]}'
+  curl -s localhost:8100/fleet.json   # per-replica states + pressure
+  curl -s localhost:8100/metrics      # fleet gauges, Prometheus text
+  curl -s localhost:8100/healthz      # 200 iff >= 1 replica is up
+
+Replicas bind port 0 and announce their address on stdout (the
+``serving on http://…`` line ``serve_lm`` already prints); the launcher
+parses that, so N replicas never race for ports. SIGTERM/SIGINT to the
+launcher drains the whole fleet: replicas get SIGTERM (their own drain
+path finishes accepted work), then the router exits.
+
+``launch_fleet()`` / ``ReplicaProc`` are importable — ``bench.py`` and
+the e2e kill-a-replica test drive the same spawning code as the CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+_URL_PREFIX = "serving on "
+
+
+class ReplicaProc:
+    """One spawned ``serve_lm`` replica: process handle, parsed URL, and
+    a bounded tail of its output (kept readable after startup so the
+    child never blocks on a full stdout pipe)."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+        self.url: str | None = None
+        self.tail = collections.deque(maxlen=200)
+        self._url_ready = threading.Event()
+        self._reader = threading.Thread(target=self._read, daemon=True)
+        self._reader.start()
+
+    def _read(self):
+        for line in self.proc.stdout:
+            line = line.rstrip("\n")
+            self.tail.append(line)
+            if self.url is None and line.startswith(_URL_PREFIX):
+                self.url = line[len(_URL_PREFIX):].split()[0]
+                self._url_ready.set()
+        self._url_ready.set()  # EOF: unblock waiters even on crash
+
+    def wait_url(self, timeout_s: float) -> str:
+        if not self._url_ready.wait(timeout_s) or self.url is None:
+            raise RuntimeError(
+                f"replica pid {self.proc.pid} did not announce a URL "
+                f"within {timeout_s}s; output tail:\n"
+                + "\n".join(self.tail)
+            )
+        return self.url
+
+    def terminate(self, grace_s: float = 15.0) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()  # SIGTERM -> serve_lm drain path
+            try:
+                self.proc.wait(grace_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(5.0)
+
+
+def launch_fleet(
+    num_replicas: int,
+    replica_argv,
+    *,
+    env=None,
+    startup_timeout_s: float = 180.0,
+) -> list[ReplicaProc]:
+    """Spawn N replicas (port 0 each) and wait for every URL. Spawning
+    is eager and waiting sequential, so the expensive part — jax import
+    + engine warmup — overlaps across replicas. On any failure the
+    already-started replicas are torn down before the raise."""
+    replicas = []
+    try:
+        for _ in range(num_replicas):
+            cmd = [
+                sys.executable, os.path.join(_TOOLS_DIR, "serve_lm.py"),
+                "--port", "0", *replica_argv,
+            ]
+            proc = subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env,
+            )
+            replicas.append(ReplicaProc(proc))
+        deadline = time.monotonic() + startup_timeout_s
+        for replica in replicas:
+            replica.wait_url(max(1.0, deadline - time.monotonic()))
+        return replicas
+    except Exception:
+        for replica in replicas:
+            replica.terminate(grace_s=2.0)
+        raise
+
+
+def main(argv=None):
+    from distributed_tensorflow_tpu import obs
+    from distributed_tensorflow_tpu.config import (
+        FleetConfig,
+        add_dataclass_flags,
+        from_args,
+    )
+    from distributed_tensorflow_tpu.serve.fleet import (
+        FleetRouter,
+        ReplicaRegistry,
+        make_router_server,
+    )
+
+    parser = argparse.ArgumentParser()
+    add_dataclass_flags(parser, FleetConfig)
+    ns, replica_argv = parser.parse_known_args(argv)
+    fleet_cfg = from_args(FleetConfig, ns)
+    if fleet_cfg.num_replicas < 1:
+        sys.exit("--num_replicas must be >= 1")
+
+    print(
+        f"serve_fleet: starting {fleet_cfg.num_replicas} replicas "
+        f"({' '.join(replica_argv) or 'default flags'})",
+        flush=True,
+    )
+    replicas = launch_fleet(fleet_cfg.num_replicas, replica_argv)
+    registry = ReplicaRegistry(
+        [r.url for r in replicas],
+        up_after=fleet_cfg.up_after,
+        down_after=fleet_cfg.down_after,
+    )
+    router = FleetRouter(registry, max_attempts=fleet_cfg.max_attempts)
+    slo_rules = obs.parse_slo_flag(
+        fleet_cfg.fleet_slo, defaults=obs.default_fleet_rules)
+    slo_monitor = (obs.SloMonitor(registry.metrics_registry, slo_rules)
+                   if slo_rules else None)
+    server = make_router_server(
+        router, fleet_cfg.router_host, fleet_cfg.router_port,
+        slo=slo_monitor)
+    registry.start(fleet_cfg.probe_interval_s)
+    # Let the hysteresis see enough probes to mark replicas up before we
+    # announce — the URLs were parsed from live servers, so this is quick.
+    deadline = time.monotonic() + 30.0
+    while registry.up_count() < len(replicas) and time.monotonic() < deadline:
+        time.sleep(fleet_cfg.probe_interval_s)
+    if slo_monitor is not None:
+        slo_monitor.start(fleet_cfg.fleet_slo_interval_s)
+    host, port = server.server_address
+    print(
+        f"router on http://{host}:{port}  replicas="
+        f"{','.join(r.url or '?' for r in replicas)} "
+        f"up={registry.up_count()}",
+        flush=True,
+    )
+
+    def _on_signal(signum, frame):
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+        if slo_monitor is not None:
+            slo_monitor.stop()
+        registry.stop()
+        for replica in replicas:
+            replica.terminate()
+        print("serve_fleet: shut down cleanly", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -85,6 +85,7 @@ def build_stack(serve_cfg, cfg, params):
         engine,
         max_queue_depth=serve_cfg.max_queue_depth,
         metrics=metrics,
+        lane_weights=getattr(serve_cfg, "lane_weight_tuple", (8, 4, 1)),
     )
     slo_rules = obs.parse_slo_flag(
         getattr(serve_cfg, "slo", "default"),
@@ -229,6 +230,31 @@ def main(argv=None):
     scheduler.start()
     if server.slo_monitor is not None:
         server.slo_monitor.start(serve_cfg.slo_interval_s)
+
+    # SIGTERM = graceful drain (the fleet contract): stop accepting so
+    # /healthz flips 503 and the router marks this replica draining, keep
+    # serving everything already accepted, then stop when idle or when the
+    # drain deadline expires — whichever comes first.
+    import signal
+
+    def _on_sigterm(signum, frame):
+        scheduler.begin_drain(serve_cfg.drain_deadline_s)
+        print(
+            f"serve_lm: SIGTERM — draining for up to "
+            f"{serve_cfg.drain_deadline_s}s",
+            flush=True,
+        )
+
+        def _finish():
+            deadline = time.monotonic() + serve_cfg.drain_deadline_s
+            while time.monotonic() < deadline and not scheduler.idle:
+                time.sleep(0.05)
+            server.shutdown()
+
+        threading.Thread(target=_finish, name="serve-drain",
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
