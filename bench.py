@@ -568,18 +568,30 @@ def bench_lm_decode() -> list[dict]:
 
 
 def bench_serving() -> list[dict]:
-    """Continuous batching (serve/SlotEngine + FCFS scheduler) vs the
-    sequential status quo (one request at a time through ONE reused jitted
-    ``build_generate_fn``) on the SAME transformer — the Orca claim as a
-    ratchet. Greedy on both sides (apples-to-apples: temperature=0
-    sequential pays no sampling sorts, and neither does the engine's
-    greedy fast path). Decode must be weight-read bound for slot-batching
-    to pay (each batched step reads params once for ``slots`` tokens), so
-    the smoke model is sized past LLC (~55 MB f32) rather than tiny, and
-    the TPU run uses the ~100M-param decode-bench shape. Also reports p99
-    TTFT under the closed-loop burst (all requests submitted at t0 — tail
-    TTFT includes queue wait behind earlier waves, the honest serving
-    number) and the engine's post-warmup recompile count (must be 0)."""
+    """Decode fast path (paged KV + prefix cache + self-speculative
+    verify) vs the sequential status quo (one request at a time through
+    ONE reused jitted ``build_generate_fn``) on the SAME transformer.
+    Greedy on both sides, and the fast path must be INVISIBLE in the
+    tokens: every engine configuration's output is asserted identical to
+    every other's — including speculative vs plain — before any timing
+    counts.
+
+    The workload is the shape the tentpole optimizes: a shared-prefix
+    burst (``n_groups`` prompt families, each group sharing a long common
+    prefix with short distinct tails — the system-prompt / few-shot
+    pattern). The first request of each group prefill-inserts the prefix
+    pages; groupmates adopt them copy-free, so the prefix hit rate below
+    is deterministic, not luck. ``max_len`` carries ``P + n_new`` plus
+    nothing extra: adoption depth is capped at
+    ``(max_len - prefill_len) // page_size`` pages (the prefill program's
+    fixed tail width must land below max_len), so ``n_new`` is sized to
+    keep the whole shared prefix adoptable.
+
+    Decode must be weight-read bound for slot-batching to pay, so the
+    smoke model is sized past LLC (~55 MB f32) and the TPU run uses the
+    ~100M-param decode-bench shape. Also reports p99 TTFT under the
+    closed-loop burst (all requests submitted at t0) and asserts the
+    post-warmup recompile count is 0 for every configuration."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -595,10 +607,12 @@ def bench_serving() -> list[dict]:
         ServingMetrics,
         SlotEngine,
     )
+    from distributed_tensorflow_tpu.serve.kv_pool import SlotKVPool
 
     if SMOKE:
         dm, h, nl, dff, vocab = 512, 8, 4, 2048, 1024
-        P, n_new, n_req, slots = 16, 16, 8, 4
+        P, n_new, n_req, slots = 48, 32, 8, 8
+        n_groups, prefix_len, page_size = 2, 32, 16
         # One steps_per_sync: CPU dispatch is cheap and stable.
         sync_candidates = (8,)
         dtype = jnp.float32
@@ -609,11 +623,16 @@ def bench_serving() -> list[dict]:
         # weight-read bound at B=1, so slot-batching has physics headroom.
         dm, h, nl, dff, vocab = 1024, 8, 8, 4096, 256
         P, n_new, n_req, slots = 128, 256, 16, 8
+        n_groups, prefix_len, page_size = 4, 96, 32
         # Per-dispatch tunnel latency swings 2.5-95 ms; steps_per_sync is
         # the serving config that amortizes it, so the bench picks the best
         # of two honest configs rather than hard-coding one tunnel regime.
         sync_candidates = (32, 128)
         dtype = jnp.bfloat16
+    # Speculation is measured, never assumed: the drafter's accept rate on
+    # a random-init model is low, so spec_k=0 usually wins the clock while
+    # spec_k>0 proves parity and reports the accept rate.
+    spec_candidates = (0, 4)
 
     cfg = TransformerConfig(
         vocab_size=vocab, d_model=dm, num_heads=h, num_layers=nl, d_ff=dff,
@@ -623,9 +642,14 @@ def bench_serving() -> list[dict]:
     params = jax.jit(
         lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"]
     )(jax.random.PRNGKey(0))
-    prompts = np.random.default_rng(0).integers(
-        0, vocab, (n_req, P), dtype=np.int64
-    ).astype(np.int32)
+    # Shared-prefix burst: n_groups families x (n_req / n_groups) members.
+    rng = np.random.default_rng(0)
+    prompts = np.stack([
+        np.concatenate([prefix, rng.integers(0, vocab, P - prefix_len)])
+        for prefix in (rng.integers(0, vocab, prefix_len)
+                       for _ in range(n_groups))
+        for _ in range(n_req // n_groups)
+    ]).astype(np.int32)
 
     # Both sides take the best of `repeats` identical passes: on a shared
     # CPU box a noisy-neighbor burst can halve one pass's throughput, and
@@ -634,7 +658,8 @@ def bench_serving() -> list[dict]:
     repeats = 3 if SMOKE else 1
 
     # Sequential baseline: the pre-serving API exactly as tools/generate.py
-    # drives it — one compiled program, requests one after another.
+    # drives it — one compiled program, requests one after another, every
+    # prompt prefilled from scratch (no cross-request reuse to hand it).
     gen = build_generate_fn(cfg, n_new)
     key = jax.random.PRNGKey(0)
     _drain(gen(params, jnp.asarray(prompts[:1]), key)[0, -1])  # compile
@@ -647,41 +672,75 @@ def bench_serving() -> list[dict]:
     seq_tok_s = n_req * n_new / seq_s
 
     best = None
+    ref_tokens = None
+    spec_accept = 0.0
     for k_sync in sync_candidates:
-        engine = SlotEngine(
-            cfg, params, slots=slots, max_len=P + n_new, prefill_len=P,
-            steps_per_sync=k_sync,
-        )
-        compiled = engine.warmup()
-        point = None
-        for _ in range(repeats):
-            metrics = ServingMetrics()
-            sched = Scheduler(engine, max_queue_depth=n_req + 1,
-                              metrics=metrics)
-            pendings = [
-                sched.submit(Request(prompt=tuple(prompts[i]),
-                                     max_new_tokens=n_new))
-                for i in range(n_req)
-            ]
-            t0 = time.perf_counter()
-            done = sched.run_until_idle(max_steps=n_req * n_new + 16)
-            wall_s = time.perf_counter() - t0
-            assert done == n_req and all(p.done() for p in pendings)
-            attempt = {
-                "tok_s": n_req * n_new / wall_s,
-                "k_sync": k_sync,
-                "ttft_p99_ms": metrics.ttft.percentile(99) * 1e3,
-                "recompiles": engine.compile_count() - compiled,
-            }
-            if point is None or attempt["tok_s"] > point["tok_s"]:
-                point = attempt
-        if best is None or point["tok_s"] > best["tok_s"]:
-            best = point
+        for spec_k in spec_candidates:
+            engine = SlotEngine(
+                cfg, params, slots=slots, max_len=P + n_new, prefill_len=P,
+                steps_per_sync=k_sync, page_size=page_size, prefix_cache=True,
+                spec_k=spec_k,
+                # A tail-width bucket: groupmates that adopt the shared
+                # prefix prefill through a (P - prefix_len)-wide program
+                # instead of the full P-wide one — the TTFT payoff.
+                prefill_buckets=(P - prefix_len,),
+            )
+            compiled = engine.warmup()
+            point = None
+            for _ in range(repeats):
+                metrics = ServingMetrics()
+                sched = Scheduler(engine, max_queue_depth=n_req + 1,
+                                  metrics=metrics)
+                pendings = [
+                    sched.submit(Request(prompt=tuple(prompts[i]),
+                                         max_new_tokens=n_new))
+                    for i in range(n_req)
+                ]
+                t0 = time.perf_counter()
+                done = sched.run_until_idle(max_steps=n_req * n_new + 16)
+                wall_s = time.perf_counter() - t0
+                assert done == n_req and all(p.done() for p in pendings)
+                recompiles = engine.compile_count() - compiled
+                assert recompiles == 0, (
+                    f"serving bench recompiled after warmup "
+                    f"(k_sync={k_sync} spec_k={spec_k}): {recompiles}"
+                )
+                # The fast path must not change a single token: every
+                # config (paged/prefix, with and without speculation, any
+                # sync cadence) must emit the same greedy streams.
+                tokens = [tuple(p.result(timeout=1).tokens)
+                          for p in pendings]
+                if ref_tokens is None:
+                    ref_tokens = tokens
+                assert tokens == ref_tokens, (
+                    f"greedy parity broken at k_sync={k_sync} "
+                    f"spec_k={spec_k}"
+                )
+                attempt = {
+                    "tok_s": n_req * n_new / wall_s,
+                    "k_sync": k_sync,
+                    "spec_k": spec_k,
+                    "ttft_p99_ms": metrics.ttft.percentile(99) * 1e3,
+                    "prefix_hit_rate": engine.prefix_hit_rate,
+                    "hbm_per_slot": engine.pool.hbm_bytes_per_slot,
+                }
+                if point is None or attempt["tok_s"] > point["tok_s"]:
+                    point = attempt
+            if spec_k:
+                spec_accept = max(spec_accept, engine.spec_accept_rate)
+            if best is None or point["tok_s"] > best["tok_s"]:
+                best = point
 
     speedup = best["tok_s"] / seq_tok_s
+    # Same-HBM framing: what the monolithic pool would spend per lane at
+    # this max_len (the paged pool allocates page-granular, shares prefix
+    # pages, and wastes at most one page per request to fragmentation).
+    mono_per_slot = SlotKVPool(cfg, slots=1, max_len=P + n_new).hbm_bytes
     shape_note = (
-        f"{dm}d/{nl}L vocab {vocab}, prompt {P} + {n_new} new x {n_req} "
-        f"req, {slots} slots, steps_per_sync {best['k_sync']}, greedy"
+        f"{dm}d/{nl}L vocab {vocab}, prompt {P} ({prefix_len} shared x "
+        f"{n_groups} groups) + {n_new} new x {n_req} req, {slots} slots, "
+        f"page_size {page_size}, steps_per_sync {best['k_sync']}, "
+        f"spec_k {best['spec_k']}, greedy"
     )
     return [
         {
@@ -689,9 +748,10 @@ def bench_serving() -> list[dict]:
             "value": round(best["tok_s"], 0),
             "unit": "tokens/s",
             "detail": (
-                f"continuous batching, {shape_note}; sequential "
-                f"build_generate_fn baseline {seq_tok_s:,.0f} tok/s; "
-                f"{best['recompiles']} recompiles after warmup"
+                f"paged+prefix continuous batching, {shape_note}; "
+                f"sequential build_generate_fn baseline "
+                f"{seq_tok_s:,.0f} tok/s; 0 recompiles after warmup and "
+                f"token parity across all configs ASSERTED in-run"
             ),
         },
         {
@@ -699,8 +759,9 @@ def bench_serving() -> list[dict]:
             "value": round(best["ttft_p99_ms"], 2),
             "unit": "ms",
             "detail": (
-                f"closed-loop burst (all {n_req} submitted at t0; tail "
-                f"waits behind {n_req - slots} queued), {shape_note}"
+                f"closed-loop burst (all {n_req} submitted at t0), "
+                f"{shape_note}; prefix adoption cuts groupmate prefill "
+                f"to the tail"
             ),
         },
         {
@@ -709,8 +770,40 @@ def bench_serving() -> list[dict]:
             "unit": "x",
             "detail": (
                 f"engine {best['tok_s']:,.0f} vs sequential "
-                f"{seq_tok_s:,.0f} tok/s, {shape_note}; >= 2.0 ENFORCED "
+                f"{seq_tok_s:,.0f} tok/s, {shape_note}; >= 2.6 ENFORCED "
                 "(bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "serve_prefix_hit_rate",
+            "value": round(best["prefix_hit_rate"], 3),
+            "unit": "frac",
+            "detail": (
+                f"prompt tokens adopted from cached pages / prompt tokens "
+                f"seen, {shape_note}; deterministic for this workload "
+                f"(groupmates adopt the full {prefix_len}-token prefix); "
+                f">= 0.4 ENFORCED (bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "serve_spec_accept_rate",
+            "value": round(spec_accept, 3),
+            "unit": "frac",
+            "detail": (
+                f"drafted tokens accepted by batched verify at spec_k="
+                f"{max(spec_candidates)}, {shape_note}; informational — "
+                f"random-init weights draft poorly, a trained model's "
+                f"repetitive spans are where prompt-lookup pays"
+            ),
+        },
+        {
+            "metric": "serve_hbm_bytes_per_slot",
+            "value": round(best["hbm_per_slot"], 0),
+            "unit": "bytes",
+            "detail": (
+                f"paged pool HBM / {slots} lanes vs {mono_per_slot:,.0f} "
+                f"for a monolithic slot at max_len {P + n_new}, "
+                f"{shape_note}"
             ),
         },
     ]
@@ -1600,12 +1693,22 @@ FLOORS = {
     "lm_train_mfu_rope": 0.72,
     # The serving subsystem's reason to exist: continuous batching must
     # beat serving the same requests one at a time through sequential
-    # build_generate_fn by >= 2x on the same transformer (ISSUE 4
-    # acceptance; smoke measures 2.3-2.5x at 4 slots on CPU, the physics
-    # ceiling is ~slots x at the weight-read bound). A regression to ~1x
-    # means the engine re-serialized (lost the slot batch) or recompiles
-    # per request (lost the fixed shapes).
-    "serve_speedup_vs_sequential": 2.0,
+    # build_generate_fn on the same transformer. 2.0 -> 2.6 in r8: the
+    # decode fast path (paged KV backing 8 lanes + prefix-cache adoption
+    # on the shared-prefix burst) measures ~3x on CPU smoke where the
+    # 4-slot monolith measured 2.3-2.5x; the physics ceiling is ~slots x
+    # at the weight-read bound. A regression to ~1x means the engine
+    # re-serialized (lost the slot batch) or recompiles per request
+    # (lost the fixed shapes); a slide back to ~2.3 means the paged
+    # lanes or prefix adoption quietly stopped paying.
+    "serve_speedup_vs_sequential": 2.6,
+    # Deterministic for the bench's shared-prefix burst: every groupmate
+    # after the first adopts the full shared prefix from cached pages, so
+    # the cumulative hit rate is ~0.5 by construction (6 of 8 prompts x
+    # 32 of 48 tokens on smoke). Falling below 0.4 means adoption broke
+    # (cap regression, hash-chain miss, or eviction thrash), not that the
+    # workload changed.
+    "serve_prefix_hit_rate": 0.4,
     # The fleet's reason to exist: the router over 2 replicas must move
     # >= 1.6x the tokens of one replica hit directly under the identical
     # offered open-loop schedule (ISSUE 7 acceptance; the physics ceiling

@@ -472,10 +472,49 @@ class ServeConfig:
             "0 (interactive), 1 (normal), 2 (batch) under contention"
         },
     )
+    page_size: int = field(
+        default=-1,
+        metadata={
+            "help": "KV page size in tokens: -1 = auto (16 when it divides "
+            "serve_max_len, else one whole-row page), 0 = monolithic "
+            "per-slot KV (legacy layout), >0 = explicit page size"
+        },
+    )
+    kv_pages: int = field(
+        default=0,
+        metadata={
+            "help": "physical KV pages in the paged pool; 0 = worst case "
+            "(slots * pages_per_slot + trash). Sizing below worst case "
+            "oversubscribes: admission then gates on pages-free"
+        },
+    )
+    prefix_cache: bool = field(
+        default=True,
+        metadata={
+            "help": "adopt shared-prefix KV pages copy-free (paged layout "
+            "only); shared-system-prompt traffic prefills only the tail"
+        },
+    )
+    spec_k: int = field(
+        default=0,
+        metadata={
+            "help": "speculative drafts per verify round (greedy requests, "
+            "paged layout); 0 disables (default — opt in where the "
+            "drafter fits the traffic; the verify program is one more "
+            "warmup compile). Output is token-identical to plain "
+            "decoding — this only changes latency"
+        },
+    )
 
     @property
     def lane_weight_tuple(self) -> tuple:
         return tuple(int(w) for w in self.lane_weights.split(","))
+
+    @property
+    def engine_page_size(self) -> int | None:
+        """Resolve the ``page_size`` flag for SlotEngine: None = engine
+        auto-pick, 0 = monolithic, else the explicit value."""
+        return None if self.page_size < 0 else self.page_size
 
 
 @dataclass

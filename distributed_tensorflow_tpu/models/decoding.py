@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_tensorflow_tpu.models.transformer import TransformerConfig, TransformerLM
 
@@ -22,6 +23,7 @@ __all__ = [
     "init_cache",
     "build_generate_fn",
     "decode_step",
+    "propose_ngram_drafts",
     "sample_logits",
     "sample_logits_batched",
 ]
@@ -146,6 +148,36 @@ def decode_step(model: TransformerLM, params, cache, tok):
     per-slot traced scalar and the K/V appends become per-slot scatters."""
     logits, cache = model.apply({"params": params}, tok, cache=cache)
     return cache, logits[:, -1]
+
+
+def propose_ngram_drafts(history, k: int, ngram: int = 2):
+    """Prompt-lookup drafting (host-side, numpy): propose ``k`` candidate
+    next tokens by continuing the most recent earlier occurrence of the
+    sequence's final n-gram.
+
+    This is the "self-speculative" drafter: no draft model, just the
+    request's own prompt + emitted tokens. It backs off from ``ngram`` to
+    shorter grams, and pads with the last token when no continuation is
+    found. Draft quality only affects SPEED — the engine's verify step
+    accepts exactly the longest prefix matching the target model's greedy
+    outputs, so a bad draft costs a shorter accepted run, never a wrong
+    token."""
+    h = np.asarray(history, np.int32).ravel()
+    n = int(h.size)
+    draft = np.zeros(k, np.int32)
+    if n == 0:
+        return draft
+    draft[:] = h[-1]
+    for g in range(min(ngram, n - 1), 0, -1):
+        pat = h[n - g:]
+        # Most recent earlier occurrence whose continuation exists.
+        for j in range(n - g - 1, -1, -1):
+            if np.array_equal(h[j : j + g], pat):
+                cont = h[j + g : j + g + k]
+                if cont.size:
+                    draft[: cont.size] = cont
+                    return draft
+    return draft
 
 
 def build_generate_fn(

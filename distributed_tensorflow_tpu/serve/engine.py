@@ -1,42 +1,68 @@
 """Continuous-batching decode engine: fixed shapes, zero recompiles.
 
-Two jitted programs serve every request mix after warmup:
+The engine runs one of two KV layouts behind the same slot API:
 
-* **prefill** — one batched causal forward of a PADDED ``(1, prefill_len)``
-  prompt into a fresh ``(1, max_len)`` cache (``models/decoding.init_cache``),
-  then the request's FIRST token sampled at its true last prompt position.
-  Padding keeps the shape fixed across heterogeneous prompt lengths; the
-  junk K/V the pad positions write is never readable (see the overwrite
-  invariant below). The resulting cache is scattered into the request's
-  pool slot in place (``kv_pool.adopt``).
+* ``page_size=0`` — the PR-4 monolithic layout: per-slot worst-case rows
+  in a :class:`~distributed_tensorflow_tpu.serve.kv_pool.SlotKVPool`.
+  Kept verbatim as the parity baseline.
 
-* **decode step** — ``steps_per_sync`` micro-steps over the WHOLE slot
-  batch fused into one ``lax.scan`` program. Each micro-step runs the
-  per-token program factored out of ``build_generate_fn``
-  (``models/decoding.decode_step``) per slot under ``jax.vmap``: the
-  cache's ``len`` becomes a per-slot traced scalar, so every slot appends
-  at ITS OWN filled length (the K/V writes lower to per-slot scatters) and
-  rotates/embeds at its own positions. Sampling is per-slot too
-  (``sample_logits_batched``: traced temperature/top-k/top-p, one PRNG
-  stream per slot). Inactive slots are masked — they burn a lane of
-  compute to keep the shape fixed, which is exactly the trade that makes
-  the program compile once.
+* ``page_size>0`` (default) — the paged layout: one physical page pool
+  (:class:`~distributed_tensorflow_tpu.serve.kv_pool.PagedKVPool`) plus
+  per-slot page tables. Every jitted program gathers a slot's logical
+  ``(kv, max_len, dh)`` cache from its table row, runs the SAME model
+  code as the monolithic path, and scatters touched pages back. The
+  table is a host numpy array passed as a TRACED operand of fixed shape
+  ``(slots, pages_per_slot)``, so rebinding pages never retraces; unbound
+  entries point at the reserved trash page, which absorbs the fixed-shape
+  scatters of masked lanes.
 
-Correctness invariant for slot reuse (why freed slots are not zeroed and
-pad junk is harmless): after prefill the filled length is the TRUE prompt
-length ``p``, and a decode step at length ``len`` writes position ``len``
-BEFORE attending keys ``0..len`` (the cache append precedes the score
-einsum in ``attention_sublayer``). By induction every attended key was
-written by this request — stale rows from a previous tenant or from pad
-positions sit strictly above the filled length until the step that
-overwrites them. ``tests/test_serve_engine.py::test_slot_reuse_isolation``
-pins this.
+Jitted programs (all compiled at :meth:`SlotEngine.warmup`, after which
+the compile count must never grow — the ``RecompileSentinel`` contract):
+
+* **prefill** — one batched causal forward of a PADDED ``(1, width)``
+  prompt where ``width`` is the narrowest compiled bucket (a fixed set,
+  ``prefill_buckets``, largest always ``prefill_len``) holding the real
+  tokens, then the request's FIRST token sampled at its true last prompt
+  position. Under paging the forward starts at cache ``len = m0`` where
+  ``m0`` tokens of KV were ADOPTED from the prefix cache (copy-free page
+  sharing) — only the prompt TAIL is computed, through a tail-sized
+  bucket, which is what collapses TTFT for shared-system-prompt traffic.
+
+* **decode step** — ``steps_per_sync`` micro-steps over the whole slot
+  batch fused into one ``lax.scan``; per-slot traced lengths, per-slot
+  sampling (``sample_logits_batched``), inactive lanes masked. The paged
+  variant scatters back only the ONE page each slot wrote (its private
+  boundary page — never a shared prefix page, since writes land at
+  positions ``>= p``).
+
+* **speculative verify** (``spec_k > 0``, greedy rounds only) — the host
+  drafts ``spec_k`` tokens by prompt-lookup (n-gram continuation of the
+  slot's own history; ``models/decoding.propose_ngram_drafts``) and ONE
+  forward of ``[cur_tok, d_0..d_{k-1}]`` verifies them. With greedy
+  selection the emitted stream is ``targets[:a+1]`` where ``targets`` are
+  the argmax outputs and ``a`` counts leading ``d_i == targets[i]``
+  matches: each accepted draft equals the token greedy decoding would
+  have fed, so by induction the output is TOKEN-IDENTICAL to the plain
+  path — speculation changes latency, never content. Rejected drafts
+  leave stale KV above the accepted length, which the overwrite
+  invariant below already makes unreadable.
+
+Correctness invariant for slot reuse (why freed slots are not zeroed, pad
+junk is harmless, and rejected-draft KV needs no rollback): after prefill
+the filled length is the TRUE prompt length ``p``, and a decode step at
+length ``len`` writes position ``len`` BEFORE attending keys ``0..len``
+(the cache append precedes the score einsum in ``attention_sublayer``).
+By induction every attended key was written by this request — stale rows
+sit strictly above the filled length until the step that overwrites them.
+``tests/test_serve_engine.py::test_slot_reuse_isolation`` pins this; the
+paged/spec parity matrix lives in ``tests/test_paged_kv.py``.
 
 Host/device split: the big pool buffers live on device and are DONATED
-through both programs (in-place turnover); the per-slot registers
-(lengths, current token, sampling params, budgets) are small host numpy
-arrays passed in each call — the host is the scheduler's view, the device
-never holds control state the host also needs.
+through every program (in-place turnover); the per-slot registers
+(lengths, current token, sampling params, budgets, token history for the
+drafter) are small host numpy arrays passed in each call — the host is
+the scheduler's view, the device never holds control state the host also
+needs.
 """
 
 from __future__ import annotations
@@ -48,10 +74,17 @@ import numpy as np
 from distributed_tensorflow_tpu.models.decoding import (
     decode_step,
     init_cache,
+    propose_ngram_drafts,
     sample_logits_batched,
 )
 from distributed_tensorflow_tpu.models.transformer import TransformerLM
-from distributed_tensorflow_tpu.serve.kv_pool import SlotKVPool
+from distributed_tensorflow_tpu.serve.kv_pool import (
+    TRASH_PAGE,
+    InsufficientPages,
+    PagedKVPool,
+    PrefixCache,
+    SlotKVPool,
+)
 
 __all__ = ["SlotEngine"]
 
@@ -62,8 +95,12 @@ class SlotEngine:
     Drive it with :class:`~distributed_tensorflow_tpu.serve.scheduler.
     Scheduler` (request queue + admission control) or directly:
     ``acquire_slot`` → ``start`` (prefill, returns the first token) →
-    repeated ``step`` (one ``steps_per_sync``-token batch round) →
-    ``release``. Single-threaded by contract: one thread owns the engine.
+    repeated ``step`` (one batch round; token count varies — plain rounds
+    yield ``steps_per_sync`` rows, speculative rounds up to ``spec_k+1``)
+    → ``release``. Single-threaded by contract: one thread owns the
+    engine. ``start`` raises :class:`InsufficientPages` when the paged
+    pool cannot back the request right now — release the slot and retry
+    once in-flight requests free pages.
     """
 
     def __init__(
@@ -76,6 +113,11 @@ class SlotEngine:
         prefill_len: int | None = None,
         steps_per_sync: int = 1,
         sentinel=None,
+        page_size: int | None = None,
+        kv_pages: int = 0,
+        prefix_cache: bool = True,
+        spec_k: int = 0,
+        prefill_buckets: tuple = (),
     ):
         max_len = int(max_len or cfg.max_seq_len)
         prefill_len = int(prefill_len or max(1, max_len // 2))
@@ -89,6 +131,14 @@ class SlotEngine:
             )
         if steps_per_sync < 1:
             raise ValueError(f"steps_per_sync must be >= 1, got {steps_per_sync}")
+        if page_size is None:
+            # Default to paging; degrade to one whole-row page per slot
+            # when 16 doesn't divide max_len rather than erroring.
+            page_size = 16 if max_len % 16 == 0 else max_len
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if spec_k and not page_size:
+            raise ValueError("spec_k > 0 requires the paged KV layout")
         self.cfg = cfg
         self.params = params
         self.model = TransformerLM(cfg)
@@ -96,11 +146,39 @@ class SlotEngine:
         self.max_len = max_len
         self.prefill_len = prefill_len
         self.steps_per_sync = int(steps_per_sync)
+        self.page_size = int(page_size)
+        self.paged = self.page_size > 0
+        self.spec_k = int(spec_k)
+        # Prefill width buckets (paged only): the prefill program is
+        # shape-polymorphic in its tokens width, so a FIXED set of widths
+        # is just a fixed set of compiled programs — warmup compiles every
+        # member and the zero-recompile invariant is untouched. A request
+        # whose post-adoption tail fits a narrow bucket prefills through
+        # it instead of paying the full prefill_len-wide forward; this is
+        # what turns prefix-cache hits into TTFT wins (without buckets the
+        # padded tail costs the same compute as a cold prompt). The
+        # largest bucket is always prefill_len — the cold-prompt path.
+        buckets = {int(b) for b in prefill_buckets} if self.paged else set()
+        for b in buckets:
+            if not 1 <= b <= prefill_len:
+                raise ValueError(
+                    f"prefill bucket {b} outside [1, prefill_len "
+                    f"{prefill_len}]"
+                )
+        buckets.add(prefill_len)
+        self.prefill_buckets = tuple(sorted(buckets))
         # Optional obs.perf.RecompileSentinel: fed the compile-cache size
         # after warmup and every round, it turns the zero-recompile
         # invariant into the alerting ``recompile_events_total`` metric.
         self.sentinel = sentinel
-        self.pool = SlotKVPool(cfg, self.slots, max_len)
+        if self.paged:
+            self.pool = PagedKVPool(
+                cfg, self.slots, max_len, self.page_size, kv_pages
+            )
+            self.prefix = PrefixCache(self.pool) if prefix_cache else None
+        else:
+            self.pool = SlotKVPool(cfg, self.slots, max_len)
+            self.prefix = None
 
         # Per-slot host registers. Fixed dtypes — the jit signatures (and
         # therefore the zero-recompile guarantee) depend on them.
@@ -115,104 +193,332 @@ class SlotEngine:
         self.made = np.zeros(n, np.int32)  # tokens generated so far
         self.budget = np.ones(n, np.int32)  # max_new_tokens per slot
         self.eos = np.full(n, -1, np.int32)  # -1 = no eos stop
+        # Prompt + emitted tokens per slot — the drafter's corpus. Bounded
+        # by max_len (prompt + budget <= max_len is validated at start).
+        self.history = np.zeros((n, max_len), np.int32)
+        self.hist_len = np.zeros(n, np.int32)
+        # Cumulative fast-path counters; the scheduler mirrors these into
+        # ServingMetrics (serve_prefix_hit_rate / serve_spec_accept_rate).
+        self.stats = {
+            "prefix_tokens_matched": 0,
+            "prefix_tokens_total": 0,
+            "spec_drafts_accepted": 0,
+            "spec_drafts_proposed": 0,
+            "spec_rounds": 0,
+            "plain_rounds": 0,
+        }
+        self._force_plain = False  # warmup hook: compile the non-spec path
 
         model, k_sync = self.model, self.steps_per_sync
+        ps, pps = self.page_size, getattr(self.pool, "pages_per_slot", 0)
+
+        # -- paged layout plumbing ---------------------------------------
+        # A slot's logical cache is the gather of its table row; the
+        # inverse reshape splits a logical buffer back into pages. Both
+        # are layout-generic over the cache leaf kinds (k/v rows
+        # (pages, kv, ps, dh) and int8 scales (pages, kv, ps)).
+
+        def gather_row(buf, row):
+            g = jnp.swapaxes(buf[row], 0, 1)  # (kv, pps, ps[, dh])
+            return g.reshape((g.shape[0], g.shape[1] * g.shape[2]) + g.shape[3:])
+
+        def split_pages(x):
+            # (kv, max_len[, dh]) -> (pps, kv, ps[, dh])
+            x = x.reshape((x.shape[0], pps, ps) + x.shape[2:])
+            return jnp.swapaxes(x, 0, 1)
+
+        def gather_cache(pool_layers, row, length):
+            return {
+                "layers": [
+                    {k: gather_row(v, row)[None] for k, v in l.items()}
+                    for l in pool_layers
+                ],
+                "len": length,
+            }
 
         def make_prefill(sampled: bool):
-            def prefill_fn(params, tokens, length, temp, top_k, top_p, seed):
-                """(1, prefill_len) padded prompt → (fresh (1, max_len) cache
-                layers, first sampled token). ``length`` is the true prompt
-                length (traced — heterogeneous prompts share the compile)."""
-                cache = init_cache(cfg, 1, max_len)
+            if not self.paged:
+
+                def prefill_fn(params, tokens, length, temp, top_k, top_p, seed):
+                    """(1, prefill_len) padded prompt → (fresh (1, max_len)
+                    cache layers, first sampled token). ``length`` is the
+                    true prompt length (traced — heterogeneous prompts
+                    share the compile)."""
+                    cache = init_cache(cfg, 1, max_len)
+                    logits, cache = model.apply(
+                        {"params": params}, tokens, cache=cache
+                    )
+                    last = jnp.take(logits[0], length - 1, axis=0)  # (V,)
+                    first = _select(sampled, last, temp, top_k, top_p, seed)
+                    return cache["layers"], first
+
+                return prefill_fn
+
+            def prefill_fn(
+                pool_layers, params, tokens, length, prefix_len, row,
+                temp, top_k, top_p, seed,
+            ):
+                """Tail prefill into the slot's pages. ``prefix_len`` (m0,
+                a page multiple, traced) tokens of KV are already present
+                via adopted shared pages; the forward runs the padded tail
+                at cache ``len = m0`` so positions/rotations line up, and
+                the first token is sampled at the true last prompt
+                position ``length - 1`` (tail-local index
+                ``length - m0 - 1``). The scatter-back writes EVERY page
+                in the row: adopted pages round-trip their gathered values
+                (byte-identical — the forward never writes below m0) and
+                unbound tail entries land in the trash page."""
+                cache = gather_cache(pool_layers, row, prefix_len)
                 logits, cache = model.apply(
                     {"params": params}, tokens, cache=cache
                 )
-                last = jnp.take(logits[0], length - 1, axis=0)  # (V,)
-                if sampled:
-                    key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
-                    first = sample_logits_batched(
-                        last[None], key[None], temp[None], top_k[None],
-                        top_p[None],
-                    )[0]
-                else:
-                    first = jnp.argmax(last).astype(jnp.int32)
-                return cache["layers"], first
+                last = jnp.take(logits[0], length - prefix_len - 1, axis=0)
+                first = _select(sampled, last, temp, top_k, top_p, seed)
+                new_pool = [
+                    {
+                        k: pl[k].at[row].set(split_pages(cl[k][0]))
+                        for k in pl
+                    }
+                    for pl, cl in zip(pool_layers, cache["layers"])
+                ]
+                return new_pool, first
 
             return prefill_fn
 
+        def _select(sampled, last, temp, top_k, top_p, seed):
+            if sampled:
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
+                return sample_logits_batched(
+                    last[None], key[None], temp[None], top_k[None], top_p[None]
+                )[0]
+            return jnp.argmax(last).astype(jnp.int32)
+
         def make_step(sampled: bool):
+            if not self.paged:
+
+                def step_fn(
+                    params, layers, active, lengths, tok,
+                    temp, top_k, top_p, seed, made, budget, eos,
+                ):
+                    """One engine round = ``steps_per_sync`` scanned
+                    micro-steps. Returns the new pool/registers plus
+                    ``(k, slots)`` sampled tokens and their validity mask
+                    (a slot's tokens are valid while it was active at
+                    sampling time — the final token of a finishing slot is
+                    valid, the masked lanes after it are not)."""
+
+                    def one(slot_layers, length, t):
+                        cache = {
+                            "layers": [
+                                {k: v[None] for k, v in l.items()}
+                                for l in slot_layers
+                            ],
+                            "len": length,
+                        }
+                        cache, logits = decode_step(
+                            model, params, cache, t[None, None]
+                        )
+                        out_layers = [
+                            {k: v[0] for k, v in l.items()}
+                            for l in cache["layers"]
+                        ]
+                        return out_layers, logits[0]
+
+                    def micro(carry, _):
+                        layers, active, lengths, tok, made = carry
+                        layers, logits = jax.vmap(one)(layers, lengths, tok)
+                        nxt = _pick(sampled, logits, seed, made,
+                                    temp, top_k, top_p)
+                        nxt = jnp.where(active, nxt, tok)
+                        new_lengths = jnp.where(active, lengths + 1, lengths)
+                        new_made = jnp.where(active, made + 1, made)
+                        finished = active & (
+                            (new_made >= budget) | (nxt == eos)
+                        )
+                        return (
+                            (layers, active & ~finished, new_lengths, nxt,
+                             new_made),
+                            (nxt, active),
+                        )
+
+                    carry, (toks, valid) = jax.lax.scan(
+                        micro, (layers, active, lengths, tok, made), None,
+                        length=k_sync,
+                    )
+                    layers, active, lengths, tok, made = carry
+                    return layers, active, lengths, tok, made, toks, valid
+
+                return step_fn
+
             def step_fn(
-                params, layers, active, lengths, tok,
+                pool_layers, params, ptabs, active, lengths, tok,
                 temp, top_k, top_p, seed, made, budget, eos,
             ):
-                """One engine round = ``steps_per_sync`` scanned micro-steps.
-                Returns the new pool/registers plus ``(k, slots)`` sampled
-                tokens and their validity mask (a slot's tokens are valid
-                while it was active at sampling time — the final token of a
-                finishing slot is valid, the masked lanes after it are
-                not)."""
+                """Paged decode round. Identical control flow to the
+                monolithic variant; each micro-step gathers every slot's
+                logical cache from its table row, appends one token, and
+                scatters back only the single page each slot wrote (page
+                ``length // page_size`` — always slot-private: decode
+                positions are ``>= p``, strictly above every shared full
+                prompt page). Inactive lanes scatter into the trash
+                page."""
 
-                def one(slot_layers, length, t):
-                    cache = {
-                        "layers": [
-                            {k: v[None] for k, v in l.items()}
-                            for l in slot_layers
-                        ],
-                        "len": length,
-                    }
+                def one(row, length, t):
+                    cache = gather_cache(pool_layers_ref[0], row, length)
                     cache, logits = decode_step(
                         model, params, cache, t[None, None]
                     )
-                    out_layers = [
-                        {k: v[0] for k, v in l.items()} for l in cache["layers"]
+                    wp = length // ps
+
+                    def grab(x):
+                        starts = (0, wp * ps) + (0,) * (x.ndim - 2)
+                        sizes = (x.shape[0], ps) + x.shape[2:]
+                        return jax.lax.dynamic_slice(x, starts, sizes)
+
+                    written = [
+                        {k: grab(v[0]) for k, v in l.items()}
+                        for l in cache["layers"]
                     ]
-                    return out_layers, logits[0]
+                    return written, logits[0]
+
+                pool_layers_ref = [pool_layers]
 
                 def micro(carry, _):
-                    layers, active, lengths, tok, made = carry
-                    layers, logits = jax.vmap(one)(layers, lengths, tok)
-                    if sampled:
-                        keys = jax.vmap(
-                            lambda s, m: jax.random.fold_in(
-                                jax.random.PRNGKey(s), m
-                            )
-                        )(seed, made)
-                        nxt = sample_logits_batched(
-                            logits, keys, temp, top_k, top_p
-                        )
-                    else:
-                        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                    pool_layers, active, lengths, tok, made = carry
+                    pool_layers_ref[0] = pool_layers
+                    written, logits = jax.vmap(one)(ptabs, lengths, tok)
+                    wp = lengths // ps
+                    dest = ptabs[jnp.arange(ptabs.shape[0]), wp]
+                    dest = jnp.where(active, dest, TRASH_PAGE)
+                    pool_layers = [
+                        {k: pl[k].at[dest].set(written[li][k]) for k in pl}
+                        for li, pl in enumerate(pool_layers)
+                    ]
+                    nxt = _pick(sampled, logits, seed, made,
+                                temp, top_k, top_p)
                     nxt = jnp.where(active, nxt, tok)
                     new_lengths = jnp.where(active, lengths + 1, lengths)
                     new_made = jnp.where(active, made + 1, made)
                     finished = active & ((new_made >= budget) | (nxt == eos))
                     return (
-                        (layers, active & ~finished, new_lengths, nxt,
+                        (pool_layers, active & ~finished, new_lengths, nxt,
                          new_made),
                         (nxt, active),
                     )
 
                 carry, (toks, valid) = jax.lax.scan(
-                    micro, (layers, active, lengths, tok, made), None,
+                    micro, (pool_layers, active, lengths, tok, made), None,
                     length=k_sync,
                 )
-                layers, active, lengths, tok, made = carry
-                return layers, active, lengths, tok, made, toks, valid
+                pool_layers, active, lengths, tok, made = carry
+                return pool_layers, active, lengths, tok, made, toks, valid
 
             return step_fn
 
-        # Two compiled variants of each program, host-selected per call:
-        # per-row top-k/top-p needs two full-vocab XLA sorts per micro-step
-        # (per-row cutoffs defeat lax.top_k's static k), and on CPU those
-        # sorts cost more than the whole d512 argmax step — an all-greedy
-        # round (THE common serving mix, and what the bench's sequential
-        # baseline pays: sample_logits with temperature=0 is pure argmax)
-        # must not pay them. Still a fixed program set: warmup compiles all
-        # four, and the compile-count assert covers the lot.
-        self._prefill_greedy = jax.jit(make_prefill(False))
-        self._prefill_sampled = jax.jit(make_prefill(True))
-        self._step_greedy = jax.jit(make_step(False), donate_argnums=(1,))
-        self._step_sampled = jax.jit(make_step(True), donate_argnums=(1,))
+        def _pick(sampled, logits, seed, made, temp, top_k, top_p):
+            if sampled:
+                keys = jax.vmap(
+                    lambda s, m: jax.random.fold_in(jax.random.PRNGKey(s), m)
+                )(seed, made)
+                return sample_logits_batched(
+                    logits, keys, temp, top_k, top_p
+                )
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+
+        def make_spec():
+            S = self.spec_k + 1
+
+            def spec_fn(
+                pool_layers, params, ptabs, active, lengths, tok, drafts,
+                made, budget, eos,
+            ):
+                """One speculative verify round (greedy slots only). Feeds
+                ``[cur_tok, d_0..d_{k-1}]`` (S tokens) per slot in ONE
+                forward; ``targets = argmax(logits)`` are the greedy
+                continuations after each fed token. With ``a`` = leading
+                ``d_i == targets[i]`` matches, the emitted stream is
+                ``targets[:a+1]`` — token-identical to ``a+1`` plain
+                rounds, because each accepted draft IS the token the plain
+                path would have fed next. KV for all S positions is
+                written (then truncated by moving ``lengths`` up only
+                ``n_final``): rejected rows sit above the filled length —
+                stale-until-overwritten, per the module invariant. The
+                whole table row scatters back (shared prefix pages get
+                byte-identical values; overrun past the slot's bound pages
+                lands in trash)."""
+
+                def one(row, length, t, d):
+                    cache = gather_cache(pool_layers, row, length)
+                    x = jnp.concatenate([t[None], d])[None]  # (1, S)
+                    logits, cache = model.apply(
+                        {"params": params}, x, cache=cache
+                    )
+                    targets = jnp.argmax(logits[0], -1).astype(jnp.int32)
+                    pages = [
+                        {k: split_pages(v[0]) for k, v in l.items()}
+                        for l in cache["layers"]
+                    ]
+                    return pages, targets
+
+                pages, targets = jax.vmap(one)(ptabs, lengths, tok, drafts)
+                dest = jnp.where(active[:, None], ptabs, TRASH_PAGE)
+                new_pool = [
+                    {k: pl[k].at[dest].set(pages[li][k]) for k in pl}
+                    for li, pl in enumerate(pool_layers)
+                ]
+                # Acceptance: longest matching draft prefix, then budget /
+                # eos truncation on the accepted stream.
+                match = drafts == targets[:, : S - 1]  # (slots, S-1)
+                lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
+                a = lead.sum(axis=1)  # (slots,) accepted drafts
+                n0 = a + 1  # candidate emit count
+                n1 = jnp.minimum(n0, budget - made)
+                idx = jnp.arange(S)[None, :]
+                eos_in = (targets == eos[:, None]) & (idx < n1[:, None])
+                any_eos = eos_in.any(axis=1)
+                first_eos = jnp.argmax(eos_in, axis=1)
+                n_final = jnp.where(any_eos, first_eos + 1, n1)
+                n_final = jnp.where(active, n_final, 0)
+                new_lengths = lengths + n_final
+                new_made = made + n_final
+                rows = jnp.arange(targets.shape[0])
+                last = jnp.clip(n_final - 1, 0, S - 1)
+                new_tok = jnp.where(active, targets[rows, last], tok)
+                finished = active & ((new_made >= budget) | any_eos)
+                valid = (idx < n_final[:, None]) & active[:, None]
+                accepted = jnp.where(active, jnp.minimum(a, n_final - 1), 0)
+                return (
+                    new_pool, active & ~finished, new_lengths, new_tok,
+                    new_made, targets.T, valid.T, accepted,
+                )
+
+            return spec_fn
+
+        # Compiled program set, host-selected per call. Two sampling
+        # variants of prefill and step: per-row top-k/top-p needs two
+        # full-vocab XLA sorts per micro-step (per-row cutoffs defeat
+        # lax.top_k's static k), and an all-greedy round (THE common
+        # serving mix, and what the bench's sequential baseline pays) must
+        # not pay them. Plus the speculative verify program for all-greedy
+        # rounds when spec_k > 0. Still a fixed set: warmup compiles every
+        # member, and the compile-count assert covers the lot.
+        donate = (0,) if self.paged else ()
+        self._prefill_greedy = jax.jit(
+            make_prefill(False), donate_argnums=donate
+        )
+        self._prefill_sampled = jax.jit(
+            make_prefill(True), donate_argnums=donate
+        )
+        step_donate = (0,) if self.paged else (1,)
+        self._step_greedy = jax.jit(
+            make_step(False), donate_argnums=step_donate
+        )
+        self._step_sampled = jax.jit(
+            make_step(True), donate_argnums=step_donate
+        )
+        self._spec = (
+            jax.jit(make_spec(), donate_argnums=(0,)) if self.spec_k else None
+        )
 
     # -- slot lifecycle ---------------------------------------------------
 
@@ -223,6 +529,26 @@ class SlotEngine:
     @property
     def active_count(self) -> int:
         return int(self.active.sum())
+
+    @property
+    def pages_free(self) -> int | None:
+        return self.pool.pages_free if self.paged else None
+
+    @property
+    def utilization(self) -> float:
+        """Capacity in use, in the layout's native unit: PAGE occupancy
+        under paging (the unit admission is actually gated on), slot
+        occupancy for the monolithic layout."""
+        return self.pool.occupancy
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        return self.prefix.hit_rate if self.prefix is not None else 0.0
+
+    @property
+    def spec_accept_rate(self) -> float:
+        prop = self.stats["spec_drafts_proposed"]
+        return self.stats["spec_drafts_accepted"] / prop if prop else 0.0
 
     def acquire_slot(self) -> int | None:
         return self.pool.alloc()
@@ -247,7 +573,10 @@ class SlotEngine:
 
         Returns ``(first_token, finished)``; a request that is already done
         after one token (budget 1, or the first token is its eos) comes
-        back ``finished=True`` and the caller releases the slot."""
+        back ``finished=True`` and the caller releases the slot. Under
+        paging, raises :class:`InsufficientPages` (slot untouched, no
+        references leaked) when the pool cannot back the request even
+        after evicting prefix-cache entries."""
         prompt = np.asarray(prompt, np.int32).ravel()
         p = int(prompt.size)
         if p < 1:
@@ -263,16 +592,20 @@ class SlotEngine:
                 f"prompt {p} + {max_new_tokens} new > engine max_len "
                 f"{self.max_len}"
             )
-        padded = np.zeros((1, self.prefill_len), np.int32)
-        padded[0, :p] = prompt
-        prefill = (
-            self._prefill_sampled if temperature > 0.0 else self._prefill_greedy
+        sampled = temperature > 0.0
+        prefill = self._prefill_sampled if sampled else self._prefill_greedy
+        sargs = (
+            np.float32(temperature), np.int32(top_k), np.float32(top_p),
+            np.uint32(seed),
         )
-        new_layers, first = prefill(
-            self.params, padded, np.int32(p), np.float32(temperature),
-            np.int32(top_k), np.float32(top_p), np.uint32(seed),
-        )
-        self.pool.adopt(slot, new_layers)
+        if self.paged:
+            first = self._start_paged(slot, prompt, p, max_new_tokens,
+                                      prefill, sargs)
+        else:
+            padded = np.zeros((1, self.prefill_len), np.int32)
+            padded[0, :p] = prompt
+            new_layers, first = prefill(self.params, padded, np.int32(p), *sargs)
+            self.pool.adopt(slot, new_layers)
         first = int(first)
         eos = -1 if eos_id is None else int(eos_id)
         finished = max_new_tokens == 1 or first == eos
@@ -286,34 +619,139 @@ class SlotEngine:
         self.made[slot] = 1
         self.budget[slot] = max_new_tokens
         self.eos[slot] = eos
+        if self.spec_k:
+            self.history[slot, :p] = prompt
+            self.history[slot, p] = first
+            self.hist_len[slot] = p + 1
         if self.sentinel is not None:
             self.sentinel.poll(self.compile_count())
         return first, finished
 
+    def _start_paged(self, slot, prompt, p, max_new, prefill, sargs):
+        """Page allocation + prefix adoption + tail prefill for one slot."""
+        pool, ps = self.pool, self.page_size
+        n_pages = pool.pages_needed(p, max_new)
+        # Adoption cap: the tail must keep >= 1 real token (the first-
+        # token logits come from position p-1). The per-bucket clamp below
+        # additionally keeps the tail write under max_len.
+        cap = (p - 1) // ps
+        matched = self.prefix.match(prompt, cap) if self.prefix else []
+        # Pick the narrowest compiled prefill width whose bucket holds the
+        # post-adoption tail. Per bucket, adoption is clamped so the tail
+        # write at offset m0 fits below max_len (dynamic_update_slice
+        # would CLAMP the start down and corrupt adopted rows otherwise);
+        # the largest bucket (prefill_len, clamp included) always fits
+        # since start() validated p <= prefill_len. Adopted pages beyond
+        # the clamp are returned — their content is simply recomputed by
+        # the (still narrower) tail forward.
+        for width in self.prefill_buckets:
+            m_pages = min(len(matched), (self.max_len - width) // ps)
+            if p - m_pages * ps <= width:
+                break
+        for pid in matched[m_pages:]:
+            pool.decref(pid)
+        matched = matched[:m_pages]
+        own = pool.alloc_pages(n_pages - len(matched))
+        if own is None and self.prefix is not None:
+            self.prefix.evict_for(n_pages - len(matched))
+            own = pool.alloc_pages(n_pages - len(matched))
+        if own is None:
+            for pid in matched:
+                pool.decref(pid)
+            raise InsufficientPages(
+                f"need {n_pages - len(matched)} pages, "
+                f"{pool.pages_free} free (slot {slot}, prompt {p} + "
+                f"{max_new} new @ page_size {ps})"
+            )
+        page_ids = matched + own
+        pool.bind(slot, page_ids)
+        m0 = len(matched) * ps
+        # The forward consumes only the TAIL — positions below m0 are
+        # covered by adopted pages; the padded tail lands at cache offset
+        # m0 inside the program.
+        padded = np.zeros((1, width), np.int32)
+        padded[0, : p - m0] = prompt[m0:]
+        row = np.array(pool.page_tables[slot])  # defensive copy for the jit
+        new_pool, first = prefill(
+            pool.layers, self.params, padded, np.int32(p), np.int32(m0),
+            row, *sargs,
+        )
+        pool.layers = new_pool
+        if self.prefix is not None:
+            self.prefix.record_lookup(m0, p)
+            self.prefix.insert(prompt, page_ids)
+            self.stats["prefix_tokens_matched"] = self.prefix.tokens_matched
+            self.stats["prefix_tokens_total"] = self.prefix.tokens_looked_up
+        return first
+
     def step(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """One batch round over every slot (``steps_per_sync`` tokens).
+        """One batch round over every slot.
 
         Returns ``(tokens (k, slots) int32, valid (k, slots) bool,
-        done (slots,) bool)``. ``done`` marks slots that finished during
-        this round — the caller collects their output and ``release``s
-        them, which is what lets the NEXT round admit replacements
-        (iteration-level batching)."""
+        done (slots,) bool)`` — ``k`` is ``steps_per_sync`` for plain
+        rounds and ``spec_k + 1`` for speculative rounds (callers already
+        iterate rows under the valid mask, so the burst size is opaque to
+        them). ``done`` marks slots that finished during this round — the
+        caller collects their output and ``release``s them, which is what
+        lets the NEXT round admit replacements (iteration-level
+        batching)."""
         if not self.active.any():
             raise RuntimeError("step() with no active slots")
         # The sampled program handles greedy rows correctly (via `where`),
         # so a mixed batch runs sampled; only an all-greedy batch takes the
-        # sort-free fast path.
-        step = (
-            self._step_sampled
-            if bool((self.temp[self.active] > 0.0).any())
-            else self._step_greedy
-        )
-        out = step(
-            self.params, self.pool.layers, self.active, self.lengths,
-            self.cur_tok, self.temp, self.top_k, self.top_p, self.seed,
-            self.made, self.budget, self.eos,
-        )
+        # sort-free fast path (and, when enabled, the speculative one).
+        any_sampled = bool((self.temp[self.active] > 0.0).any())
+        if (
+            self.spec_k
+            and not any_sampled
+            and not self._force_plain
+            # Verify writes S positions starting at each slot's length; a
+            # slot within spec_k+1 of max_len would clamp the write — fall
+            # back to plain rounds for that (rare, end-of-window) round.
+            and bool(
+                (self.lengths[self.active] + self.spec_k + 1
+                 <= self.max_len).all()
+            )
+        ):
+            return self._spec_round()
+        self.stats["plain_rounds"] += 1
+        step = self._step_sampled if any_sampled else self._step_greedy
+        if self.paged:
+            out = step(
+                self.pool.layers, self.params, self.pool.page_tables,
+                self.active, self.lengths, self.cur_tok, self.temp,
+                self.top_k, self.top_p, self.seed, self.made, self.budget,
+                self.eos,
+            )
+        else:
+            out = step(
+                self.params, self.pool.layers, self.active, self.lengths,
+                self.cur_tok, self.temp, self.top_k, self.top_p, self.seed,
+                self.made, self.budget, self.eos,
+            )
         layers, active, lengths, tok, made, toks, valid = out
+        return self._finish_round(layers, active, lengths, tok, made,
+                                  toks, valid)
+
+    def _spec_round(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        drafts = np.zeros((self.slots, self.spec_k), np.int32)
+        for s in np.nonzero(self.active)[0]:
+            drafts[s] = propose_ngram_drafts(
+                self.history[s, : int(self.hist_len[s])], self.spec_k
+            )
+        out = self._spec(
+            self.pool.layers, self.params, self.pool.page_tables,
+            self.active, self.lengths, self.cur_tok, drafts, self.made,
+            self.budget, self.eos,
+        )
+        layers, active, lengths, tok, made, toks, valid, accepted = out
+        self.stats["spec_rounds"] += 1
+        self.stats["spec_drafts_proposed"] += int(self.active.sum()) * self.spec_k
+        self.stats["spec_drafts_accepted"] += int(np.asarray(accepted).sum())
+        return self._finish_round(layers, active, lengths, tok, made,
+                                  toks, valid)
+
+    def _finish_round(self, layers, active, lengths, tok, made, toks, valid):
         self.pool.layers = layers
         was_active = self.active
         # np.array (copy), not np.asarray: zero-copy views of jax buffers
@@ -323,27 +761,39 @@ class SlotEngine:
         self.cur_tok = np.array(tok)
         self.made = np.array(made)
         done = was_active & ~self.active
+        toks = np.asarray(toks)
+        valid = np.asarray(valid)
+        if self.spec_k:
+            for s in np.nonzero(was_active)[0]:
+                emitted = toks[valid[:, s], s]
+                n = int(self.hist_len[s])
+                self.history[s, n : n + emitted.size] = emitted
+                self.hist_len[s] = n + emitted.size
         if self.sentinel is not None:
             self.sentinel.poll(self.compile_count())
-        return np.asarray(toks), np.asarray(valid), done
+        return toks, valid, done
 
     # -- warmup / zero-recompile accounting -------------------------------
 
     def warmup(self) -> int:
-        """Compile both programs (and the pool's adopt) on a throwaway
-        request; returns :meth:`compile_count`. Run this before taking
-        traffic — after it, the count must never grow (the serving
-        equivalent of ``__graft_entry__``'s collective-count asserts;
-        asserted under churn in ``tests/test_serve_engine.py``)."""
-        slot = self.acquire_slot()
-        if slot is None:
-            raise RuntimeError("warmup needs a free slot")
-        # Both sampling variants of both programs: greedy pass, then a
-        # temperature/top-k/top-p pass.
-        for kwargs in (
-            {"temperature": 0.0},
-            {"temperature": 1.0, "top_k": 2, "top_p": 0.9},
-        ):
+        """Compile the full program set on throwaway requests; returns
+        :meth:`compile_count`. Run this before taking traffic — after it,
+        the count must never grow (the serving equivalent of
+        ``__graft_entry__``'s collective-count asserts; asserted under
+        churn in ``tests/test_serve_engine.py``). Covers: greedy prefill +
+        PLAIN greedy step (forced even when speculation is on — the spec
+        path falls back to it near max_len), the speculative verify
+        program, and the sampled prefill/step pair."""
+        passes: list[dict] = [{"temperature": 0.0, "_plain": True}]
+        if self.spec_k:
+            passes.append({"temperature": 0.0})
+        passes.append({"temperature": 1.0, "top_k": 2, "top_p": 0.9})
+        for kwargs in passes:
+            force = kwargs.pop("_plain", False)
+            slot = self.acquire_slot()
+            if slot is None:
+                raise RuntimeError("warmup needs a free slot")
+            self._force_plain = force
             try:
                 _, finished = self.start(
                     slot, [0], max_new_tokens=2, seed=0, **kwargs
@@ -353,9 +803,29 @@ class SlotEngine:
                         self.step()
                     self.active[slot] = False
             finally:
+                self._force_plain = False
                 self.release(slot)
-            slot = self.acquire_slot()
-        self.release(slot)
+        # The passes above prefilled through the SMALLEST bucket (p=1);
+        # compile the remaining widths too — a length-b throwaway prompt
+        # forces bucket b exactly, and max_new=1 finishes at start() so
+        # only the prefill programs are exercised.
+        for width in self.prefill_buckets[1:]:
+            p_warm = min(width, self.max_len - 1)
+            for kwargs in ({}, {"temperature": 1.0, "top_k": 2}):
+                slot = self.acquire_slot()
+                try:
+                    self.start(slot, [0] * p_warm, max_new_tokens=1,
+                               seed=0, **kwargs)
+                finally:
+                    self.release(slot)
+        if self.prefix is not None:
+            # Warmup's throwaway prompts must not linger as adoptable
+            # prefixes (or skew the hit-rate counters).
+            self.prefix.clear()
+            self.prefix.tokens_matched = 0
+            self.prefix.tokens_looked_up = 0
+            self.stats["prefix_tokens_matched"] = 0
+            self.stats["prefix_tokens_total"] = 0
         n = self.compile_count()
         if self.sentinel is not None:
             # Sync the poll base to the warmed cache size, then draw the
@@ -368,9 +838,11 @@ class SlotEngine:
     def compile_count(self) -> int:
         """Total compiled programs across the engine's jitted callables —
         stable after :meth:`warmup` or something is shape-unstable."""
+        fns = [self._prefill_greedy, self._prefill_sampled,
+               self._step_greedy, self._step_sampled]
+        if self._spec is not None:
+            fns.append(self._spec)
         own = sum(
-            f._cache_size() if hasattr(f, "_cache_size") else 0
-            for f in (self._prefill_greedy, self._prefill_sampled,
-                      self._step_greedy, self._step_sampled)
+            f._cache_size() if hasattr(f, "_cache_size") else 0 for f in fns
         )
         return own + self.pool.compile_count()

@@ -1,32 +1,66 @@
-"""Slot-pooled KV cache: fixed device buffers, in-place slot turnover.
+"""KV storage for the serving engine: slot-pooled and block-paged layouts.
 
-One allocation for the engine's lifetime: per-layer K/V buffers shaped
-``(slots, kv_heads, max_len, head_dim)`` (plus per-row f32 scales under the
-int8-KV config), built on ``models/decoding.init_cache`` so every cache
-layout the model family supports — GQA's unexpanded kv heads, int8 rows —
-pools identically. Admitting a request never allocates: the prefilled
-(1, …) cache is scattered into its slot with ``.at[slot].set`` inside a
-jitted, buffer-donating program, so XLA aliases the pool in place (the
-vLLM lesson: cheap admission is what makes token-granularity scheduling
-worth doing). ``slot`` is a traced scalar — one compile covers every slot.
+Two pool classes share the engine-facing bookkeeping contract
+(``alloc``/``free``/``num_free``/``occupancy``):
 
-Freeing is a host-side bookkeeping pop: a freed slot's stale K/V rows are
-NOT zeroed on the hot path. That is safe by the same invariant the decode
-step relies on (``engine.py``): prefill rewrites positions ``[0, p)`` and
-sets the filled length to ``p``, and every decode step writes position
-``len`` BEFORE attending keys ``0..len`` — stale rows above the filled
-length are overwritten before they are ever readable. ``reset`` exists for
-hygiene/debugging, not correctness.
+* :class:`SlotKVPool` — the PR-4 monolithic layout: per-layer K/V buffers
+  shaped ``(slots, kv_heads, max_len, head_dim)``, one worst-case row per
+  slot. Kept as the ``page_size=0`` engine mode and the parity baseline.
+
+* :class:`PagedKVPool` — the vLLM PagedAttention layout: ONE physical pool
+  of fixed-size pages per layer, shaped ``(num_pages, kv_heads, page_size,
+  head_dim)``, plus a host-side per-slot page table ``(slots,
+  pages_per_slot)`` of physical page ids. A slot's logical ``(kv, max_len,
+  dh)`` cache is the gather of its table row; capacity is PAGES-free, not
+  slots-free, so short requests stop reserving worst-case HBM and the same
+  pool admits more concurrent requests. Physical page 0 is a reserved
+  TRASH page: unbound table entries point at it, masked/inactive lanes
+  scatter into it, and nothing ever reads it — which is what lets every
+  jitted program keep fixed shapes (full-width table rows, full-width
+  scatters) with zero recompiles.
+
+Pages are REFCOUNTED so immutable full-prompt pages can be shared between
+slots (and held by the :class:`PrefixCache`): a slot's allocation holds one
+reference, prefix adoption adds one per adopting slot, and the cache holds
+one of its own. A page returns to the free list only at refcount zero.
+Safety of sharing rests on the same overwrite invariant the monolithic
+layout relies on (see ``engine.py``): decode writes start at the filled
+length ``p`` (strictly above every full prompt page), so a shared page is
+written only with byte-identical content (the prefill program's
+whole-row scatter-back, which round-trips the gathered values).
+
+The :class:`PrefixCache` keys pages by the EXACT BYTES of the token prefix
+they complete (not a hash digest), so a lookup can never adopt a colliding
+request's KV; entries are LRU-evicted when the pool runs out of pages.
 """
 
 from __future__ import annotations
+
+from collections import OrderedDict
 
 import jax
 import numpy as np
 
 from distributed_tensorflow_tpu.models.decoding import init_cache
 
-__all__ = ["SlotKVPool"]
+__all__ = [
+    "SlotKVPool",
+    "PagedKVPool",
+    "PrefixCache",
+    "InsufficientPages",
+    "TRASH_PAGE",
+]
+
+# Physical page 0: never allocated, never freed, absorbs the fixed-shape
+# scatters of unbound table entries and masked lanes. Never read.
+TRASH_PAGE = 0
+
+
+class InsufficientPages(RuntimeError):
+    """Admission-time: the pool cannot back this request right now. The
+    scheduler requeues the request at the head of its lane — pages free as
+    in-flight requests complete, so progress is guaranteed (every active
+    request holds ALL its pages up front; nothing allocates mid-decode)."""
 
 
 class SlotKVPool:
@@ -49,8 +83,11 @@ class SlotKVPool:
         self.max_len = int(max_len)
         self.layers = init_cache(cfg, slots, max_len)["layers"]
         # LIFO reuse: the most recently freed slot's buffers are the most
-        # likely to still be resident in any cache hierarchy.
+        # likely to still be resident in any cache hierarchy. The companion
+        # set keeps free/double-free checks O(1) under high churn (the old
+        # `slot in list` scan was O(slots) per free).
         self._free: list[int] = list(range(slots - 1, -1, -1))
+        self._free_set: set[int] = set(self._free)
 
         def adopt_fn(layers, slot, new_layers):
             # new_layers leaves are (1, kv, max_len, dh) — a single-request
@@ -78,16 +115,33 @@ class SlotKVPool:
     def occupancy(self) -> float:
         return 1.0 - len(self._free) / self.slots
 
+    @property
+    def hbm_bytes(self) -> int:
+        return sum(
+            buf.size * buf.dtype.itemsize
+            for layer in self.layers
+            for buf in layer.values()
+        )
+
+    @property
+    def hbm_bytes_per_slot(self) -> float:
+        return self.hbm_bytes / self.slots
+
     def alloc(self) -> int | None:
         """Claim a slot index, or None when the pool is full."""
-        return self._free.pop() if self._free else None
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._free_set.discard(slot)
+        return slot
 
     def free(self, slot: int) -> None:
         if not 0 <= slot < self.slots:
             raise ValueError(f"slot {slot} outside [0, {self.slots})")
-        if slot in self._free:
+        if slot in self._free_set:
             raise ValueError(f"double free of slot {slot}")
         self._free.append(slot)
+        self._free_set.add(slot)
 
     # -- jitted in-place mutators ----------------------------------------
 
@@ -106,3 +160,252 @@ class SlotKVPool:
             f._cache_size() if hasattr(f, "_cache_size") else 0
             for f in (self._adopt, self._reset)
         )
+
+
+class PagedKVPool:
+    """Block-granular physical KV pool + per-slot page tables.
+
+    Pure host bookkeeping plus the big device buffers — every jitted
+    mutation (prefill scatter, decode page write-back) lives in the
+    engine's programs, which take ``layers`` (donated) and a table row /
+    the full table as traced operands. ``page_tables`` is host numpy so
+    the scheduler's view of capacity never needs a device sync.
+    """
+
+    def __init__(self, cfg, slots: int, max_len: int, page_size: int,
+                 num_pages: int = 0):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if max_len % page_size:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of page_size "
+                f"{page_size} (fixed-shape table rows need a whole number "
+                f"of pages per slot)"
+            )
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.page_size = int(page_size)
+        self.pages_per_slot = max_len // page_size
+        if num_pages == 0:
+            # Default: worst case for every slot + the trash page — paging
+            # with no oversubscription. Sizing BELOW this is the point:
+            # short requests only claim what they use, so the same HBM
+            # admits more concurrent requests.
+            num_pages = self.slots * self.pages_per_slot + 1
+        if num_pages < self.pages_per_slot + 1:
+            raise ValueError(
+                f"num_pages {num_pages} cannot back even one worst-case "
+                f"request ({self.pages_per_slot} pages) + the trash page"
+            )
+        self.num_pages = int(num_pages)
+        # One allocation for the pool's lifetime: init_cache with
+        # batch=num_pages, len=page_size IS the paged layout — every cache
+        # variant the model family supports (GQA kv heads, int8 rows with
+        # f32 scales) pages identically.
+        self.layers = init_cache(cfg, self.num_pages, page_size)["layers"]
+        # Page 0 is TRASH (reserved, refcount pinned). LIFO free list with
+        # an O(1) companion set, same discipline as the slot pool.
+        self._free_pages: list[int] = list(range(self.num_pages - 1, 0, -1))
+        self._free_page_set: set[int] = set(self._free_pages)
+        self.refcount = np.zeros(self.num_pages, np.int64)
+        self.refcount[TRASH_PAGE] = 1  # pinned — never allocatable
+        self.page_tables = np.full(
+            (self.slots, self.pages_per_slot), TRASH_PAGE, np.int32
+        )
+        self._free_slots: list[int] = list(range(slots - 1, -1, -1))
+        self._free_slot_set: set[int] = set(self._free_slots)
+
+    # -- capacity views ---------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        """Free SLOT count (engine lane capacity; pages gate separately)."""
+        return len(self._free_slots)
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def pages_allocatable(self) -> int:
+        return self.num_pages - 1  # minus trash
+
+    @property
+    def occupancy(self) -> float:
+        """PAGE occupancy — under paging, capacity is pages-free."""
+        return 1.0 - self.pages_free / self.pages_allocatable
+
+    @property
+    def hbm_bytes(self) -> int:
+        return sum(
+            buf.size * buf.dtype.itemsize
+            for layer in self.layers
+            for buf in layer.values()
+        )
+
+    @property
+    def hbm_bytes_per_slot(self) -> float:
+        return self.hbm_bytes / self.slots
+
+    def pages_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        total = prompt_len + max_new_tokens
+        return -(-total // self.page_size)  # ceil
+
+    # -- slot bookkeeping --------------------------------------------------
+
+    def alloc(self) -> int | None:
+        if not self._free_slots:
+            return None
+        slot = self._free_slots.pop()
+        self._free_slot_set.discard(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Release a slot AND its page references. The table row resets to
+        TRASH so a later (masked) lane write can never land in a page that
+        has been handed to another slot — the stale-page-table hazard."""
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        if slot in self._free_slot_set:
+            raise ValueError(f"double free of slot {slot}")
+        for pid in self.page_tables[slot]:
+            if pid != TRASH_PAGE:
+                self.decref(int(pid))
+        self.page_tables[slot, :] = TRASH_PAGE
+        self._free_slots.append(slot)
+        self._free_slot_set.add(slot)
+
+    # -- page bookkeeping --------------------------------------------------
+
+    def alloc_pages(self, n: int) -> list[int] | None:
+        """Claim ``n`` physical pages (refcount 1 each), or None if the
+        free list is short — the caller may evict prefix-cache entries and
+        retry. All-or-nothing: no partial claims to unwind."""
+        if n > len(self._free_pages):
+            return None
+        pages = [self._free_pages.pop() for _ in range(n)]
+        for pid in pages:
+            self._free_page_set.discard(pid)
+            self.refcount[pid] = 1
+        return pages
+
+    def incref(self, pid: int) -> None:
+        if pid == TRASH_PAGE or not 0 < pid < self.num_pages:
+            raise ValueError(f"incref of invalid page {pid}")
+        if pid in self._free_page_set:
+            raise ValueError(f"incref of free page {pid}")
+        self.refcount[pid] += 1
+
+    def decref(self, pid: int) -> None:
+        if pid == TRASH_PAGE or not 0 < pid < self.num_pages:
+            raise ValueError(f"decref of invalid page {pid}")
+        if pid in self._free_page_set:
+            raise ValueError(f"double free of page {pid}")
+        self.refcount[pid] -= 1
+        if self.refcount[pid] == 0:
+            self._free_pages.append(pid)
+            self._free_page_set.add(pid)
+
+    def bind(self, slot: int, page_ids: list[int]) -> None:
+        """Point ``slot``'s table at ``page_ids`` (prefix-adopted pages
+        first, then the slot's own); unbound tail entries stay TRASH."""
+        if len(page_ids) > self.pages_per_slot:
+            raise ValueError(
+                f"{len(page_ids)} pages > pages_per_slot {self.pages_per_slot}"
+            )
+        self.page_tables[slot, :] = TRASH_PAGE
+        self.page_tables[slot, : len(page_ids)] = np.asarray(
+            page_ids, np.int32
+        )
+
+    def compile_count(self) -> int:
+        return 0  # all jitted programs live in the engine
+
+
+class PrefixCache:
+    """Exact-prefix index over immutable full pages, refcounted + LRU.
+
+    Key: the raw bytes of the token prefix a page COMPLETES (int32,
+    little-endian) — exact matching, so adopting a cached page can never
+    splice a colliding request's KV (a digest could). Value: the physical
+    page id. The cache holds its own reference on every indexed page;
+    ``match`` adds one per adopting slot, ``evict_for`` drops LRU entries
+    (cache reference only — pages still referenced by live slots survive
+    until those slots free)."""
+
+    def __init__(self, pool: PagedKVPool):
+        self.pool = pool
+        self._entries: OrderedDict[bytes, int] = OrderedDict()
+        # Cumulative token counters (the serve_prefix_hit_rate feed).
+        self.tokens_matched = 0
+        self.tokens_looked_up = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @staticmethod
+    def _key(prompt: np.ndarray, n_pages: int, page_size: int) -> bytes:
+        return prompt[: n_pages * page_size].astype("<i4").tobytes()
+
+    def match(self, prompt: np.ndarray, max_pages: int) -> list[int]:
+        """Longest chain of cached full pages covering a prefix of
+        ``prompt`` (at most ``max_pages``). Each matched page is increffed
+        for the adopting slot; entries touch LRU-recency."""
+        ps = self.pool.page_size
+        pages: list[int] = []
+        for i in range(1, max_pages + 1):
+            key = self._key(prompt, i, ps)
+            pid = self._entries.get(key)
+            if pid is None:
+                break
+            self._entries.move_to_end(key)
+            pages.append(pid)
+        for pid in pages:
+            self.pool.incref(pid)
+        return pages
+
+    def record_lookup(self, matched_tokens: int, prompt_tokens: int) -> None:
+        self.tokens_matched += matched_tokens
+        self.tokens_looked_up += prompt_tokens
+
+    @property
+    def hit_rate(self) -> float:
+        if self.tokens_looked_up == 0:
+            return 0.0
+        return self.tokens_matched / self.tokens_looked_up
+
+    def insert(self, prompt: np.ndarray, page_ids) -> None:
+        """Index ``prompt``'s full pages (``page_ids[i]`` backs page ``i``).
+        Already-indexed prefixes keep their existing (shared) page."""
+        ps = self.pool.page_size
+        n_full = min(len(page_ids), len(prompt) // ps)
+        for i in range(n_full):
+            key = self._key(prompt, i + 1, ps)
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                continue
+            pid = int(page_ids[i])
+            self.pool.incref(pid)
+            self._entries[key] = pid
+
+    def evict_for(self, pages_wanted: int) -> int:
+        """Drop LRU entries until the pool could satisfy ``pages_wanted``
+        (or the cache is empty). Returns entries evicted. Only the cache's
+        own reference drops — a page shared with a live slot stays
+        resident and simply leaves the index."""
+        evicted = 0
+        while (self.pool.pages_free < pages_wanted) and self._entries:
+            _, pid = self._entries.popitem(last=False)
+            self.pool.decref(pid)
+            evicted += 1
+        return evicted
+
+    def clear(self) -> None:
+        while self._entries:
+            _, pid = self._entries.popitem(last=False)
+            self.pool.decref(pid)

@@ -87,7 +87,40 @@ class ServingMetrics:
             "serve_lane_depth_current",
             "Queued requests per priority lane, last seen at submit.",
             labels=("lane",))
+        # Decode fast-path instruments (paged KV / prefix cache /
+        # speculative decoding). Counters carry the raw totals; the rate
+        # gauges are derived at sync time so scrapers (fleet router,
+        # loadgen reports, bench gates) read a ready 0..1 value.
+        self._prefix_matched = r.counter(
+            "serve_prefix_tokens_matched_total",
+            "Prompt tokens whose KV was adopted from the prefix cache.")
+        self._prefix_total = r.counter(
+            "serve_prefix_tokens_total",
+            "Prompt tokens offered to prefix-cache lookup.")
+        self._spec_accepted = r.counter(
+            "serve_spec_drafts_accepted_total",
+            "Drafted tokens accepted by the speculative verify step.")
+        self._spec_proposed = r.counter(
+            "serve_spec_drafts_proposed_total",
+            "Drafted tokens proposed to the speculative verify step.")
+        self._prefix_hit_rate = r.gauge(
+            "serve_prefix_hit_rate",
+            "Cumulative fraction of prompt tokens served from the prefix "
+            "cache (adopted pages / prompt tokens).")
+        self._spec_accept_rate = r.gauge(
+            "serve_spec_accept_rate",
+            "Cumulative fraction of speculative drafts accepted.")
+        self._pages_free = r.gauge(
+            "serve_kv_pages_free_current",
+            "Free physical KV pages (paged layout; 0 when monolithic).")
+        self._page_occupancy = r.gauge(
+            "serve_kv_page_occupancy_current",
+            "Fraction of allocatable KV pages in use (paged layout).")
+        self._hbm_per_slot = r.gauge(
+            "serve_hbm_bytes_per_slot",
+            "KV pool device bytes divided by slot count.")
         self._peak_lock = threading.Lock()
+        self._last_engine_stats: dict = {}
 
     # -- recording (scheduler hot path) -----------------------------------
 
@@ -121,6 +154,33 @@ class ServingMetrics:
     def record_shed(self) -> None:
         self._shed.inc()
 
+    def sync_engine(self, engine) -> None:
+        """Mirror the engine's cumulative fast-path stats into registry
+        instruments (called once per scheduler round). Counters advance by
+        delta against the last sync; rate gauges are recomputed from the
+        cumulative totals; pool gauges are point-in-time."""
+        stats = getattr(engine, "stats", None)
+        if not stats:
+            return
+        for key, counter in (
+            ("prefix_tokens_matched", self._prefix_matched),
+            ("prefix_tokens_total", self._prefix_total),
+            ("spec_drafts_accepted", self._spec_accepted),
+            ("spec_drafts_proposed", self._spec_proposed),
+        ):
+            delta = int(stats[key]) - self._last_engine_stats.get(key, 0)
+            if delta > 0:
+                counter.inc(delta)
+                self._last_engine_stats[key] = int(stats[key])
+        self._prefix_hit_rate.set(float(engine.prefix_hit_rate))
+        self._spec_accept_rate.set(float(engine.spec_accept_rate))
+        pool = getattr(engine, "pool", None)
+        if getattr(engine, "paged", False) and pool is not None:
+            self._pages_free.set(float(pool.pages_free))
+            self._page_occupancy.set(float(pool.occupancy))
+        if pool is not None and hasattr(pool, "hbm_bytes_per_slot"):
+            self._hbm_per_slot.set(float(pool.hbm_bytes_per_slot))
+
     # -- counter readout (kept as plain ints for callers/tests) ------------
 
     @property
@@ -139,6 +199,14 @@ class ServingMetrics:
     def queue_depth_peak(self) -> int:
         return int(self._queue_depth_peak.value)
 
+    @property
+    def prefix_hit_rate(self) -> float:
+        return float(self._prefix_hit_rate.value)
+
+    @property
+    def spec_accept_rate(self) -> float:
+        return float(self._spec_accept_rate.value)
+
     # -- readout ----------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -156,6 +224,10 @@ class ServingMetrics:
             "slot_occupancy": self.occupancy.summary(),
             "ttft_ms": ms(self.ttft),
             "per_token_ms": ms(self.per_token),
+            "prefix_hit_rate": self.prefix_hit_rate,
+            "spec_accept_rate": self.spec_accept_rate,
+            "kv_pages_free": self._pages_free.value,
+            "hbm_bytes_per_slot": self._hbm_per_slot.value,
         }
 
     def publish(self, writer, step: int) -> None:

@@ -68,6 +68,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from distributed_tensorflow_tpu.serve.engine import SlotEngine
+from distributed_tensorflow_tpu.serve.kv_pool import InsufficientPages
 
 __all__ = [
     "Request",
@@ -234,6 +235,30 @@ class _FairQueue:
             self._rings[lane].append(cid)
             self._deficits[lane][cid] = 0.0
         qs[cid].append(pending)
+        self._len += 1
+
+    def push_front(self, pending: PendingRequest) -> None:
+        """Requeue at the HEAD of its client's deque and move the client
+        to the front of the lane's service ring (with enough deficit to be
+        served immediately). Used when admission popped a request the
+        paged pool cannot back yet — the request keeps its place instead
+        of paying the fairness rotation twice. The one-step ring bias this
+        introduces is bounded: at most one requeue per admission attempt,
+        and the request it favors is the one that was already chosen."""
+        lane = pending.request.priority
+        cid = pending.request.client_id
+        qs = self._queues[lane]
+        ring = self._rings[lane]
+        defs = self._deficits[lane]
+        if cid not in qs:
+            qs[cid] = deque()
+            defs[cid] = 0.0
+            ring.appendleft(cid)
+        else:
+            ring.remove(cid)
+            ring.appendleft(cid)
+        defs[cid] = max(defs[cid], 1.0)
+        qs[cid].appendleft(pending)
         self._len += 1
 
     def _drop_client(self, lane: int, cid: str) -> None:
@@ -413,8 +438,11 @@ class Scheduler:
         self._shed_expired(now)
         self._admit(now)
         if self.metrics is not None:
-            self.metrics.record_occupancy(1.0 - self.engine.free_slots
-                                          / self.engine.slots)
+            # Occupancy in the engine's native capacity unit: PAGE
+            # occupancy under the paged layout (what admission actually
+            # gates on), slot occupancy for the monolithic layout.
+            self.metrics.record_occupancy(self.engine.utilization)
+            self.metrics.sync_engine(self.engine)
         if self.engine.active_count == 0:
             return 0
         t0 = self.clock()
@@ -473,6 +501,16 @@ class Scheduler:
                     temperature=r.temperature, top_k=r.top_k,
                     top_p=r.top_p, seed=r.seed, eos_id=r.eos_id,
                 )
+            except InsufficientPages:
+                # Not an error: the paged pool is the real capacity gate
+                # and it's full right now. Put the request back at the
+                # head of its lane and stop admitting this round — pages
+                # free as in-flight requests complete, and every request
+                # holds all its pages up front, so progress is guaranteed.
+                self.engine.release(slot)
+                with self._lock:
+                    self._queue.push_front(pending)
+                return
             except Exception as exc:  # _validate should prevent this
                 self.engine.release(slot)
                 pending.finish(Rejection(r.request_id, "invalid", str(exc)))

@@ -195,6 +195,13 @@ def make_server(
                     "queue_depth": scheduler.queue_depth,
                     "draining": bool(getattr(scheduler, "draining", False)),
                 }
+                if getattr(scheduler.engine, "paged", False):
+                    # Page capacity is the real admission gate under the
+                    # paged KV layout — routers dispatching on free_slots
+                    # alone would overfill an oversubscribed pool.
+                    pool = scheduler.engine.pool
+                    body["pages_free"] = pool.pages_free
+                    body["pages_total"] = pool.pages_allocatable
                 drain_fn = getattr(scheduler, "drain_remaining_s", None)
                 remaining = drain_fn() if drain_fn is not None else None
                 if remaining is not None:

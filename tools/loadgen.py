@@ -203,11 +203,17 @@ def _sched_submit(scheduler, payload, timeout_s, acct):
 
 
 def _scrape_health(url, server):
-    """(slo_status_dict | None, recompile_events_total | None) from a live
-    target: HTTP mode scrapes ``/slo.json`` + ``/metrics`` (Prometheus
-    text); self-serve mode reads the in-process monitor/sentinel that
-    ``serve_lm.build_stack`` hung on the server object. Never raises — a
-    server without the endpoints just yields nulls."""
+    """(slo_status_dict | None, recompile_events_total | None,
+    fastpath_rates dict) from a live target: HTTP mode scrapes
+    ``/slo.json`` + ``/metrics`` (Prometheus text); self-serve mode reads
+    the in-process monitor/sentinel/metrics that ``serve_lm.build_stack``
+    hung on the server object. The fastpath dict carries the decode
+    fast-path gauges (``serve_prefix_hit_rate`` /
+    ``serve_spec_accept_rate``) so prefix-cache and speculation
+    effectiveness are visible end to end — including through the fleet
+    router. Never raises — a server without the endpoints just yields
+    nulls."""
+    fastpath = {"prefix_hit_rate": None, "spec_accept_rate": None}
     if url:
         import urllib.request
 
@@ -228,11 +234,15 @@ def _scrape_health(url, server):
             for sample in parse_prometheus_text(text):
                 if sample["name"] == "recompile_events_total":
                     recompiles = int(sample["value"])
+                elif sample["name"] == "serve_prefix_hit_rate":
+                    fastpath["prefix_hit_rate"] = float(sample["value"])
+                elif sample["name"] == "serve_spec_accept_rate":
+                    fastpath["spec_accept_rate"] = float(sample["value"])
         except Exception:
             pass
-        return slo, recompiles
+        return slo, recompiles, fastpath
     if server is None:
-        return None, None
+        return None, None, fastpath
     slo = None
     monitor = getattr(server, "slo_monitor", None)
     if monitor is not None:
@@ -240,7 +250,11 @@ def _scrape_health(url, server):
         slo["enabled"] = True
     sentinel = getattr(server, "sentinel", None)
     recompiles = sentinel.post_warm_total if sentinel is not None else None
-    return slo, recompiles
+    metrics = getattr(server, "serving_metrics", None)
+    if metrics is not None:
+        fastpath["prefix_hit_rate"] = float(metrics.prefix_hit_rate)
+        fastpath["spec_accept_rate"] = float(metrics.spec_accept_rate)
+    return slo, recompiles, fastpath
 
 
 def run_load(
@@ -339,23 +353,56 @@ def main(argv=None):
              "line (bench.py's BENCH_LAST.json convention — appended, so "
              "serving-latency trends accumulate across runs; '' disables)",
     )
+    parser.add_argument(
+        "--prefix_groups", type=int, default=0,
+        help="shared-prefix workload: N groups of requests, each group "
+        "sharing a long common prompt prefix (~3/4 of prompt_len) with "
+        "per-request random tails — the traffic shape the prefix cache "
+        "serves; 0 = fully random prompts",
+    )
     # Self-serve engine shape (ignored with --url).
     parser.add_argument("--slots", type=int, default=4)
     parser.add_argument("--seq_len", type=int, default=64)
     parser.add_argument("--steps_per_sync", type=int, default=1)
+    parser.add_argument(
+        "--page_size", type=int, default=-1,
+        help="self-serve KV page size (-1 auto, 0 monolithic)",
+    )
+    parser.add_argument(
+        "--spec_k", type=int, default=4,
+        help="self-serve speculative drafts per verify round (0 = off)",
+    )
     args, _ = parser.parse_known_args(argv)
 
     import random
 
     rng = random.Random(args.seed)
 
+    group_prefixes = []
+    if args.prefix_groups > 0:
+        # The shared prefix must span whole KV pages to be adoptable, so
+        # make it the bulk of the prompt; tails stay heterogeneous.
+        plen = max(1, (args.prompt_len * 3) // 4)
+        group_prefixes = [
+            [rng.randint(0, 255) for _ in range(plen)]
+            for _ in range(args.prefix_groups)
+        ]
+
     def make_payload(i):
         # Heterogeneous prompt/output lengths: the serving engine's whole
         # point is that this mix shares one compiled program.
-        p = rng.randint(1, max(1, args.prompt_len))
         n = rng.randint(1, max(1, args.max_new_tokens))
+        if group_prefixes:
+            prefix = group_prefixes[i % len(group_prefixes)]
+            tail_max = max(1, args.prompt_len - len(prefix))
+            tail = [rng.randint(0, 255)
+                    for _ in range(rng.randint(1, tail_max))]
+            prompt = prefix + tail
+        else:
+            p = rng.randint(1, max(1, args.prompt_len))
+            prompt = [rng.randint(0, 255) for _ in range(p)]
         payload = {
-            "prompt": [rng.randint(0, 255) for _ in range(p)],
+            "prompt": prompt,
             "max_new_tokens": n,
             "temperature": args.temperature,
             "seed": i,
@@ -402,6 +449,8 @@ def main(argv=None):
             serve_max_len=args.seq_len,
             prefill_len=max(args.prompt_len, args.seq_len // 2),
             steps_per_sync=args.steps_per_sync,
+            page_size=args.page_size,
+            spec_k=args.spec_k,
         )
         engine, scheduler, metrics, server = build_stack(serve_cfg, cfg, params)
         server.server_close()  # wiring only — loadgen submits directly
@@ -421,7 +470,7 @@ def main(argv=None):
     # Scrape server health BEFORE teardown so the report record is
     # self-describing: was the server SLO-degraded during this run, and did
     # the engine recompile after warmup (it must not)?
-    slo_status, recompiles = _scrape_health(
+    slo_status, recompiles, fastpath = _scrape_health(
         targets[0] if targets else "", server)
     if scheduler is not None:
         scheduler.stop()
@@ -445,6 +494,9 @@ def main(argv=None):
         "mode": "open" if args.rate > 0 else "closed",
         "slo": slo_status,
         "recompile_events_total": recompiles,
+        "prefix_groups": args.prefix_groups,
+        "serve_prefix_hit_rate": fastpath["prefix_hit_rate"],
+        "serve_spec_accept_rate": fastpath["spec_accept_rate"],
         "t_wall": time.time(),
         "concurrency": args.concurrency,
         "rate": args.rate,

@@ -79,6 +79,10 @@ def build_stack(serve_cfg, cfg, params):
         prefill_len=serve_cfg.prefill_len or None,
         steps_per_sync=serve_cfg.steps_per_sync,
         sentinel=sentinel,
+        page_size=getattr(serve_cfg, "engine_page_size", None),
+        kv_pages=getattr(serve_cfg, "kv_pages", 0),
+        prefix_cache=getattr(serve_cfg, "prefix_cache", True),
+        spec_k=getattr(serve_cfg, "spec_k", 0),
     )
     engine.warmup()
     scheduler = Scheduler(
@@ -103,6 +107,7 @@ def build_stack(serve_cfg, cfg, params):
     )
     server.slo_monitor = slo_monitor
     server.sentinel = sentinel
+    server.serving_metrics = metrics
     return engine, scheduler, metrics, server
 
 
@@ -174,10 +179,17 @@ def main(argv=None):
 
     engine, scheduler, metrics, server = build_stack(serve_cfg, cfg, params)
     host, port = server.server_address
+    kv_desc = (
+        f"paged(page_size={engine.page_size} pages={engine.pool.num_pages} "
+        f"prefix={'on' if engine.prefix is not None else 'off'} "
+        f"spec_k={engine.spec_k})"
+        if engine.paged
+        else "monolithic"
+    )
     print(
         f"serving on http://{host}:{port}  slots={engine.slots} "
         f"max_len={engine.max_len} prefill_len={engine.prefill_len} "
-        f"compiled={engine.compile_count()}",
+        f"kv={kv_desc} compiled={engine.compile_count()}",
         flush=True,
     )
 
