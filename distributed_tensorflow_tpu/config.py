@@ -505,6 +505,35 @@ class ServeConfig:
             "decoding — this only changes latency"
         },
     )
+    prefill_chunk_tokens: int = field(
+        default=0,
+        metadata={
+            "help": "chunked-prefill budget per engine iteration (paged "
+            "layout): prompts whose tail exceeds this width prefill in "
+            "chunks interleaved with decode steps, so prompts beyond "
+            "prefill_len are admissible and long prefills never stall "
+            "co-resident decodes. 0 = auto (prefill_len), -1 = off "
+            "(prefill_len stays a hard prompt cap)"
+        },
+    )
+    draft_model: str = field(
+        default="",
+        metadata={
+            "help": "path to a tools/train_draft.py bundle: a small "
+            "distilled draft LM replacing the n-gram drafter for "
+            "spec_k rounds (greedy output stays token-identical — a "
+            "better drafter only raises the accept rate). Empty = "
+            "n-gram prompt-lookup drafting"
+        },
+    )
+    draft_window: int = field(
+        default=16,
+        metadata={
+            "help": "history suffix (tokens) the draft model conditions "
+            "on per round; clamped to the draft bundle's max_seq_len "
+            "minus spec_k"
+        },
+    )
 
     @property
     def lane_weight_tuple(self) -> tuple:

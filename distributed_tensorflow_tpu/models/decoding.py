@@ -21,8 +21,11 @@ from distributed_tensorflow_tpu.models.transformer import TransformerConfig, Tra
 
 __all__ = [
     "init_cache",
+    "build_draft_fn",
     "build_generate_fn",
     "decode_step",
+    "init_draft_params",
+    "make_draft_config",
     "propose_ngram_drafts",
     "sample_logits",
     "sample_logits_batched",
@@ -177,6 +180,113 @@ def propose_ngram_drafts(history, k: int, ngram: int = 2):
                 if cont.size:
                     draft[: cont.size] = cont
                     return draft
+    return draft
+
+
+def make_draft_config(cfg: TransformerConfig, num_layers: int,
+                      max_seq_len: int | None = None) -> TransformerConfig:
+    """The draft model is the target truncated to its first ``num_layers``
+    blocks — same widths, same vocab, same embeddings shapes, so
+    :func:`init_draft_params` can seed it straight from the target tree
+    and the serving engine can share tokenization/eos handling."""
+    import dataclasses
+
+    if not 1 <= num_layers <= cfg.num_layers:
+        raise ValueError(
+            f"draft num_layers {num_layers} outside [1, {cfg.num_layers}]"
+        )
+    return dataclasses.replace(
+        cfg,
+        num_layers=num_layers,
+        max_seq_len=max_seq_len or cfg.max_seq_len,
+    )
+
+
+def init_draft_params(cfg: TransformerConfig, target_params,
+                      num_layers: int):
+    """Seed a truncated-layer draft head from the target: the embeddings
+    (``tok_embed``/``pos_embed``) are SHARED (and stay frozen under
+    ``tools/train_draft.py``'s distillation — the draft reads the target's
+    representation space), the first ``num_layers`` blocks plus ``ln_f``
+    and ``lm_head`` start as copies and get trained. Returns a plain dict
+    tree compatible with ``TransformerLM(make_draft_config(...))``."""
+    draft = {}
+    for name, sub in target_params.items():
+        if name.startswith("block_"):
+            if int(name.split("_", 1)[1]) < num_layers:
+                draft[name] = sub
+        else:
+            draft[name] = sub
+    return jax.tree_util.tree_map(lambda x: x, draft)
+
+
+def build_draft_fn(cfg: TransformerConfig, k: int, window: int):
+    """Returns ``draft(params, tokens (B, window) int32, lens (B,) int32,
+    pos0 (B,) int32) -> (B, k) int32`` — the serving engine's learned
+    drafter program.
+
+    Each row is the right-aligned-then-left-packed suffix of a slot's
+    history (``tokens[i, :lens[i]]`` real, rest pad; the last real token
+    is the slot's current token). Per row: one causal forward of the
+    window into a fresh ``window + k`` cache, greedy-pick at ``lens - 1``
+    (pad positions never attended — causality keeps position ``j < lens``
+    clean), then rewind the cache length to ``lens`` and roll ``k - 1``
+    cached greedy steps; the rolls overwrite the pad junk the window
+    forward wrote above ``lens`` (write-before-attend makes the stale rows
+    unreadable until then).
+
+    ``pos0`` is the ABSOLUTE sequence position of ``tokens[i, 0]``
+    (``history_len - lens`` at the engine): the drafter shares the
+    target's embeddings, so it must read the same ``pos_embed`` rows (or
+    RoPE rotations) the target applies at those positions — the window is
+    an attention-context truncation, never a position shift. Training
+    (``tools/train_draft.py``) distills with the same absolute-position
+    windows. Positions are clamped to ``max_seq_len - 1`` so over-budget
+    tail drafts (discarded by the verify anyway) cannot gather out of
+    range."""
+    if k < 1:
+        raise ValueError(f"spec k must be >= 1, got {k}")
+    if window < 1:
+        raise ValueError(f"draft window must be >= 1, got {window}")
+    if window + k > cfg.max_seq_len:
+        raise ValueError(
+            f"window {window} + k {k} > draft max_seq_len {cfg.max_seq_len}"
+        )
+    model = TransformerLM(cfg)
+    pmax = cfg.max_seq_len - 1
+
+    def draft(params, tokens, lens, pos0):
+        def one(toks, ln, p0):
+            cache = init_cache(cfg, 1, window + k)
+            positions = jnp.minimum(
+                p0 + jnp.arange(window, dtype=jnp.int32), pmax
+            )
+            logits, cache = model.apply({"params": params}, toks[None],
+                                        cache=cache,
+                                        positions=positions[None])
+            t0 = jnp.argmax(
+                jnp.take(logits[0], ln - 1, axis=0)
+            ).astype(jnp.int32)
+            cache = {**cache, "len": ln.astype(jnp.int32)}
+
+            def roll(carry, _):
+                cache, tok = carry
+                pos = jnp.minimum(p0 + cache["len"], pmax)
+                lg, cache = model.apply(
+                    {"params": params}, tok[None, None], cache=cache,
+                    positions=pos[None, None].astype(jnp.int32),
+                )
+                nxt = jnp.argmax(lg[0, -1]).astype(jnp.int32)
+                return (cache, nxt), tok
+
+            if k == 1:
+                return t0[None]
+            (_, last), emitted = jax.lax.scan(roll, (cache, t0), None,
+                                              length=k - 1)
+            return jnp.concatenate([emitted, last[None]])
+
+        return jax.vmap(one)(tokens, lens, pos0)
+
     return draft
 
 

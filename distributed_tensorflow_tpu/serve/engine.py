@@ -47,6 +47,31 @@ the compile count must never grow — the ``RecompileSentinel`` contract):
   leave stale KV above the accepted length, which the overwrite
   invariant below already makes unreadable.
 
+* **chunked prefill** (``prefill_chunk_tokens > 0``, paged only) — a
+  prompt whose post-adoption tail exceeds the chunk width is fed across
+  ENGINE ITERATIONS instead of one monolithic forward: full-width
+  intermediate chunks through the SAME compiled bucket programs (their
+  sampled token is discarded), then one suffix-aligned final chunk whose
+  fed window ends exactly at position ``p-1`` so the first token is
+  sampled at the true last prompt position. The slot sits in a
+  ``PREFILLING`` phase meanwhile (``start`` returns ``(None, False)``)
+  and co-resident decode slots keep stepping every iteration —
+  Sarathi-style stall-free batching. Because a chunk at offset ``m``
+  writes positions ``[m, m+w)`` BEFORE any later chunk attends them
+  (write-before-attend, below), resuming at ``len = m`` across separate
+  program invocations is exactly as correct as the one-shot tail
+  forward. No new programs: chunk calls reuse the bucket set, so the
+  zero-recompile contract is untouched.
+
+Drafting (``spec_k > 0``) comes in two flavors behind the same verify:
+the zero-weight n-gram prompt-lookup drafter (default), or a LEARNED
+draft model (``draft_params``/``draft_cfg``: a truncated-layer head
+distilled from the target by ``tools/train_draft.py``) that greedily
+rolls ``spec_k`` tokens from a ``draft_window``-token suffix of the
+slot's history in one jitted program. Draft quality only moves the
+accept rate — the verify forward makes greedy output token-identical
+either way.
+
 Correctness invariant for slot reuse (why freed slots are not zeroed, pad
 junk is harmless, and rejected-draft KV needs no rollback): after prefill
 the filled length is the TRUE prompt length ``p``, and a decode step at
@@ -55,7 +80,8 @@ length ``len`` writes position ``len`` BEFORE attending keys ``0..len``
 By induction every attended key was written by this request — stale rows
 sit strictly above the filled length until the step that overwrites them.
 ``tests/test_serve_engine.py::test_slot_reuse_isolation`` pins this; the
-paged/spec parity matrix lives in ``tests/test_paged_kv.py``.
+paged/spec parity matrix lives in ``tests/test_paged_kv.py``, the
+chunked-prefill parity matrix in ``tests/test_serve_chunked.py``.
 
 Host/device split: the big pool buffers live on device and are DONATED
 through every program (in-place turnover); the per-slot registers
@@ -67,11 +93,14 @@ needs.
 
 from __future__ import annotations
 
+from collections import deque
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from distributed_tensorflow_tpu.models.decoding import (
+    build_draft_fn,
     decode_step,
     init_cache,
     propose_ngram_drafts,
@@ -118,6 +147,10 @@ class SlotEngine:
         prefix_cache: bool = True,
         spec_k: int = 0,
         prefill_buckets: tuple = (),
+        prefill_chunk_tokens: int = 0,
+        draft_params=None,
+        draft_cfg=None,
+        draft_window: int = 16,
     ):
         max_len = int(max_len or cfg.max_seq_len)
         prefill_len = int(prefill_len or max(1, max_len // 2))
@@ -166,7 +199,58 @@ class SlotEngine:
                     f"{prefill_len}]"
                 )
         buckets.add(prefill_len)
+        # Chunked prefill (paged only): 0 = auto (chunk width =
+        # prefill_len, i.e. prompts up to prefill_len keep the one-shot
+        # path byte-for-byte and only LONGER prompts chunk), -1 = off
+        # (prefill_len stays a hard prompt cap, the pre-chunking
+        # contract). Widths above the chunk are pruned from the bucket
+        # set — the one-shot path never sees a tail wider than the chunk
+        # once chunking is on, so they would be dead compiled programs.
+        c = int(prefill_chunk_tokens)
+        if self.paged and c >= 0:
+            if c == 0:
+                c = prefill_len
+            if not 1 <= c <= prefill_len:
+                raise ValueError(
+                    f"prefill_chunk_tokens {c} outside [1, prefill_len "
+                    f"{prefill_len}]"
+                )
+            buckets = {b for b in buckets if b <= c}
+            buckets.add(c)
+        else:
+            c = -1
+        self.prefill_chunk_tokens = c
         self.prefill_buckets = tuple(sorted(buckets))
+        # Learned drafter (optional): a small draft LM rolled greedily for
+        # spec_k tokens from a draft_window-token suffix of each slot's
+        # history — one jitted program, compiled at warmup alongside the
+        # verify. Replaces the host n-gram drafter when provided; the
+        # verify loop (and therefore token-identical greedy output) is
+        # unchanged either way.
+        if draft_params is not None:
+            if not self.spec_k:
+                raise ValueError("draft_params requires spec_k > 0")
+            if draft_cfg is None:
+                raise ValueError("draft_params requires draft_cfg")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}"
+                )
+            # The draft cache holds window + spec_k positions; clamp the
+            # window so it fits the draft model's trained length.
+            draft_window = min(
+                int(draft_window), draft_cfg.max_seq_len - self.spec_k
+            )
+            if draft_window < 1:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} too short "
+                    f"for spec_k {self.spec_k}"
+                )
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
+        self.draft_window = int(draft_window)
+        self.drafter = "model" if draft_params is not None else "ngram"
         # Optional obs.perf.RecompileSentinel: fed the compile-cache size
         # after warmup and every round, it turns the zero-recompile
         # invariant into the alerting ``recompile_events_total`` metric.
@@ -197,15 +281,29 @@ class SlotEngine:
         # by max_len (prompt + budget <= max_len is validated at start).
         self.history = np.zeros((n, max_len), np.int32)
         self.hist_len = np.zeros(n, np.int32)
+        # PREFILLING phase state: slots mid-chunked-prefill are neither
+        # free nor active. _pf holds each one's chunk plan; _pf_queue is
+        # the round-robin order chunks are spent in.
+        self.prefilling = np.zeros(n, bool)
+        self._pf: dict[int, dict] = {}
+        self._pf_queue: deque[int] = deque()
         # Cumulative fast-path counters; the scheduler mirrors these into
         # ServingMetrics (serve_prefix_hit_rate / serve_spec_accept_rate).
+        # The aggregate spec keys stay (pre-drafter dashboards); the
+        # per-drafter keys feed the drafter-labeled /metrics counters.
         self.stats = {
             "prefix_tokens_matched": 0,
             "prefix_tokens_total": 0,
             "spec_drafts_accepted": 0,
             "spec_drafts_proposed": 0,
+            "spec_drafts_accepted_ngram": 0,
+            "spec_drafts_proposed_ngram": 0,
+            "spec_drafts_accepted_model": 0,
+            "spec_drafts_proposed_model": 0,
             "spec_rounds": 0,
             "plain_rounds": 0,
+            "prefill_chunks": 0,
+            "prefill_tokens_last_iter": 0,
         }
         self._force_plain = False  # warmup hook: compile the non-spec path
 
@@ -519,6 +617,11 @@ class SlotEngine:
         self._spec = (
             jax.jit(make_spec(), donate_argnums=(0,)) if self.spec_k else None
         )
+        self._draft = (
+            jax.jit(build_draft_fn(draft_cfg, self.spec_k, self.draft_window))
+            if self.draft_params is not None
+            else None
+        )
 
     # -- slot lifecycle ---------------------------------------------------
 
@@ -529,6 +632,20 @@ class SlotEngine:
     @property
     def active_count(self) -> int:
         return int(self.active.sum())
+
+    @property
+    def prefilling_count(self) -> int:
+        return int(self.prefilling.sum())
+
+    @property
+    def max_prompt_len(self) -> int:
+        """Longest admissible prompt: ``prefill_len`` is the hard cap only
+        when chunked prefill is off; with it on, any prompt that leaves
+        room for one generated token fits (p + max_new <= max_len is
+        validated separately)."""
+        if self.paged and self.prefill_chunk_tokens > 0:
+            return self.max_len - 1
+        return self.prefill_len
 
     @property
     def pages_free(self) -> int | None:
@@ -550,11 +667,23 @@ class SlotEngine:
         prop = self.stats["spec_drafts_proposed"]
         return self.stats["spec_drafts_accepted"] / prop if prop else 0.0
 
+    def spec_accept_rate_for(self, drafter: str) -> float:
+        prop = self.stats[f"spec_drafts_proposed_{drafter}"]
+        acc = self.stats[f"spec_drafts_accepted_{drafter}"]
+        return acc / prop if prop else 0.0
+
     def acquire_slot(self) -> int | None:
         return self.pool.alloc()
 
     def release(self, slot: int) -> None:
         self.active[slot] = False
+        if self.prefilling[slot]:
+            self.prefilling[slot] = False
+            self._pf.pop(slot, None)
+            try:
+                self._pf_queue.remove(slot)
+            except ValueError:
+                pass
         self.pool.free(slot)
 
     def start(
@@ -568,7 +697,7 @@ class SlotEngine:
         top_p: float = 0.0,
         seed: int = 0,
         eos_id: int | None = None,
-    ) -> tuple[int, bool]:
+    ) -> tuple[int | None, bool]:
         """Prefill ``prompt`` into ``slot`` and sample its first token.
 
         Returns ``(first_token, finished)``; a request that is already done
@@ -576,14 +705,23 @@ class SlotEngine:
         back ``finished=True`` and the caller releases the slot. Under
         paging, raises :class:`InsufficientPages` (slot untouched, no
         references leaked) when the pool cannot back the request even
-        after evicting prefix-cache entries."""
+        after evicting prefix-cache entries.
+
+        When the post-adoption tail exceeds the chunk width (possible only
+        with chunked prefill enabled), no forward runs here: the slot
+        enters the PREFILLING phase, ``(None, False)`` is returned, and
+        the first token surfaces from a later :meth:`step` once the final
+        chunk lands (its row precedes that round's decode rows)."""
         prompt = np.asarray(prompt, np.int32).ravel()
         p = int(prompt.size)
         if p < 1:
             raise ValueError("prompt must contain at least one token")
-        if p > self.prefill_len:
+        if p > self.max_prompt_len:
             raise ValueError(
                 f"prompt length {p} > engine prefill_len {self.prefill_len}"
+                if self.max_prompt_len == self.prefill_len
+                else f"prompt length {p} > engine max prompt "
+                     f"{self.max_prompt_len}"
             )
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -598,27 +736,41 @@ class SlotEngine:
             np.float32(temperature), np.int32(top_k), np.float32(top_p),
             np.uint32(seed),
         )
+        eos = -1 if eos_id is None else int(eos_id)
         if self.paged:
             first = self._start_paged(slot, prompt, p, max_new_tokens,
-                                      prefill, sargs)
+                                      prefill, sargs, sampled)
         else:
             padded = np.zeros((1, self.prefill_len), np.int32)
             padded[0, :p] = prompt
             new_layers, first = prefill(self.params, padded, np.int32(p), *sargs)
             self.pool.adopt(slot, new_layers)
-        first = int(first)
-        eos = -1 if eos_id is None else int(eos_id)
-        finished = max_new_tokens == 1 or first == eos
-        self.active[slot] = not finished
-        self.lengths[slot] = p
-        self.cur_tok[slot] = first
+        # Registers shared by both outcomes (immediate first token vs
+        # PREFILLING): sampling params and limits are fixed at admission.
         self.temp[slot] = temperature
         self.top_k[slot] = top_k
         self.top_p[slot] = top_p
         self.seed[slot] = np.uint32(seed & 0xFFFFFFFF)
-        self.made[slot] = 1
         self.budget[slot] = max_new_tokens
         self.eos[slot] = eos
+        if first is None:
+            # Chunked path scheduled by _start_paged; pages are all bound,
+            # chunks spend across subsequent step() calls.
+            self.active[slot] = False
+            self.lengths[slot] = 0
+            self.made[slot] = 0
+            if self.spec_k:
+                self.history[slot, :p] = prompt
+                self.hist_len[slot] = p
+            if self.sentinel is not None:
+                self.sentinel.poll(self.compile_count())
+            return None, False
+        first = int(first)
+        finished = max_new_tokens == 1 or first == eos
+        self.active[slot] = not finished
+        self.lengths[slot] = p
+        self.cur_tok[slot] = first
+        self.made[slot] = 1
         if self.spec_k:
             self.history[slot, :p] = prompt
             self.history[slot, p] = first
@@ -627,8 +779,10 @@ class SlotEngine:
             self.sentinel.poll(self.compile_count())
         return first, finished
 
-    def _start_paged(self, slot, prompt, p, max_new, prefill, sargs):
-        """Page allocation + prefix adoption + tail prefill for one slot."""
+    def _start_paged(self, slot, prompt, p, max_new, prefill, sargs, sampled):
+        """Page allocation + prefix adoption + tail prefill for one slot.
+        Returns the first token, or ``None`` when the tail exceeds every
+        bucket and a chunked-prefill plan was scheduled instead."""
         pool, ps = self.pool, self.page_size
         n_pages = pool.pages_needed(p, max_new)
         # Adoption cap: the tail must keep >= 1 real token (the first-
@@ -640,14 +794,22 @@ class SlotEngine:
         # post-adoption tail. Per bucket, adoption is clamped so the tail
         # write at offset m0 fits below max_len (dynamic_update_slice
         # would CLAMP the start down and corrupt adopted rows otherwise);
-        # the largest bucket (prefill_len, clamp included) always fits
-        # since start() validated p <= prefill_len. Adopted pages beyond
-        # the clamp are returned — their content is simply recomputed by
-        # the (still narrower) tail forward.
+        # with chunking off the largest bucket (prefill_len, clamp
+        # included) always fits since start() validated p <= prefill_len.
+        # Adopted pages beyond the clamp are returned — their content is
+        # simply recomputed by the (still narrower) tail forward. A tail
+        # wider than every bucket (a long prompt under chunked prefill)
+        # falls through to the chunk planner.
+        m_pages = 0
+        fits = False
         for width in self.prefill_buckets:
             m_pages = min(len(matched), (self.max_len - width) // ps)
             if p - m_pages * ps <= width:
+                fits = True
                 break
+        if not fits:
+            return self._start_chunked(slot, prompt, p, max_new, sargs,
+                                       sampled, matched)
         for pid in matched[m_pages:]:
             pool.decref(pid)
         matched = matched[:m_pages]
@@ -684,6 +846,132 @@ class SlotEngine:
             self.stats["prefix_tokens_total"] = self.prefix.tokens_looked_up
         return first
 
+    def _start_chunked(self, slot, prompt, p, max_new, sargs, sampled,
+                       matched):
+        """Bind every page up front and plan the chunk schedule; no
+        forward runs here. The plan is a list of ``(offset, width,
+        is_final)`` bucket-program calls: full-chunk-width intermediates
+        (sampled token discarded), then ONE suffix-aligned final chunk —
+        its fed window ends at position ``p-1`` so the first-token logits
+        come from the true last prompt position, with no padding anywhere.
+
+        Adoption is capped so the post-adoption remainder strictly
+        exceeds the chunk width: that forces >= 1 intermediate chunk,
+        which keeps the final chunk's window start ``p - w`` strictly
+        above the adopted boundary — the final forward only ever REwrites
+        the slot's own pages (overlap recompute is deterministic and
+        write-before-attend makes it safe), never a shared prefix page."""
+        pool, ps, c = self.pool, self.page_size, self.prefill_chunk_tokens
+        n_pages = pool.pages_needed(p, max_new)
+        a = min(len(matched), max(0, (p - c - 1) // ps))
+        for pid in matched[a:]:
+            pool.decref(pid)
+        matched = matched[:a]
+        own = pool.alloc_pages(n_pages - len(matched))
+        if own is None and self.prefix is not None:
+            self.prefix.evict_for(n_pages - len(matched))
+            own = pool.alloc_pages(n_pages - len(matched))
+        if own is None:
+            for pid in matched:
+                pool.decref(pid)
+            raise InsufficientPages(
+                f"need {n_pages - len(matched)} pages, "
+                f"{pool.pages_free} free (slot {slot}, prompt {p} + "
+                f"{max_new} new @ page_size {ps}, chunked)"
+            )
+        page_ids = matched + own
+        pool.bind(slot, page_ids)
+        m0 = len(matched) * ps
+        chunks = []
+        m = m0
+        while p - m > c:
+            chunks.append((m, c, False))
+            m += c
+        r = p - m  # 1 <= r <= c: the suffix the final chunk must cover
+        w = next(b for b in self.prefill_buckets if b >= r)
+        chunks.append((p - w, w, True))
+        self._pf[slot] = {
+            "slot": slot, "prompt": prompt, "p": p, "chunks": chunks,
+            "idx": 0, "sampled": sampled, "sargs": sargs,
+            "page_ids": page_ids, "m0": m0,
+        }
+        self.prefilling[slot] = True
+        self._pf_queue.append(slot)
+        if self.prefix is not None:
+            self.prefix.record_lookup(m0, p)
+            self.stats["prefix_tokens_matched"] = self.prefix.tokens_matched
+            self.stats["prefix_tokens_total"] = self.prefix.tokens_looked_up
+        return None
+
+    def _advance_prefill(self):
+        """Spend up to ``prefill_chunk_tokens`` of prefill this iteration
+        (always >= 1 chunk when any slot is PREFILLING — forward progress
+        is unconditional), round-robin across prefilling slots. Returns
+        ``(events, spent)`` where events are ``(slot, first_token,
+        finished)`` for slots whose FINAL chunk landed this call."""
+        events = []
+        spent = 0
+        chunks_run = 0
+        budget = self.prefill_chunk_tokens
+        while self._pf_queue:
+            slot = self._pf_queue[0]
+            st = self._pf[slot]
+            m, w, final = st["chunks"][st["idx"]]
+            if spent and spent + w > budget:
+                break
+            first = self._run_chunk(st, m, w, final)
+            spent += w
+            chunks_run += 1
+            st["idx"] += 1
+            if final:
+                self._pf_queue.popleft()
+                del self._pf[slot]
+                self.prefilling[slot] = False
+                prompt, p = st["prompt"], st["p"]
+                eos = int(self.eos[slot])
+                finished = int(self.budget[slot]) == 1 or first == eos
+                self.active[slot] = not finished
+                self.lengths[slot] = p
+                self.cur_tok[slot] = first
+                self.made[slot] = 1
+                if self.spec_k:
+                    self.history[slot, p] = first
+                    self.hist_len[slot] = p + 1
+                if self.prefix is not None:
+                    # Pages only become adoptable once every position is
+                    # filled — insert at completion, not at start().
+                    self.prefix.insert(prompt, st["page_ids"])
+                events.append((slot, first, finished))
+            else:
+                self._pf_queue.rotate(-1)
+        self.stats["prefill_chunks"] += chunks_run
+        self.stats["prefill_tokens_last_iter"] = spent
+        return events, spent
+
+    def _run_chunk(self, st, m, w, final):
+        """One bucket-program call of the chunk plan for one slot: ``w``
+        REAL tokens at offset ``m`` (cache resumes at len = m; the
+        program's scatter-back writes every page in the row, where pages
+        below the chunk round-trip their gathered values). Intermediate
+        chunks discard the sampled token; the final chunk's is the
+        request's first token."""
+        pool = self.pool
+        prompt, p = st["prompt"], st["p"]
+        toks = np.ascontiguousarray(prompt[m : m + w][None])
+        row = np.array(pool.page_tables[st["slot"]])
+        prefill = (
+            self._prefill_sampled
+            if final and st["sampled"]
+            else self._prefill_greedy
+        )
+        length = np.int32(p if final else m + w)
+        new_pool, first = prefill(
+            pool.layers, self.params, toks, length, np.int32(m), row,
+            *st["sargs"],
+        )
+        pool.layers = new_pool
+        return int(first) if final else None
+
     def step(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """One batch round over every slot.
 
@@ -694,9 +982,38 @@ class SlotEngine:
         them). ``done`` marks slots that finished during this round — the
         caller collects their output and ``release``s them, which is what
         lets the NEXT round admit replacements (iteration-level
-        batching)."""
-        if not self.active.any():
+        batching).
+
+        With chunked prefill in flight, each call first spends one
+        iteration's prefill budget (PREFILLING slots advance one or more
+        chunks), then runs the normal decode round over the ACTIVE slots
+        — long prefills never stall co-resident decodes. A slot whose
+        final chunk lands this call contributes its first token as one
+        extra LEADING row and joins the same call's decode round."""
+        if not self.active.any() and not self.prefilling.any():
             raise RuntimeError("step() with no active slots")
+        pre_events, _ = self._advance_prefill()
+        if self.active.any():
+            toks, valid, done = self._decode_round()
+        else:
+            toks = np.zeros((0, self.slots), np.int32)
+            valid = np.zeros((0, self.slots), bool)
+            done = np.zeros(self.slots, bool)
+            if self.sentinel is not None:
+                self.sentinel.poll(self.compile_count())
+        if pre_events:
+            row_t = np.zeros((1, self.slots), np.int32)
+            row_v = np.zeros((1, self.slots), bool)
+            for slot, first, finished in pre_events:
+                row_t[0, slot] = first
+                row_v[0, slot] = True
+                if finished:
+                    done[slot] = True
+            toks = np.concatenate([row_t, toks])
+            valid = np.concatenate([row_v, valid])
+        return toks, valid, done
+
+    def _decode_round(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         # The sampled program handles greedy rows correctly (via `where`),
         # so a mixed batch runs sampled; only an all-greedy batch takes the
         # sort-free fast path (and, when enabled, the speculative one).
@@ -734,22 +1051,51 @@ class SlotEngine:
                                   toks, valid)
 
     def _spec_round(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        drafts = np.zeros((self.slots, self.spec_k), np.int32)
-        for s in np.nonzero(self.active)[0]:
-            drafts[s] = propose_ngram_drafts(
-                self.history[s, : int(self.hist_len[s])], self.spec_k
-            )
+        drafts = self._propose_drafts()
         out = self._spec(
             self.pool.layers, self.params, self.pool.page_tables,
             self.active, self.lengths, self.cur_tok, drafts, self.made,
             self.budget, self.eos,
         )
         layers, active, lengths, tok, made, toks, valid, accepted = out
+        proposed = int(self.active.sum()) * self.spec_k
+        accepted_n = int(np.asarray(accepted).sum())
         self.stats["spec_rounds"] += 1
-        self.stats["spec_drafts_proposed"] += int(self.active.sum()) * self.spec_k
-        self.stats["spec_drafts_accepted"] += int(np.asarray(accepted).sum())
+        self.stats["spec_drafts_proposed"] += proposed
+        self.stats["spec_drafts_accepted"] += accepted_n
+        self.stats[f"spec_drafts_proposed_{self.drafter}"] += proposed
+        self.stats[f"spec_drafts_accepted_{self.drafter}"] += accepted_n
         return self._finish_round(layers, active, lengths, tok, made,
                                   toks, valid)
+
+    def _propose_drafts(self) -> np.ndarray:
+        """(slots, spec_k) draft tokens for the active lanes: the learned
+        draft model when loaded (one jitted call over every lane — the
+        cur_tok is the LAST history entry, so the draft's first output is
+        its prediction for the token after it), else the host n-gram
+        prompt-lookup fallback. Inactive lanes draft from a length-1 dummy
+        window; the verify masks them out."""
+        drafts = np.zeros((self.slots, self.spec_k), np.int32)
+        if self._draft is not None:
+            W = self.draft_window
+            toks = np.zeros((self.slots, W), np.int32)
+            lens = np.ones(self.slots, np.int32)
+            pos0 = np.zeros(self.slots, np.int32)
+            for s in np.nonzero(self.active)[0]:
+                n = int(self.hist_len[s])
+                l = min(n, W)
+                toks[s, :l] = self.history[s, n - l : n]
+                lens[s] = max(l, 1)
+                # Absolute position of toks[s, 0]: the drafter reads the
+                # target's own pos_embed/RoPE at the true offsets.
+                pos0[s] = n - l
+            return np.asarray(
+                self._draft(self.draft_params, toks, lens, pos0))
+        for s in np.nonzero(self.active)[0]:
+            drafts[s] = propose_ngram_drafts(
+                self.history[s, : int(self.hist_len[s])], self.spec_k
+            )
+        return drafts
 
     def _finish_round(self, layers, active, lengths, tok, made, toks, valid):
         self.pool.layers = layers
@@ -783,7 +1129,11 @@ class SlotEngine:
         churn in ``tests/test_serve_engine.py``). Covers: greedy prefill +
         PLAIN greedy step (forced even when speculation is on — the spec
         path falls back to it near max_len), the speculative verify
-        program, and the sampled prefill/step pair."""
+        program (which also compiles the learned-draft program when one
+        is loaded), the sampled prefill/step pair, every prefill bucket
+        width, and — when chunked prefill can trigger — one chunked
+        prompt driven to completion (chunk calls reuse the bucket
+        programs, so this compiles nothing new; it asserts that)."""
         passes: list[dict] = [{"temperature": 0.0, "_plain": True}]
         if self.spec_k:
             passes.append({"temperature": 0.0})
@@ -818,6 +1168,19 @@ class SlotEngine:
                                seed=0, **kwargs)
                 finally:
                     self.release(slot)
+        if self.paged and 0 < self.prefill_chunk_tokens < self.max_len - 1:
+            # One chunked prompt per sampling variant, driven through
+            # step() to completion (budget 1 finishes at the final chunk).
+            p_long = min(self.prefill_chunk_tokens + 1, self.max_len - 1)
+            for kwargs in ({}, {"temperature": 1.0, "top_k": 2}):
+                slot = self.acquire_slot()
+                try:
+                    self.start(slot, [0] * p_long, max_new_tokens=1,
+                               seed=0, **kwargs)
+                    while self.prefilling[slot]:
+                        self.step()
+                finally:
+                    self.release(slot)
         if self.prefix is not None:
             # Warmup's throwaway prompts must not linger as adoptable
             # prefixes (or skew the hit-rate counters).
@@ -842,6 +1205,8 @@ class SlotEngine:
                self._step_greedy, self._step_sampled]
         if self._spec is not None:
             fns.append(self._spec)
+        if self._draft is not None:
+            fns.append(self._draft)
         own = sum(
             f._cache_size() if hasattr(f, "_cache_size") else 0 for f in fns
         )

@@ -256,6 +256,16 @@ class PagedKVPool:
         total = prompt_len + max_new_tokens
         return -(-total // self.page_size)  # ceil
 
+    def pages_bound(self, slot: int) -> int:
+        """Pages currently bound in ``slot``'s table row (TRASH excluded)
+        — the accounting view multi-iteration (chunked) prefill is audited
+        against: admission must bind exactly ``pages_needed(p, n)`` pages
+        up front (adopted + owned), and ``free()`` must return every
+        non-shared one."""
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        return int(np.count_nonzero(self.page_tables[slot] != TRASH_PAGE))
+
     # -- slot bookkeeping --------------------------------------------------
 
     def alloc(self) -> int | None:

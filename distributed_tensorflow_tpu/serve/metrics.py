@@ -97,12 +97,20 @@ class ServingMetrics:
         self._prefix_total = r.counter(
             "serve_prefix_tokens_total",
             "Prompt tokens offered to prefix-cache lookup.")
+        # Labeled by drafter ("ngram" | "model") so a fleet can compare
+        # acceptance between the zero-weight fallback and the learned
+        # draft head from one scrape.
         self._spec_accepted = r.counter(
             "serve_spec_drafts_accepted_total",
-            "Drafted tokens accepted by the speculative verify step.")
+            "Drafted tokens accepted by the speculative verify step.",
+            labels=("drafter",))
         self._spec_proposed = r.counter(
             "serve_spec_drafts_proposed_total",
-            "Drafted tokens proposed to the speculative verify step.")
+            "Drafted tokens proposed to the speculative verify step.",
+            labels=("drafter",))
+        self._prefill_chunks = r.counter(
+            "serve_prefill_chunks_total",
+            "Prefill chunks executed (chunked-prefill path only).")
         self._prefix_hit_rate = r.gauge(
             "serve_prefix_hit_rate",
             "Cumulative fraction of prompt tokens served from the prefix "
@@ -110,6 +118,17 @@ class ServingMetrics:
         self._spec_accept_rate = r.gauge(
             "serve_spec_accept_rate",
             "Cumulative fraction of speculative drafts accepted.")
+        self._spec_accept_rate_by = r.gauge(
+            "serve_spec_accept_rate_by_drafter",
+            "Cumulative speculative accept fraction, per drafter.",
+            labels=("drafter",))
+        self._prefill_budget = r.gauge(
+            "serve_prefill_tokens_budget",
+            "Per-iteration prefill token budget (chunk width; -1 = "
+            "chunking off).")
+        self._prefill_last_iter = r.gauge(
+            "serve_prefill_tokens_last_iter",
+            "Prefill tokens actually spent in the engine's last step.")
         self._pages_free = r.gauge(
             "serve_kv_pages_free_current",
             "Free physical KV pages (paged layout; 0 when monolithic).")
@@ -165,15 +184,33 @@ class ServingMetrics:
         for key, counter in (
             ("prefix_tokens_matched", self._prefix_matched),
             ("prefix_tokens_total", self._prefix_total),
-            ("spec_drafts_accepted", self._spec_accepted),
-            ("spec_drafts_proposed", self._spec_proposed),
+            ("prefill_chunks", self._prefill_chunks),
         ):
-            delta = int(stats[key]) - self._last_engine_stats.get(key, 0)
+            delta = int(stats.get(key, 0)) - self._last_engine_stats.get(
+                key, 0)
             if delta > 0:
                 counter.inc(delta)
                 self._last_engine_stats[key] = int(stats[key])
+        for drafter in ("ngram", "model"):
+            for suffix, family in (
+                ("accepted", self._spec_accepted),
+                ("proposed", self._spec_proposed),
+            ):
+                key = f"spec_drafts_{suffix}_{drafter}"
+                delta = (int(stats.get(key, 0))
+                         - self._last_engine_stats.get(key, 0))
+                if delta > 0:
+                    family.labels(drafter=drafter).inc(delta)
+                    self._last_engine_stats[key] = int(stats[key])
+            if hasattr(engine, "spec_accept_rate_for"):
+                self._spec_accept_rate_by.labels(drafter=drafter).set(
+                    float(engine.spec_accept_rate_for(drafter)))
         self._prefix_hit_rate.set(float(engine.prefix_hit_rate))
         self._spec_accept_rate.set(float(engine.spec_accept_rate))
+        self._prefill_budget.set(
+            float(getattr(engine, "prefill_chunk_tokens", -1)))
+        self._prefill_last_iter.set(
+            float(stats.get("prefill_tokens_last_iter", 0)))
         pool = getattr(engine, "pool", None)
         if getattr(engine, "paged", False) and pool is not None:
             self._pages_free.set(float(pool.pages_free))
@@ -226,6 +263,12 @@ class ServingMetrics:
             "per_token_ms": ms(self.per_token),
             "prefix_hit_rate": self.prefix_hit_rate,
             "spec_accept_rate": self.spec_accept_rate,
+            "spec_accept_rate_by_drafter": {
+                d: float(self._spec_accept_rate_by.labels(drafter=d).value)
+                for d in ("ngram", "model")
+            },
+            "prefill_chunks": int(self._prefill_chunks.value),
+            "prefill_tokens_budget": self._prefill_budget.value,
             "kv_pages_free": self._pages_free.value,
             "hbm_bytes_per_slot": self._hbm_per_slot.value,
         }

@@ -410,8 +410,12 @@ class Scheduler:
         p = len(r.prompt)
         if p < 1:
             return "empty prompt"
-        if p > e.prefill_len:
-            return f"prompt length {p} > prefill_len {e.prefill_len}"
+        cap = getattr(e, "max_prompt_len", e.prefill_len)
+        if p > cap:
+            # With chunked prefill on, the cap is max_len - 1 (prompts
+            # beyond prefill_len chunk); with it off, prefill_len stays
+            # the hard limit.
+            return f"prompt length {p} > max prompt length {cap}"
         if r.max_new_tokens < 1:
             return f"max_new_tokens {r.max_new_tokens} < 1"
         if p + r.max_new_tokens > e.max_len:
@@ -443,7 +447,8 @@ class Scheduler:
             # gates on), slot occupancy for the monolithic layout.
             self.metrics.record_occupancy(self.engine.utilization)
             self.metrics.sync_engine(self.engine)
-        if self.engine.active_count == 0:
+        if (self.engine.active_count == 0
+                and getattr(self.engine, "prefilling_count", 0) == 0):
             return 0
         t0 = self.clock()
         toks, valid, done = self.engine.step()
@@ -458,7 +463,14 @@ class Scheduler:
                     round_toks.setdefault(slot, []).append(tok)
                     produced += 1
         for slot, new in round_toks.items():
-            self._inflight[slot].pending.push_tokens(new)
+            fl = self._inflight[slot]
+            if fl.ttft_s is None:
+                # Chunked-prefill admission deferred the first token to
+                # this round — TTFT is request-observed first-token time.
+                fl.ttft_s = self.clock() - fl.pending.submitted_at
+                if self.metrics is not None:
+                    self.metrics.record_ttft(fl.ttft_s)
+            fl.pending.push_tokens(new)
         if self.metrics is not None:
             self.metrics.record_round(round_s, produced)
         completed = 0
@@ -517,6 +529,13 @@ class Scheduler:
                 self._count_shed()
                 continue
             done_at = self.clock()
+            if first is None:
+                # Chunked prefill scheduled: the slot is PREFILLING and
+                # the first token arrives from a later engine round (the
+                # step() collection loop records TTFT then).
+                self._inflight[slot] = _InFlight(pending, None, done_at,
+                                                 None)
+                continue
             ttft = done_at - pending.submitted_at
             if self.metrics is not None:
                 self.metrics.record_ttft(ttft)
@@ -688,6 +707,8 @@ class _InFlight:
 
     def __init__(self, pending, first_token, started_at, ttft_s):
         self.pending = pending
-        self.tokens = [int(first_token)]
+        # first_token/ttft_s are None while the slot is PREFILLING
+        # (chunked prefill) — both arrive with the final chunk's round.
+        self.tokens = [] if first_token is None else [int(first_token)]
         self.started_at = started_at
         self.ttft_s = ttft_s

@@ -207,7 +207,9 @@ def test_eos_stops_early_and_budget_caps(params):
 
 
 def test_start_validates_limits(params):
-    engine = SlotEngine(CFG, params, slots=1, max_len=16, prefill_len=8)
+    # Chunking off: this test pins the strict single-shot prompt cap.
+    engine = SlotEngine(CFG, params, slots=1, max_len=16, prefill_len=8,
+                        prefill_chunk_tokens=-1)
     slot = engine.acquire_slot()
     with pytest.raises(ValueError, match="at least one token"):
         engine.start(slot, [], max_new_tokens=2)

@@ -131,7 +131,7 @@ def test_invalid_requests_are_typed(params):
     sched = Scheduler(_engine(params), max_queue_depth=8)
     cases = [
         Request(prompt=(), max_new_tokens=2),
-        Request(prompt=tuple(range(13)), max_new_tokens=2),  # > prefill_len
+        Request(prompt=tuple(range(32)), max_new_tokens=2),  # > prompt cap
         Request(prompt=(1,), max_new_tokens=0),
         Request(prompt=(1,), max_new_tokens=64),  # > max_len
         Request(prompt=(1,), max_new_tokens=2, deadline_s=-1.0),

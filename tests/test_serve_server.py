@@ -119,7 +119,7 @@ def test_invalid_requests_get_400(stack):
         {"prompt": "text"},                                # string, no codec
         {"prompt": [1, "a"]},                              # non-int token
         {"prompt": [1], "max_new_tokens": 0},              # scheduler invalid
-        {"prompt": list(range(13)), "max_new_tokens": 2},  # > prefill_len
+        {"prompt": list(range(32)), "max_new_tokens": 2},  # > prompt cap
     ]
     for payload in cases:
         status, body = _post(base + "/generate", payload)

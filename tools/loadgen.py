@@ -68,16 +68,25 @@ class _Accounting:
         self.tokens = 0
         self.ttft_s = []
         self.latency_s = []
+        self.intertoken_s = []
         self.shed_reasons = {}
         self.per_replica = {}
         self.failovers = 0
 
-    def complete(self, ttft_s, latency_s, n_tokens):
+    def complete(self, ttft_s, latency_s, n_tokens, gaps=None):
+        """``gaps``: measured inter-token gaps (SSE frame arrivals). When
+        absent, the decode-phase mean (latency - ttft) / (n - 1) stands in
+        — per-request, so the percentile spread across requests survives."""
         with self.lock:
             self.completed += 1
             self.tokens += n_tokens
             self.ttft_s.append(ttft_s)
             self.latency_s.append(latency_s)
+            if gaps:
+                self.intertoken_s.extend(gaps)
+            elif n_tokens > 1 and latency_s > ttft_s >= 0:
+                self.intertoken_s.append(
+                    (latency_s - ttft_s) / (n_tokens - 1))
 
     def reject(self, reason):
         with self.lock:
@@ -115,6 +124,8 @@ def _read_sse(resp, t0, acct):
     ttft = None
     tokens = 0
     done = None
+    gaps = []
+    last_frame = None
     for raw in resp:
         line = raw.decode("utf-8", "replace").rstrip("\n\r")
         if line.startswith("event: "):
@@ -122,8 +133,14 @@ def _read_sse(resp, t0, acct):
         elif line.startswith("data: "):
             obj = json.loads(line[len("data: "):])
             if event == "token":
+                now = time.monotonic()
                 if ttft is None:
-                    ttft = time.monotonic() - t0
+                    ttft = now - t0
+                else:
+                    # True client-side inter-token gap: successive token
+                    # frame arrivals (what chunked prefill must protect).
+                    gaps.append(now - last_frame)
+                last_frame = now
                 tokens += len(obj.get("tokens", ()))
             elif event == "done":
                 done = obj
@@ -138,6 +155,7 @@ def _read_sse(resp, t0, acct):
         ttft if ttft is not None else time.monotonic() - t0,
         time.monotonic() - t0,
         tokens or len(done.get("tokens", ())),
+        gaps=gaps,
     )
     return True
 
@@ -213,7 +231,8 @@ def _scrape_health(url, server):
     effectiveness are visible end to end — including through the fleet
     router. Never raises — a server without the endpoints just yields
     nulls."""
-    fastpath = {"prefix_hit_rate": None, "spec_accept_rate": None}
+    fastpath = {"prefix_hit_rate": None, "spec_accept_rate": None,
+                "spec_accept_rate_by_drafter": {}}
     if url:
         import urllib.request
 
@@ -238,6 +257,10 @@ def _scrape_health(url, server):
                     fastpath["prefix_hit_rate"] = float(sample["value"])
                 elif sample["name"] == "serve_spec_accept_rate":
                     fastpath["spec_accept_rate"] = float(sample["value"])
+                elif sample["name"] == "serve_spec_accept_rate_by_drafter":
+                    drafter = sample.get("labels", {}).get("drafter", "?")
+                    fastpath["spec_accept_rate_by_drafter"][drafter] = float(
+                        sample["value"])
         except Exception:
             pass
         return slo, recompiles, fastpath
@@ -254,6 +277,8 @@ def _scrape_health(url, server):
     if metrics is not None:
         fastpath["prefix_hit_rate"] = float(metrics.prefix_hit_rate)
         fastpath["spec_accept_rate"] = float(metrics.spec_accept_rate)
+        fastpath["spec_accept_rate_by_drafter"] = (
+            metrics.snapshot().get("spec_accept_rate_by_drafter", {}))
     return slo, recompiles, fastpath
 
 
@@ -354,6 +379,12 @@ def main(argv=None):
              "serving-latency trends accumulate across runs; '' disables)",
     )
     parser.add_argument(
+        "--long_prompts", action="store_true",
+        help="mix in prompts LONGER than the prefill window (up to "
+        "seq_len - max_new - 1): the chunked-prefill workload — half the "
+        "requests draw long, half stay short/heterogeneous",
+    )
+    parser.add_argument(
         "--prefix_groups", type=int, default=0,
         help="shared-prefix workload: N groups of requests, each group "
         "sharing a long common prompt prefix (~3/4 of prompt_len) with "
@@ -392,6 +423,24 @@ def main(argv=None):
         # Heterogeneous prompt/output lengths: the serving engine's whole
         # point is that this mix shares one compiled program.
         n = rng.randint(1, max(1, args.max_new_tokens))
+        if args.long_prompts and i % 2 == 1:
+            # Beyond the prefill window (self-serve sizes it at
+            # max(prompt_len, seq_len // 2)) but within the engine cap
+            # p + n <= seq_len: the chunked-prefill path end to end.
+            lo = max(args.prompt_len, args.seq_len // 2) + 1
+            hi = args.seq_len - n - 1
+            if hi < lo:
+                n = max(1, args.seq_len - lo - 1)
+                hi = lo
+            p = rng.randint(lo, hi)
+            return {
+                "prompt": [rng.randint(0, 255) for _ in range(p)],
+                "max_new_tokens": n,
+                "temperature": args.temperature,
+                "seed": i,
+                **({"deadline_s": args.deadline_s}
+                   if args.deadline_s > 0 else {}),
+            }
         if group_prefixes:
             prefix = group_prefixes[i % len(group_prefixes)]
             tail_max = max(1, args.prompt_len - len(prefix))
@@ -491,12 +540,19 @@ def main(argv=None):
             k: round(v * 1e3, 3)
             for k, v in _percentiles(acct.latency_s).items()
         },
+        "intertoken_ms": {
+            k: round(v * 1e3, 3)
+            for k, v in _percentiles(acct.intertoken_s).items()
+        },
         "mode": "open" if args.rate > 0 else "closed",
         "slo": slo_status,
         "recompile_events_total": recompiles,
         "prefix_groups": args.prefix_groups,
+        "long_prompts": bool(args.long_prompts),
         "serve_prefix_hit_rate": fastpath["prefix_hit_rate"],
         "serve_spec_accept_rate": fastpath["spec_accept_rate"],
+        "serve_spec_accept_rate_by_drafter":
+            fastpath["spec_accept_rate_by_drafter"],
         "t_wall": time.time(),
         "concurrency": args.concurrency,
         "rate": args.rate,

@@ -71,6 +71,16 @@ def build_stack(serve_cfg, cfg, params):
     # unrelated jit compiles (other engines, tests, train steps) as
     # serving recompiles.
     sentinel = obs.RecompileSentinel(metrics.registry, use_listener=False)
+    draft_cfg = draft_params = None
+    draft_path = getattr(serve_cfg, "draft_model", "")
+    if draft_path:
+        if not getattr(serve_cfg, "spec_k", 0):
+            raise ValueError("--draft_model requires --spec_k > 0")
+        from distributed_tensorflow_tpu.train.checkpoint import (
+            load_lm_bundle,
+        )
+
+        draft_cfg, draft_params, _ = load_lm_bundle(draft_path)
     engine = SlotEngine(
         cfg,
         params,
@@ -83,6 +93,10 @@ def build_stack(serve_cfg, cfg, params):
         kv_pages=getattr(serve_cfg, "kv_pages", 0),
         prefix_cache=getattr(serve_cfg, "prefix_cache", True),
         spec_k=getattr(serve_cfg, "spec_k", 0),
+        prefill_chunk_tokens=getattr(serve_cfg, "prefill_chunk_tokens", 0),
+        draft_params=draft_params,
+        draft_cfg=draft_cfg,
+        draft_window=getattr(serve_cfg, "draft_window", 16),
     )
     engine.warmup()
     scheduler = Scheduler(
@@ -182,7 +196,8 @@ def main(argv=None):
     kv_desc = (
         f"paged(page_size={engine.page_size} pages={engine.pool.num_pages} "
         f"prefix={'on' if engine.prefix is not None else 'off'} "
-        f"spec_k={engine.spec_k})"
+        f"spec_k={engine.spec_k} drafter={engine.drafter} "
+        f"chunk={engine.prefill_chunk_tokens})"
         if engine.paged
         else "monolithic"
     )

@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Distill a truncated-layer draft head from a trained TransformerLM.
+
+The serving engine's learned drafter (``models/decoding.build_draft_fn``)
+is the target model truncated to its first N blocks, sharing the target's
+token/position embeddings. This tool trains that head to *imitate the
+target's greedy continuations* — the only thing speculative decoding
+rewards is argmax agreement, so the distillation loss is soft cross
+entropy against the target's logits on the target's own rollouts.
+
+Training matches serving exactly: the drafter runs on ``--window``-token
+history suffixes at their *absolute* positions (the shared ``pos_embed``
+rows the target itself used — the window truncates attention context,
+never shifts positions), so the student is trained on random W-token
+windows cut from target rollouts, at those windows' true offsets, while
+the teacher logits for those same tokens come from the full-context
+forward.
+Embeddings stay frozen (``tok_embed``/``pos_embed`` are shared with the
+target and must not drift); blocks, ``ln_f`` and ``lm_head`` train.
+
+Example:
+  python tools/train_draft.py --model lm.msgpack --draft_layers 1 \\
+      --steps 400 --output draft.msgpack
+  python tools/serve_lm.py --model lm.msgpack --spec_k 4 \\
+      --draft_model draft.msgpack
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+FROZEN = ("tok_embed", "pos_embed")  # shared with the target — never drift
+
+
+def distill(cfg, params, draft_layers=1, *, steps=300, batch=16, window=16,
+            rollouts=32, rollout_prompt=4, rollout_new=None, lr=1e-3,
+            soft_temp=1.0, seed=0, eval_windows=64, log_every=0,
+            prompts=None):
+    """Train a ``draft_layers``-deep head to imitate ``params``' greedy
+    rollouts. Returns ``(draft_cfg, draft_params, agreement)`` where
+    ``agreement`` is the held-out fraction of window positions whose
+    student argmax equals the teacher argmax — the quantity that becomes
+    the serving ``spec_accept_rate``.
+
+    ``prompts`` (optional, a list of int sequences) distills on the
+    SERVING TRAFFIC: each prompt is rolled out greedily to
+    ``cfg.max_seq_len`` and those continuations become the corpus,
+    replacing the ``rollouts`` random ``rollout_prompt``-token prompts.
+    This is the mode that makes the accept rate meaningful — a drafter
+    can only predict continuations it has seen the shape of, and on a
+    target whose rollouts don't generalize across prompts (random-init
+    bench weights are the extreme case) per-traffic distillation is the
+    difference between chance-level and useful acceptance.
+
+    Kept importable (bench.py distills in-process) and CPU-sized: the
+    corpus is a handful of greedy continuations, re-windowed every step.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.models.decoding import (
+        build_generate_fn,
+        init_draft_params,
+        make_draft_config,
+    )
+    from distributed_tensorflow_tpu.models.transformer import TransformerLM
+
+    draft_cfg = make_draft_config(cfg, draft_layers)
+    draft_params = init_draft_params(cfg, params, draft_layers)
+
+    # -- corpus: the target's own greedy rollouts + full-context logits ----
+    rng = np.random.default_rng(seed)
+    if prompts is not None:
+        # Traffic mode: roll every supplied prompt to max_seq_len (one
+        # generate program per distinct prompt length).
+        if window >= cfg.max_seq_len:
+            raise ValueError(
+                f"window {window} >= max_seq_len {cfg.max_seq_len}"
+            )
+        groups: dict[int, list] = {}
+        for pr in prompts:
+            pr = np.asarray(pr, np.int32).ravel()
+            if not 1 <= pr.size < cfg.max_seq_len:
+                raise ValueError(
+                    f"traffic prompt length {pr.size} outside "
+                    f"[1, max_seq_len {cfg.max_seq_len})"
+                )
+            groups.setdefault(int(pr.size), []).append(pr)
+        seqs = np.concatenate([
+            np.asarray(jax.device_get(
+                build_generate_fn(cfg, cfg.max_seq_len - plen)(
+                    params, np.stack(grp), jax.random.PRNGKey(seed)
+                )
+            ), np.int32)
+            for plen, grp in sorted(groups.items())
+        ])
+        seq_len = cfg.max_seq_len
+    else:
+        if rollout_new is None:
+            rollout_new = cfg.max_seq_len - rollout_prompt
+        seq_len = rollout_prompt + rollout_new
+        if not window < seq_len <= cfg.max_seq_len:
+            raise ValueError(
+                f"need window {window} < rollout length {seq_len} "
+                f"<= max_seq_len {cfg.max_seq_len}"
+            )
+        rand_prompts = rng.integers(
+            0, cfg.vocab_size, (rollouts, rollout_prompt)
+        ).astype(np.int32)
+        gen = build_generate_fn(cfg, rollout_new)
+        seqs = np.asarray(
+            jax.device_get(gen(params, rand_prompts,
+                               jax.random.PRNGKey(seed))),
+            np.int32,
+        )
+    teacher_lm = TransformerLM(cfg)
+    teacher_logits = np.asarray(jax.device_get(
+        jax.jit(lambda p, t: teacher_lm.apply({"params": p}, t))(params, seqs)
+    ), np.float32)  # (rollouts, seq_len, vocab)
+
+    # -- student step: soft CE on windows, embeddings grad-masked ----------
+    student_lm = TransformerLM(draft_cfg)
+    tx = optax.adam(lr)
+    opt_state = tx.init(draft_params)
+
+    def _loss(p, toks, pos, teach):
+        # Absolute positions, exactly as the serving drafter runs
+        # (build_draft_fn): shared embeddings mean the window is an
+        # attention truncation, not a position shift.
+        logits = student_lm.apply({"params": p}, toks, positions=pos)
+        soft = jax.nn.softmax(teach / soft_temp, axis=-1)
+        return -jnp.mean(
+            jnp.sum(soft * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+        )
+
+    @jax.jit
+    def _step(p, o, toks, pos, teach):
+        loss, grads = jax.value_and_grad(_loss)(p, toks, pos, teach)
+        grads = {
+            k: (jax.tree_util.tree_map(jnp.zeros_like, g) if k in FROZEN
+                else g)
+            for k, g in grads.items()
+        }
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    @jax.jit
+    def _agree(p, toks, pos, teach):
+        logits = student_lm.apply({"params": p}, toks, positions=pos)
+        return jnp.mean(
+            (jnp.argmax(logits, -1) == jnp.argmax(teach, -1))
+            .astype(jnp.float32)
+        )
+
+    def _windows(n):
+        rows = rng.integers(0, seqs.shape[0], n)
+        starts = rng.integers(0, seq_len - window + 1, n)
+        toks = np.stack([seqs[r, s:s + window]
+                         for r, s in zip(rows, starts)])
+        pos = (starts[:, None] + np.arange(window)).astype(np.int32)
+        teach = np.stack([teacher_logits[r, s:s + window]
+                          for r, s in zip(rows, starts)])
+        return toks, pos, teach
+
+    ev_toks, ev_pos, ev_teach = _windows(eval_windows)  # held out
+    loss = float("nan")
+    for i in range(steps):
+        toks, pos, teach = _windows(batch)
+        draft_params, opt_state, loss = _step(
+            draft_params, opt_state, toks, pos, teach)
+        if log_every and (i + 1) % log_every == 0:
+            agree = float(_agree(draft_params, ev_toks, ev_pos, ev_teach))
+            print(
+                f"step {i + 1}/{steps} loss {float(loss):.4f} "
+                f"agree {agree:.3f}",
+                flush=True,
+            )
+
+    agreement = float(_agree(draft_params, ev_toks, ev_pos, ev_teach))
+    return draft_cfg, jax.device_get(draft_params), agreement
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="lm.msgpack")
+    parser.add_argument(
+        "--demo", action="store_true",
+        help="distill from random-init target weights (smoke runs)",
+    )
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--vocab_size", type=int, default=256)
+    parser.add_argument("--d_model", type=int, default=128)
+    parser.add_argument("--num_heads", type=int, default=4)
+    parser.add_argument("--num_layers", type=int, default=4)
+    parser.add_argument("--d_ff", type=int, default=512)
+    parser.add_argument("--draft_layers", type=int, default=1)
+    parser.add_argument("--steps", type=int, default=300)
+    parser.add_argument("--batch", type=int, default=16)
+    parser.add_argument("--window", type=int, default=16)
+    parser.add_argument("--rollouts", type=int, default=32)
+    parser.add_argument("--rollout_prompt", type=int, default=4)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--soft_temp", type=float, default=1.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--log_every", type=int, default=50)
+    parser.add_argument("--output", default="draft.msgpack")
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    if args.demo:
+        from distributed_tensorflow_tpu.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=args.vocab_size,
+            d_model=args.d_model,
+            num_heads=args.num_heads,
+            num_layers=args.num_layers,
+            d_ff=args.d_ff,
+            max_seq_len=args.seq_len,
+            compute_dtype=jnp.float32,
+        )
+        params = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+    else:
+        from distributed_tensorflow_tpu.train.checkpoint import load_lm_bundle
+
+        try:
+            cfg, params, _ = load_lm_bundle(
+                args.model,
+                fallback_shapes={
+                    "vocab_size": args.vocab_size,
+                    "d_model": args.d_model,
+                    "num_heads": args.num_heads,
+                    "num_layers": args.num_layers,
+                    "d_ff": args.d_ff,
+                    "max_seq_len": args.seq_len,
+                },
+            )
+        except ValueError as e:
+            sys.exit(str(e))
+
+    draft_cfg, draft_params, agreement = distill(
+        cfg, params, args.draft_layers,
+        steps=args.steps, batch=args.batch, window=args.window,
+        rollouts=args.rollouts, rollout_prompt=args.rollout_prompt,
+        lr=args.lr, soft_temp=args.soft_temp, seed=args.seed,
+        log_every=args.log_every,
+    )
+    print(f"held-out argmax agreement with target: {agreement:.3f}")
+
+    from distributed_tensorflow_tpu.train.checkpoint import (
+        export_inference_bundle,
+    )
+
+    export_inference_bundle(
+        args.output,
+        draft_params,
+        metadata={
+            "model": "TransformerLM",
+            "parallelism": "dp",
+            "draft_of": os.path.basename(args.model) if not args.demo
+            else "demo",
+            "agreement": agreement,
+            "config": {
+                "vocab_size": draft_cfg.vocab_size,
+                "d_model": draft_cfg.d_model,
+                "num_heads": draft_cfg.num_heads,
+                "num_kv_heads": draft_cfg.num_kv_heads or 0,
+                "attention_window": draft_cfg.attention_window or 0,
+                "use_bias": int(draft_cfg.use_bias),
+                "rope": int(draft_cfg.position == "rope"),
+                "rope_theta": float(draft_cfg.rope_theta),
+                "num_layers": draft_cfg.num_layers,
+                "d_ff": draft_cfg.d_ff,
+                # Keeps the target's max_seq_len: pos_embed is shared and
+                # sized (max_seq_len, d_model).
+                "max_seq_len": draft_cfg.max_seq_len,
+            },
+        },
+    )
+    print(f"exported {args.output}")
+    return agreement
+
+
+if __name__ == "__main__":
+    main()
