@@ -65,6 +65,18 @@ from functools import partial
 
 REFERENCE_STEPS_PER_SEC_ESTIMATE = 20.0
 SMOKE = os.environ.get("BENCH_SMOKE", "") == "1"
+# bench_serving_sharded needs >= 2 devices; on the CPU smoke path that
+# means forcing virtual host devices BEFORE jax initializes its backend.
+# This module deliberately imports jax lazily (inside the bench fns), so
+# setting the flag at import time is early enough for a CLI smoke run; in
+# pytest the conftest already forces 8. TPU runs ignore the flag (it only
+# affects the host platform).
+if SMOKE and "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
 # Reference hot-loop batch (demo1/train.py:154). The smoke path shrinks it:
 # XLA:CPU takes minutes to compile the batch-100 conv train scan that the
 # TPU backend compiles in seconds, and smoke mode exists to be quick.
@@ -954,6 +966,190 @@ def _bench_serving_long_prompts(cfg, params, *, slots, page_size,
                 f"{mix_note}; >= 0.5 ENFORCED (bench.FLOORS) — the "
                 f"rung's reason to exist: the n-gram fallback measured "
                 f"{ngram_accept:.3f} on the same weights"
+            ),
+        },
+    ]
+
+
+def bench_serving_sharded() -> list[dict]:
+    """Tensor-parallel serving: the ShardedSlotEngine (tp=2, weights by
+    ``parallel/rules.SERVE_TP_RULES``, KV pool split on the kv-head axis)
+    vs the single-device SlotEngine on the SAME model and workload.
+
+    Sharding is only allowed to change WHERE the math runs, never its
+    outcome: every request stream — greedy (speculative rounds), sampled,
+    and chunked long-prompt — is asserted token-identical between the two
+    engines, and the sharded engine's post-warmup recompile count must be
+    0 (page tables stay host-side traced operands, so the PR 8 contract
+    survives the mesh). The parity fraction is also emitted as the
+    ``serve_sharded_token_parity`` FLOORS gate so bench_diff watches it.
+
+    Smoke branch runs on a 2-virtual-device CPU host mesh (XLA_FLAGS
+    forced at module import, before jax backend init); the TPU branch is
+    wired with the mid-size decode shape but reports a VACUOUS parity
+    pass on single-device hosts where a 2-way mesh cannot exist. f32 on
+    both branches: the bench's job is the cross-placement parity claim,
+    which low-precision accumulation differences would only blur."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from distributed_tensorflow_tpu.serve import (
+        Request,
+        Scheduler,
+        ServingMetrics,
+        ShardedSlotEngine,
+        SlotEngine,
+    )
+
+    if not SMOKE and jax.default_backend() != "tpu":
+        return []
+    if jax.device_count() < 2:
+        return [{
+            "metric": "serve_sharded_token_parity",
+            "value": 1.0,
+            "unit": "frac",
+            "detail": (
+                "VACUOUS PASS: fewer than 2 devices visible, a tp=2 mesh "
+                "cannot exist here — run on a multi-chip host (or smoke "
+                "mode, which forces 2 virtual CPU devices) for the real "
+                "measurement"
+            ),
+        }]
+
+    tp = 2
+    if SMOKE:
+        dm, h, kv, nl, dff, vocab = 128, 8, 4, 2, 512, 512
+        max_len, prefill_len, page_size, slots = 96, 48, 8, 4
+        chunk, n_new = 16, 12
+        short_p, long_p = 40, 70
+    else:
+        dm, h, kv, nl, dff, vocab = 1024, 8, 8, 8, 4096, 256
+        max_len, prefill_len, page_size, slots = 256, 128, 32, 8
+        chunk, n_new = 64, 48
+        short_p, long_p = 112, 192
+    cfg = TransformerConfig(
+        vocab_size=vocab, d_model=dm, num_heads=h, num_kv_heads=kv,
+        num_layers=nl, d_ff=dff, max_seq_len=max_len,
+        compute_dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    params = jax.jit(
+        lambda k: model.init(k, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, vocab, 16)
+    # Workload A — all-greedy shared-prefix burst: exercises prefix
+    # adoption AND the speculative-verify program (all-active-greedy
+    # rounds run spec when spec_k > 0).
+    reqs_a = [
+        Request(
+            prompt=tuple(np.concatenate(
+                [prefix, rng.integers(0, vocab, short_p - 16)]
+            ).astype(int)),
+            max_new_tokens=n_new,
+        )
+        for _ in range(slots + 2)
+    ]
+    # Workload B — mixed: sampled lanes (plain rounds — spec falls back),
+    # greedy lanes, and prompts past prefill_len (chunked prefill).
+    reqs_b = (
+        [Request(prompt=tuple(rng.integers(0, vocab, short_p).astype(int)),
+                 max_new_tokens=n_new, temperature=0.8, top_k=20, seed=s)
+         for s in (1, 2)]
+        + [Request(prompt=tuple(rng.integers(0, vocab, 24).astype(int)),
+                   max_new_tokens=n_new)]
+        + [Request(prompt=tuple(rng.integers(0, vocab, long_p).astype(int)),
+                   max_new_tokens=n_new)
+           for _ in range(2)]
+    )
+
+    kw = dict(
+        slots=slots, max_len=max_len, prefill_len=prefill_len,
+        page_size=page_size, prefix_cache=True, spec_k=2,
+        prefill_chunk_tokens=chunk,
+        prefill_buckets=(chunk,),
+    )
+
+    def run(engine):
+        compiled = engine.warmup()
+        streams, wall = [], 0.0
+        for reqs in (reqs_a, reqs_b):
+            metrics = ServingMetrics()
+            sched = Scheduler(engine, max_queue_depth=len(reqs) + 1,
+                              metrics=metrics)
+            pendings = [sched.submit(r) for r in reqs]
+            t0 = time.perf_counter()
+            done = sched.run_until_idle(
+                max_steps=len(reqs) * (long_p + n_new) + 64)
+            wall += time.perf_counter() - t0
+            assert done == len(reqs) and all(p.done() for p in pendings)
+            streams.append([tuple(p.result(timeout=1).tokens)
+                            for p in pendings])
+        recompiles = engine.compile_count() - compiled
+        assert recompiles == 0, (
+            f"{type(engine).__name__} recompiled after warmup: {recompiles}"
+        )
+        return streams, wall
+
+    single = SlotEngine(cfg, params, **kw)
+    ref_streams, single_s = run(single)
+    sharded = ShardedSlotEngine(cfg, params, tp=tp, **kw)
+    sh_streams, sharded_s = run(sharded)
+
+    flat_ref = [s for ws in ref_streams for s in ws]
+    flat_sh = [s for ws in sh_streams for s in ws]
+    matched = sum(a == b for a, b in zip(flat_ref, flat_sh))
+    parity = matched / len(flat_ref)
+    assert parity == 1.0, (
+        f"sharded token parity broken: {matched}/{len(flat_ref)} streams "
+        f"matched (greedy/sampled/spec/chunked mix, tp={tp})"
+    )
+    n_tok = sum(len(s) for s in flat_ref)
+    shape_note = (
+        f"{dm}d/{nl}L kv_heads {kv}, tp={tp} ('model' axis; fused-qkv/"
+        f"mlp_in column, proj/mlp_out row, KV pages split on kv heads), "
+        f"{slots} slots, page_size {page_size}, chunk {chunk}, spec_k 2, "
+        f"greedy+sampled+chunked mix"
+    )
+    return [
+        {
+            "metric": "serve_sharded_token_parity",
+            "value": round(parity, 3),
+            "unit": "frac",
+            "detail": (
+                f"{matched}/{len(flat_ref)} request streams identical "
+                f"between ShardedSlotEngine and SlotEngine, {shape_note}; "
+                f"0 post-warmup recompiles on both ASSERTED in-run; "
+                f">= 1.0 ENFORCED (bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "serve_sharded_tok_s",
+            "value": round(n_tok / sharded_s, 1),
+            "unit": "tokens/s",
+            "detail": (
+                f"tp={tp} engine on the parity workload, {shape_note}; "
+                f"single-device engine {n_tok / single_s:,.1f} tok/s on "
+                f"the same burst (informational — a 2-virtual-device CPU "
+                f"mesh adds collective overhead without adding FLOPs; "
+                f"the win this wires up is HBM: models past one chip)"
+            ),
+        },
+        {
+            "metric": "serve_sharded_hbm_bytes_per_device",
+            "value": round(sharded.hbm_bytes_per_device, 0),
+            "unit": "bytes",
+            "detail": (
+                f"KV pool bytes RESIDENT per device (kv-head axis split "
+                f"{tp} ways) vs {single.hbm_bytes_per_device:,.0f} "
+                f"single-device, {shape_note}; weights shard too (rules "
+                f"table) — informational, the capacity story"
             ),
         },
     ]
@@ -1871,6 +2067,15 @@ FLOORS = {
     # draft positions misaligned, or the verify stopped crediting
     # matches.
     "serve_spec_accept_rate": 0.5,
+    # Sharded serving may only change WHERE the math runs, never its
+    # outcome: every request stream from the tp=2 ShardedSlotEngine must
+    # equal the single-device engine's, across the greedy / sampled /
+    # speculative / chunked mix (bench_serving_sharded hard-asserts
+    # in-run too; the floor keeps the gate visible through bench_diff).
+    # Below 1.0 means a partition spec changed numerics enough to flip a
+    # token — wrong rule table, a sharded reduction crossing an argmax
+    # tie, or host registers leaking onto the mesh.
+    "serve_sharded_token_parity": 1.0,
     # The fleet's reason to exist: the router over 2 replicas must move
     # >= 1.6x the tokens of one replica hit directly under the identical
     # offered open-loop schedule (ISSUE 7 acceptance; the physics ceiling
@@ -1961,6 +2166,7 @@ def main() -> None:
             bench_lm_mfu,
             bench_lm_decode,
             bench_serving,
+            bench_serving_sharded,
             bench_fleet,
             bench_flash_kernel,
             bench_mnist_real_accuracy,

@@ -534,6 +534,16 @@ class ServeConfig:
             "minus spec_k"
         },
     )
+    tp: int = field(
+        default=1,
+        metadata={
+            "help": "tensor-parallel width of the serving mesh: 1 = one "
+            "fully-replicated device (SlotEngine), N > 1 = one model "
+            "partitioned over N devices behind the same slot API "
+            "(ShardedSlotEngine; requires num_kv_heads % tp == 0 and "
+            "d_model % tp == 0, validated before any jit)"
+        },
+    )
 
     @property
     def lane_weight_tuple(self) -> tuple:
@@ -544,6 +554,37 @@ class ServeConfig:
         """Resolve the ``page_size`` flag for SlotEngine: None = engine
         auto-pick, 0 = monolithic, else the explicit value."""
         return None if self.page_size < 0 else self.page_size
+
+    def validate_mesh(self, model_cfg) -> None:
+        """Fail fast — at config-build time, with an actionable message —
+        on a ``tp`` the model's shapes cannot shard, instead of a shape
+        error deep inside jit. No-op for ``tp <= 1``."""
+        if self.tp > 1:
+            validate_tp_mesh(model_cfg, self.tp)
+
+
+def validate_tp_mesh(model_cfg, tp: int) -> None:
+    """Shared tp-divisibility check (ServeConfig AND ShardedSlotEngine call
+    this). ``model_cfg`` needs ``kv_heads`` and ``d_model`` attributes."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    kv = int(model_cfg.kv_heads)
+    if kv % tp:
+        divisors = [d for d in range(1, kv + 1) if kv % d == 0]
+        raise ValueError(
+            f"tp={tp} does not divide num_kv_heads={kv}: GQA-under-TP "
+            "shards whole query groups along the kv-head axis (KV pages "
+            "included), so num_kv_heads % tp must be 0. Pick tp from "
+            f"{divisors} or change the model's num_kv_heads."
+        )
+    dm = int(model_cfg.d_model)
+    if dm % tp:
+        raise ValueError(
+            f"tp={tp} does not divide d_model={dm}: the column/row-"
+            "parallel kernels split the model dim evenly across the "
+            "'model' mesh axis. Pick a tp that divides d_model."
+        )
 
 
 @dataclass

@@ -112,13 +112,20 @@ def sample_logits_batched(logits, keys, temperature, top_k, top_p):
     return jnp.where(temperature > 0.0, sampled, greedy)
 
 
-def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
+               sharding=None):
     """Static-shape per-layer KV buffers + one shared filled-prefix length.
     Under GQA the buffers hold the UNEXPANDED ``kv_heads`` — the cache (and
     its per-step HBM read, the decode bound past small batches) shrinks by
     the query-group factor. With ``cfg.kv_cache_dtype == 'int8'`` the
     buffers are int8 with per-row f32 scales (another ~2x off the cache
-    read at the KV bound, composing with GQA)."""
+    read at the KV bound, composing with GQA).
+
+    ``sharding`` (a ``jax.sharding.Sharding``) allocates every k/v/scale
+    leaf directly under a mesh placement — the sharded serving engine
+    passes ``P(None, 'model')`` to split the kv-head axis (axis 1 on every
+    leaf) without a replicated round-trip through host memory. The scalar
+    ``len`` register stays default-placed."""
     dh = cfg.d_model // cfg.num_heads
     kv = cfg.kv_heads
     quant = getattr(cfg, "kv_cache_dtype", None)
@@ -126,14 +133,19 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
         raise ValueError(f"kv_cache_dtype must be None or 'int8', got {quant!r}")
     dtype = jnp.int8 if quant == "int8" else cfg.compute_dtype
 
+    def zeros(shape, dt):
+        if sharding is None:
+            return jnp.zeros(shape, dt)
+        return jnp.zeros(shape, dt, device=sharding)
+
     def layer():
         buf = {
-            "k": jnp.zeros((batch, kv, max_len, dh), dtype),
-            "v": jnp.zeros((batch, kv, max_len, dh), dtype),
+            "k": zeros((batch, kv, max_len, dh), dtype),
+            "v": zeros((batch, kv, max_len, dh), dtype),
         }
         if quant == "int8":
-            buf["k_scale"] = jnp.zeros((batch, kv, max_len), jnp.float32)
-            buf["v_scale"] = jnp.zeros((batch, kv, max_len), jnp.float32)
+            buf["k_scale"] = zeros((batch, kv, max_len), jnp.float32)
+            buf["v_scale"] = zeros((batch, kv, max_len), jnp.float32)
         return buf
 
     return {

@@ -1,0 +1,112 @@
+"""Declarative sharding: regex partition rules over parameter path names.
+
+Every parallel strategy in this package used to hand-wire its
+``PartitionSpec``s per model (``tensor_parallel._spec_for_path`` was the
+canonical example). This module replaces that with RULE TABLES: an ordered
+sequence of ``(regex, PartitionSpec)`` pairs resolved against each
+parameter's '/'-joined path — first ``re.search`` hit wins, scalar leaves
+are always replicated, and a non-scalar leaf no rule matches is a loud
+error (a silent replicate-by-default would hide an exploding-memory bug on
+real meshes). Any new model then gets any mesh layout from a table instead
+of new code; the serving engine (``serve/engine.ShardedSlotEngine``) is the
+first consumer, the TP train path (``tensor_parallel.tp_param_specs``) is
+re-expressed on the same primitive, and FSDP-sharded weights can follow by
+adding a table.
+
+Two tables ship today, both over the ('data', 'model') mesh of
+``parallel/mesh.make_mesh``:
+
+* :data:`TP_TRAIN_RULES` — the Megatron split for ``TpTransformerLM``'s
+  SEPARATE q/k/v projections (column-parallel q/k/v/mlp_in with sharded
+  bias, row-parallel proj/mlp_out kernels, everything else replicated).
+  Exactly reproduces the retired ``_spec_for_path``; pinned by
+  ``tests/test_tensor_parallel.py::test_param_specs_rules``.
+
+* :data:`SERVE_TP_RULES` — the same split for the serving
+  ``TransformerLM``'s FUSED ``qkv`` projection. Under GSPMD jit (unlike
+  ``shard_map``) a spec is a PLACEMENT constraint, not a local-compute
+  contract, so splitting the fused ``[q | k | v]`` output columns across
+  'model' is valid — XLA partitions the matmul on its output dim and
+  inserts the collectives the attention einsums need. Row-parallel
+  proj/mlp_out contract over the sharded dim (partial products + one
+  all-reduce), the Megatron recipe.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "TP_TRAIN_RULES",
+    "SERVE_TP_RULES",
+    "match_partition_rules",
+    "shardings_from_rules",
+]
+
+
+# Megatron TP for separate-projection TpTransformerLM (training). Biases of
+# column-parallel layers carry the output shard ``P('model')``; biases of
+# row-parallel layers (``proj_bias`` module param, applied AFTER the
+# all-reduce) fall through to replicated.
+TP_TRAIN_RULES = (
+    (r"(?:^|/)(?:q|k|v|mlp_in)/kernel$", P(None, "model")),
+    (r"(?:^|/)(?:q|k|v|mlp_in)/[^/]+$", P("model")),
+    (r"(?:^|/)(?:proj|mlp_out)/kernel$", P("model", None)),
+    (r".*", P()),
+)
+
+# Same split for the serving TransformerLM's fused qkv. proj/mlp_out
+# biases (row-parallel, added after the reduce) and embeddings / norms /
+# lm_head fall through to replicated — the lm_head matmul runs once per
+# emitted token on a (slots, d_model) activation, not worth a collective.
+SERVE_TP_RULES = (
+    (r"(?:^|/)(?:qkv|mlp_in)/kernel$", P(None, "model")),
+    (r"(?:^|/)(?:qkv|mlp_in)/bias$", P("model")),
+    (r"(?:^|/)(?:proj|mlp_out)/kernel$", P("model", None)),
+    (r".*", P()),
+)
+
+
+def _path_name(path) -> str:
+    # Mirror tensor_parallel's path naming: only mapping keys contribute
+    # (DictKey has .key; GetAttrKey/SequenceKey from optimizer-state
+    # containers are structural, not name segments).
+    return "/".join(str(p.key) for p in path if hasattr(p, "key"))
+
+
+def match_partition_rules(rules, params):
+    """Resolve a ``PartitionSpec`` pytree for ``params`` from ``rules``.
+
+    ``rules`` is an ordered iterable of ``(regex, PartitionSpec)``; each
+    leaf's '/'-joined path is matched with ``re.search`` and the FIRST hit
+    wins (order encodes precedence — put the specific rules first and a
+    ``('.*', P())`` fallback last if replication is an acceptable
+    default). Scalar (0-d) leaves are always replicated regardless of the
+    table. A non-scalar leaf that no rule matches raises ``ValueError``.
+    """
+    rules = tuple(rules)
+
+    def resolve(path, leaf):
+        name = _path_name(path)
+        if getattr(leaf, "ndim", 0) == 0:
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        raise ValueError(f"Partition rule not found for param: {name}")
+
+    return jax.tree_util.tree_map_with_path(resolve, params)
+
+
+def shardings_from_rules(rules, params, mesh):
+    """Rule table → per-leaf ``NamedSharding`` pytree for ``mesh`` — the
+    form ``jax.jit(in_shardings=...)`` and ``jax.device_put`` take."""
+    specs = match_partition_rules(rules, params)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )

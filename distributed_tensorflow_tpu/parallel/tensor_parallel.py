@@ -244,30 +244,36 @@ class TpTransformerLM(nn.Module):
 # Param sharding specs.
 # ---------------------------------------------------------------------------
 
-_COLUMN_PARALLEL = ("q", "k", "v", "mlp_in")
-_ROW_PARALLEL = ("proj", "mlp_out")
-
-
-def _spec_for_path(path) -> P:
-    names = [p.key for p in path if hasattr(p, "key")]
-    if len(names) >= 2 and names[-2] in _COLUMN_PARALLEL:
-        return P(None, "model") if names[-1] == "kernel" else P("model")
-    if len(names) >= 2 and names[-2] in _ROW_PARALLEL and names[-1] == "kernel":
-        return P("model", None)
-    return P()
-
-
 def tp_param_specs(tree: Any) -> Any:
     """PartitionSpec tree for a :class:`TpTransformerLM` param tree — also
     valid for optimizer-state trees whose leaves mirror param paths (Adam
-    mu/nu); scalar leaves (e.g. Adam count) map to P()."""
+    mu/nu); scalar leaves (e.g. Adam count) map to P().
 
-    def spec(path, leaf):
-        if getattr(leaf, "ndim", None) == 0:
-            return P()
-        return _spec_for_path(path)
+    The split itself lives in ``parallel/rules.py::TP_TRAIN_RULES`` — one
+    rule table shared with the serving engine's spec derivation instead of
+    a second hand-wired path matcher."""
+    from distributed_tensorflow_tpu.parallel.rules import (
+        TP_TRAIN_RULES,
+        match_partition_rules,
+    )
 
-    return jax.tree_util.tree_map_with_path(spec, tree)
+    return match_partition_rules(TP_TRAIN_RULES, tree)
+
+
+def _spec_for_path(path) -> P:
+    """Per-PATH spec from the same rule table, for callers that resolve one
+    tree_map_with_path entry at a time (``three_d`` stacks stage params and
+    prefixes a 'pipe' axis onto the UNSTACKED dims' spec, so it cannot use
+    the whole-tree resolver)."""
+    import re
+
+    from distributed_tensorflow_tpu.parallel.rules import TP_TRAIN_RULES
+
+    name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
+    for pattern, spec in TP_TRAIN_RULES:
+        if re.search(pattern, name):
+            return spec
+    return P()
 
 
 def shard_params(tree: Any, mesh: Mesh, specs: Any | None = None) -> Any:

@@ -18,7 +18,10 @@ Layers (each importable on its own):
 See ``docs/DESIGN.md`` §11 for the contracts.
 """
 
-from distributed_tensorflow_tpu.serve.engine import SlotEngine
+from distributed_tensorflow_tpu.serve.engine import (
+    ShardedSlotEngine,
+    SlotEngine,
+)
 from distributed_tensorflow_tpu.serve.kv_pool import SlotKVPool
 from distributed_tensorflow_tpu.serve.metrics import Histogram, ServingMetrics
 from distributed_tensorflow_tpu.serve.scheduler import (
@@ -30,6 +33,7 @@ from distributed_tensorflow_tpu.serve.scheduler import (
 
 __all__ = [
     "SlotEngine",
+    "ShardedSlotEngine",
     "SlotKVPool",
     "Histogram",
     "ServingMetrics",

@@ -115,7 +115,7 @@ from distributed_tensorflow_tpu.serve.kv_pool import (
     SlotKVPool,
 )
 
-__all__ = ["SlotEngine"]
+__all__ = ["SlotEngine", "ShardedSlotEngine"]
 
 
 class SlotEngine:
@@ -255,10 +255,14 @@ class SlotEngine:
         # after warmup and every round, it turns the zero-recompile
         # invariant into the alerting ``recompile_events_total`` metric.
         self.sentinel = sentinel
+        # Mesh topology: the base engine is one fully-replicated process.
+        # ShardedSlotEngine sets these BEFORE delegating here so the pool
+        # and program hooks below see them.
+        if not hasattr(self, "tp"):
+            self.tp = 1
+            self.mesh = None
         if self.paged:
-            self.pool = PagedKVPool(
-                cfg, self.slots, max_len, self.page_size, kv_pages
-            )
+            self.pool = self._build_pool(cfg, max_len, kv_pages)
             self.prefix = PrefixCache(self.pool) if prefix_cache else None
         else:
             self.pool = SlotKVPool(cfg, self.slots, max_len)
@@ -601,27 +605,47 @@ class SlotEngine:
         # rounds when spec_k > 0. Still a fixed set: warmup compiles every
         # member, and the compile-count assert covers the lot.
         donate = (0,) if self.paged else ()
-        self._prefill_greedy = jax.jit(
-            make_prefill(False), donate_argnums=donate
+        self._prefill_greedy = self._jit_program(
+            make_prefill(False), "prefill", donate
         )
-        self._prefill_sampled = jax.jit(
-            make_prefill(True), donate_argnums=donate
+        self._prefill_sampled = self._jit_program(
+            make_prefill(True), "prefill", donate
         )
         step_donate = (0,) if self.paged else (1,)
-        self._step_greedy = jax.jit(
-            make_step(False), donate_argnums=step_donate
+        self._step_greedy = self._jit_program(
+            make_step(False), "step", step_donate
         )
-        self._step_sampled = jax.jit(
-            make_step(True), donate_argnums=step_donate
+        self._step_sampled = self._jit_program(
+            make_step(True), "step", step_donate
         )
         self._spec = (
-            jax.jit(make_spec(), donate_argnums=(0,)) if self.spec_k else None
+            self._jit_program(make_spec(), "spec", (0,))
+            if self.spec_k
+            else None
         )
         self._draft = (
-            jax.jit(build_draft_fn(draft_cfg, self.spec_k, self.draft_window))
+            self._jit_program(
+                build_draft_fn(draft_cfg, self.spec_k, self.draft_window),
+                "draft",
+                (),
+            )
             if self.draft_params is not None
             else None
         )
+
+    # -- program / pool hooks (overridden by ShardedSlotEngine) -----------
+
+    def _build_pool(self, cfg, max_len, kv_pages):
+        return PagedKVPool(
+            cfg, self.slots, max_len, self.page_size, kv_pages
+        )
+
+    def _jit_program(self, fn, kind, donate):
+        """Compile hook: the base engine jits on the default device; the
+        sharded engine overrides this to jit the SAME program under its
+        mesh with in/out shardings. ``kind`` names the fixed argument
+        layout (``prefill``/``step``/``spec``/``draft``)."""
+        return jax.jit(fn, donate_argnums=donate)
 
     # -- slot lifecycle ---------------------------------------------------
 
@@ -1211,3 +1235,143 @@ class SlotEngine:
             f._cache_size() if hasattr(f, "_cache_size") else 0 for f in fns
         )
         return own + self.pool.compile_count()
+
+    @property
+    def mesh_device_count(self) -> int:
+        """Devices the engine's programs span: 1 for the replicated base
+        engine, ``mesh.size`` for the sharded one. Routers use this (via
+        ``/healthz``) to tell one tp-wide replica from N independent ones."""
+        return int(self.mesh.size) if self.mesh is not None else 1
+
+    @property
+    def hbm_bytes_per_device(self) -> int:
+        """KV pool bytes RESIDENT per device. The sharded engine splits
+        the pool's kv-head axis ``tp`` ways; everything else about the
+        pool (page tables, accounting) is host-side and free."""
+        return int(self.pool.hbm_bytes) // max(1, self.tp)
+
+
+class ShardedSlotEngine(SlotEngine):
+    """The SlotEngine on a TP-partitioned model — same slot API, same
+    host-side registers and page tables, same fixed compiled-program set,
+    but every program is jitted under a ``('data', 'model')`` mesh
+    (``data`` axis size 1 — serving parallelism is slots, not batch):
+
+    * **Weights** are placed by the declarative rule table
+      (``parallel/rules.py::SERVE_TP_RULES`` unless ``rules=`` overrides):
+      fused qkv / mlp_in column-parallel, proj / mlp_out row-parallel,
+      embeddings + norms + lm_head replicated. ``in_shardings`` pin the
+      same placement at every program boundary so donated buffers round-trip
+      without resharding.
+    * **KV pool** leaves shard along the kv-head axis
+      (``P(None, 'model')`` — pages and in-page positions stay whole), the
+      axis GQA-under-TP already constrains to ``num_kv_heads % tp == 0``.
+    * **Everything host-side stays host-side and replicated**: page
+      tables, slot registers, token buffers enter as numpy traced operands
+      exactly as before, so rebinding pages never retraces and the
+      zero-recompile-after-warmup contract (RecompileSentinel) is
+      unchanged.
+
+    GSPMD jit semantics make this a PLACEMENT change, not a numerics
+    rewrite: XLA partitions the matmuls along the annotated dims and
+    inserts the collectives, and the emitted TOKENS are identical to the
+    single-device engine (asserted by the sharded_serve parity tests and
+    in ``bench_serving_sharded``). Requires the paged KV layout.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        *,
+        tp: int,
+        devices=None,
+        rules=None,
+        **kw,
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_tensorflow_tpu.config import validate_tp_mesh
+        from distributed_tensorflow_tpu.parallel.mesh import make_mesh
+        from distributed_tensorflow_tpu.parallel.rules import (
+            SERVE_TP_RULES,
+            shardings_from_rules,
+        )
+
+        tp = int(tp)
+        if tp < 2:
+            raise ValueError(
+                f"ShardedSlotEngine is the tp >= 2 path, got tp={tp}; "
+                "use SlotEngine for a single-device replica"
+            )
+        validate_tp_mesh(cfg, tp)
+        page_size = kw.get("page_size")
+        if page_size is not None and page_size <= 0:
+            raise ValueError(
+                "ShardedSlotEngine requires the paged KV layout "
+                f"(page_size > 0), got page_size={page_size}"
+            )
+        devices = list(devices) if devices is not None else list(jax.devices())
+        if len(devices) < tp:
+            raise ValueError(
+                f"tp={tp} needs {tp} devices but only {len(devices)} are "
+                "visible (CPU smoke: set XLA_FLAGS="
+                "--xla_force_host_platform_device_count)"
+            )
+        # Set BEFORE delegating: the base __init__ calls the _build_pool /
+        # _jit_program hooks below, which read the mesh state.
+        self.tp = tp
+        self.mesh = make_mesh(
+            num_devices=tp, model_parallel=tp, devices=devices[:tp]
+        )
+        self._rep = NamedSharding(self.mesh, P())
+        # One spec covers every pool leaf: axis 1 is kv heads on both the
+        # (pages, kv, ps, dh) k/v rows and the (pages, kv, ps) int8 scales;
+        # unnamed trailing dims are replicated.
+        self._kv_shard = NamedSharding(self.mesh, P(None, "model"))
+        self._rules = tuple(rules) if rules is not None else SERVE_TP_RULES
+        self._param_sh = shardings_from_rules(self._rules, params, self.mesh)
+        params = jax.device_put(params, self._param_sh)
+        super().__init__(cfg, params, **kw)
+
+    # -- hooks -------------------------------------------------------------
+
+    def _build_pool(self, cfg, max_len, kv_pages):
+        return PagedKVPool(
+            cfg, self.slots, max_len, self.page_size, kv_pages,
+            kv_sharding=self._kv_shard,
+        )
+
+    def _jit_program(self, fn, kind, donate):
+        """Jit under the mesh with explicit in/out shardings per program
+        kind. Arg layouts are the paged ones (position 0 = pool layers,
+        position 1 = params, everything after is a replicated host
+        register); the pool position takes ONE sharding as a pytree
+        prefix for all its leaves."""
+        rep, kvs, psh = self._rep, self._kv_shard, self._param_sh
+        if kind == "draft":
+            # The drafter is a small replicated model over host windows —
+            # nothing sharded flows through it.
+            return jax.jit(fn, donate_argnums=donate)
+        if kind == "prefill":
+            # (pool, params, tokens, length, prefix_len, row, temp,
+            #  top_k, top_p, seed) -> (pool, first)
+            ins = (kvs, psh) + (rep,) * 8
+            outs = (kvs, rep)
+        elif kind == "step":
+            # (pool, params, ptabs, active, lengths, tok, temp, top_k,
+            #  top_p, seed, made, budget, eos)
+            #   -> (pool, active, lengths, tok, made, toks, valid)
+            ins = (kvs, psh) + (rep,) * 11
+            outs = (kvs,) + (rep,) * 6
+        elif kind == "spec":
+            # (pool, params, ptabs, active, lengths, tok, drafts, made,
+            #  budget, eos) -> (pool, active, lengths, tok, made,
+            #  targets.T, valid.T, accepted)
+            ins = (kvs, psh) + (rep,) * 8
+            outs = (kvs,) + (rep,) * 7
+        else:  # pragma: no cover - new kinds must be wired explicitly
+            raise ValueError(f"unknown program kind {kind!r}")
+        return jax.jit(
+            fn, donate_argnums=donate, in_shardings=ins, out_shardings=outs
+        )

@@ -67,7 +67,9 @@ class ProbeResult:
     queue_depth: int = 0
     occupancy: float = 0.0
     shed_total: float = 0.0
-    detail: str = ""
+    tp: int = 1              # tensor-parallel width of the replica's mesh
+    devices: int = 1         # devices it spans — a tp-wide replica is ONE
+    detail: str = ""         # replica, not tp independent ones
 
 
 @dataclass
@@ -111,6 +113,8 @@ def http_probe(base_url: str, timeout_s: float = 2.0) -> ProbeResult:
         queue_depth=int(body.get("queue_depth", 0)),
         occupancy=(1.0 - body.get("free_slots", 0) / body["slots"]
                    if body.get("slots") else 0.0),
+        tp=int(body.get("mesh", {}).get("tp", 1)),
+        devices=int(body.get("mesh", {}).get("devices", 1)),
     )
     try:
         with urllib.request.urlopen(
@@ -348,6 +352,8 @@ class ReplicaRegistry:
                         "queue_depth": r.last.queue_depth,
                         "occupancy": r.last.occupancy,
                         "slots": r.last.slots,
+                        "tp": r.last.tp,
+                        "devices": r.last.devices,
                         "shed_total": r.last.shed_total,
                         "dispatched_total": r.dispatched_total,
                         "error_total": r.error_total,

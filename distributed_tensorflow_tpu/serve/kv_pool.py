@@ -173,7 +173,7 @@ class PagedKVPool:
     """
 
     def __init__(self, cfg, slots: int, max_len: int, page_size: int,
-                 num_pages: int = 0):
+                 num_pages: int = 0, kv_sharding=None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if max_len < 2:
@@ -206,8 +206,15 @@ class PagedKVPool:
         # One allocation for the pool's lifetime: init_cache with
         # batch=num_pages, len=page_size IS the paged layout — every cache
         # variant the model family supports (GQA kv heads, int8 rows with
-        # f32 scales) pages identically.
-        self.layers = init_cache(cfg, self.num_pages, page_size)["layers"]
+        # f32 scales) pages identically. ``kv_sharding`` (a NamedSharding,
+        # from ShardedSlotEngine) allocates the buffers already split on
+        # the kv-head axis — every leaf has kv heads at axis 1, so one
+        # sharding covers them all; page tables below stay host numpy
+        # either way.
+        self.kv_sharding = kv_sharding
+        self.layers = init_cache(
+            cfg, self.num_pages, page_size, sharding=kv_sharding
+        )["layers"]
         # Page 0 is TRASH (reserved, refcount pinned). LIFO free list with
         # an O(1) companion set, same discipline as the slot pool.
         self._free_pages: list[int] = list(range(self.num_pages - 1, 0, -1))

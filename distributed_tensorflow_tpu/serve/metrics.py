@@ -138,6 +138,14 @@ class ServingMetrics:
         self._hbm_per_slot = r.gauge(
             "serve_hbm_bytes_per_slot",
             "KV pool device bytes divided by slot count.")
+        self._mesh_tp = r.gauge(
+            "serve_mesh_tp",
+            "Tensor-parallel width of the serving mesh (1 = replicated "
+            "single-device engine).")
+        self._hbm_per_device = r.gauge(
+            "serve_hbm_bytes_per_device",
+            "KV pool bytes RESIDENT per device (kv-head axis sharded "
+            "tp ways; equals the pool size when tp=1).")
         self._peak_lock = threading.Lock()
         self._last_engine_stats: dict = {}
 
@@ -217,6 +225,9 @@ class ServingMetrics:
             self._page_occupancy.set(float(pool.occupancy))
         if pool is not None and hasattr(pool, "hbm_bytes_per_slot"):
             self._hbm_per_slot.set(float(pool.hbm_bytes_per_slot))
+        self._mesh_tp.set(float(getattr(engine, "tp", 1)))
+        if hasattr(engine, "hbm_bytes_per_device"):
+            self._hbm_per_device.set(float(engine.hbm_bytes_per_device))
 
     # -- counter readout (kept as plain ints for callers/tests) ------------
 

@@ -194,6 +194,15 @@ def make_server(
                     "free_slots": scheduler.engine.free_slots,
                     "queue_depth": scheduler.queue_depth,
                     "draining": bool(getattr(scheduler, "draining", False)),
+                    # Mesh topology: a tp-wide sharded replica is ONE
+                    # replica spanning N devices, not N independent ones —
+                    # the router must not multiply its capacity by tp.
+                    "mesh": {
+                        "tp": int(getattr(scheduler.engine, "tp", 1)),
+                        "devices": int(
+                            getattr(scheduler.engine, "mesh_device_count", 1)
+                        ),
+                    },
                 }
                 if getattr(scheduler.engine, "paged", False):
                     # Page capacity is the real admission gate under the

@@ -403,6 +403,11 @@ def main(argv=None):
         "--spec_k", type=int, default=4,
         help="self-serve speculative drafts per verify round (0 = off)",
     )
+    parser.add_argument(
+        "--tp", type=int, default=1,
+        help="self-serve tensor-parallel width (ShardedSlotEngine when "
+        "> 1; needs that many visible devices)",
+    )
     args, _ = parser.parse_known_args(argv)
 
     import random
@@ -500,6 +505,7 @@ def main(argv=None):
             steps_per_sync=args.steps_per_sync,
             page_size=args.page_size,
             spec_k=args.spec_k,
+            tp=args.tp,
         )
         engine, scheduler, metrics, server = build_stack(serve_cfg, cfg, params)
         server.server_close()  # wiring only — loadgen submits directly
@@ -521,6 +527,25 @@ def main(argv=None):
     # the engine recompile after warmup (it must not)?
     slo_status, recompiles, fastpath = _scrape_health(
         targets[0] if targets else "", server)
+    # Serving-mesh topology for the report: self-serve reads the engine,
+    # HTTP mode scrapes /healthz (best-effort — older servers lack it).
+    mesh_info = None
+    if scheduler is not None:
+        eng = scheduler.engine
+        mesh_info = {"tp": int(getattr(eng, "tp", 1)),
+                     "devices": int(getattr(eng, "mesh_device_count", 1))}
+    elif targets:
+        import urllib.error
+        import urllib.request
+        try:
+            try:
+                with urllib.request.urlopen(
+                        targets[0].rstrip("/") + "/healthz", timeout=5) as r:
+                    mesh_info = json.loads(r.read()).get("mesh")
+            except urllib.error.HTTPError as err:  # 503 is still an answer
+                mesh_info = json.loads(err.read()).get("mesh")
+        except Exception:  # noqa: BLE001 — report stays best-effort
+            pass
     if scheduler is not None:
         scheduler.stop()
 
@@ -545,6 +570,7 @@ def main(argv=None):
             for k, v in _percentiles(acct.intertoken_s).items()
         },
         "mode": "open" if args.rate > 0 else "closed",
+        "mesh": mesh_info,
         "slo": slo_status,
         "recompile_events_total": recompiles,
         "prefix_groups": args.prefix_groups,

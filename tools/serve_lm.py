@@ -61,6 +61,7 @@ def build_stack(serve_cfg, cfg, params):
     from distributed_tensorflow_tpu.serve import (
         Scheduler,
         ServingMetrics,
+        ShardedSlotEngine,
         SlotEngine,
     )
     from distributed_tensorflow_tpu.serve.server import make_server
@@ -81,9 +82,19 @@ def build_stack(serve_cfg, cfg, params):
         )
 
         draft_cfg, draft_params, _ = load_lm_bundle(draft_path)
-    engine = SlotEngine(
+    # --tp N > 1: the SAME stack on a TP-partitioned model. Validate the
+    # mesh against the model BEFORE any engine/jit work so a bad tp fails
+    # with the config-level message, and build the sharded engine mode —
+    # scheduler/server/fleet wiring below is byte-identical either way.
+    tp = int(getattr(serve_cfg, "tp", 1))
+    if tp > 1 and hasattr(serve_cfg, "validate_mesh"):
+        serve_cfg.validate_mesh(cfg)
+    engine_cls = SlotEngine if tp <= 1 else ShardedSlotEngine
+    tp_kw = {} if tp <= 1 else {"tp": tp}
+    engine = engine_cls(
         cfg,
         params,
+        **tp_kw,
         slots=serve_cfg.slots,
         max_len=serve_cfg.serve_max_len or None,
         prefill_len=serve_cfg.prefill_len or None,
@@ -204,7 +215,8 @@ def main(argv=None):
     print(
         f"serving on http://{host}:{port}  slots={engine.slots} "
         f"max_len={engine.max_len} prefill_len={engine.prefill_len} "
-        f"kv={kv_desc} compiled={engine.compile_count()}",
+        f"kv={kv_desc} mesh=tp{engine.tp}x{engine.mesh_device_count}dev "
+        f"compiled={engine.compile_count()}",
         flush=True,
     )
 
