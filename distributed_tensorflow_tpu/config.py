@@ -544,6 +544,25 @@ class ServeConfig:
             "d_model % tp == 0, validated before any jit)"
         },
     )
+    weight_dtype: str = field(
+        default="",
+        metadata={
+            "help": "weight-only quantization for serving: '' = the "
+            "bundle's native weights, 'int8' = symmetric per-channel "
+            "(scales factor out of the matmul exactly), 'int4' = "
+            "group-wise along the input axis (needs quant_group_size; "
+            "dequant in-register). Embeddings/norms/lm_head stay "
+            "high-precision (models/quant.py)"
+        },
+    )
+    quant_group_size: int = field(
+        default=0,
+        metadata={
+            "help": "int4 scale-group size along the matmul input axis "
+            "(even, dividing d_model and d_ff — e.g. 32/64/128); must be "
+            "0 for '' / 'int8'"
+        },
+    )
 
     @property
     def lane_weight_tuple(self) -> tuple:
@@ -561,6 +580,23 @@ class ServeConfig:
         error deep inside jit. No-op for ``tp <= 1``."""
         if self.tp > 1:
             validate_tp_mesh(model_cfg, self.tp)
+
+    def validate_quant(self, model_cfg) -> None:
+        """Fail fast on a weight-quantization config the model's shapes
+        cannot satisfy (group-size divisibility, int4-requires-grouping,
+        int4-under-tp group alignment) — the ``validate_mesh`` discipline
+        for the ``weight_dtype``/``quant_group_size`` pair. No-op when
+        quantization is off."""
+        if self.weight_dtype or self.quant_group_size:
+            from distributed_tensorflow_tpu.models.quant import (
+                validate_weight_quant,
+            )
+
+            validate_weight_quant(
+                self.weight_dtype or None, self.quant_group_size,
+                int(model_cfg.d_model), int(model_cfg.d_ff),
+                tp=max(1, int(self.tp)),
+            )
 
 
 def validate_tp_mesh(model_cfg, tp: int) -> None:

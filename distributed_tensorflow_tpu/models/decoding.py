@@ -24,9 +24,11 @@ __all__ = [
     "build_draft_fn",
     "build_generate_fn",
     "decode_step",
+    "filter_logits_batched",
     "init_draft_params",
     "make_draft_config",
     "propose_ngram_drafts",
+    "rejection_verify_row",
     "sample_logits",
     "sample_logits_batched",
 ]
@@ -68,24 +70,23 @@ def sample_logits(logits, key, temperature: float = 0.0,
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
-def sample_logits_batched(logits, keys, temperature, top_k, top_p):
-    """Traced per-row sampling: ``(B, V) logits → (B,) int32 tokens`` with
-    PER-ROW sampling params — the serving engine's slot-batched counterpart
-    of :func:`sample_logits` (whose params are Python scalars resolved at
-    trace time, so one compiled program serves one sampling config).
+def filter_logits_batched(logits, temperature, top_k, top_p):
+    """Tempered + top-k + nucleus FILTERED logits: ``(B, V) → (B, V) f32``
+    with per-row params. This is the sampling distribution's definition,
+    factored out of :func:`sample_logits_batched` so the rejection-sampling
+    speculative verify (:func:`rejection_verify_row`) computes its target
+    probabilities from EXACTLY the same filter — distribution parity
+    between spec and plain sampled decode holds by construction, not by a
+    re-implementation staying in sync.
 
-    ``temperature`` (B,) f32 — rows ``<= 0`` are greedy argmax. ``top_k``
-    (B,) int32 — rows ``< 1`` (or ``>= V``) disable the filter. ``top_p``
-    (B,) f32 — rows outside ``(0, 1]`` disable the filter. ``keys`` is a
-    (B,) batch of PRNG keys (one independent stream per row, so slots
-    sharing a step draw from unrelated streams). Filter semantics match
+    ``temperature`` (B,) f32 — rows ``<= 0`` temper at 1.0 (their callers
+    go greedy and ignore the filtered logits). ``top_k`` (B,) int32 — rows
+    ``< 1`` (or ``>= V``) disable the filter. ``top_p`` (B,) f32 — rows
+    outside ``(0, 1]`` disable the filter. Filter semantics match
     :func:`sample_logits` filter-for-filter (temper, then top-k, then
-    nucleus on the post-top-k distribution), so a single busy slot in the
-    serving engine reproduces ``tools/generate.py``; everything is sorts
-    and wheres — no data-dependent shapes, so the whole thing jits into
-    the engine's fixed decode step."""
+    nucleus on the post-top-k distribution); everything is sorts and
+    wheres — no data-dependent shapes."""
     v = logits.shape[-1]
-    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
     temperature = temperature.astype(jnp.float32)
     safe_t = jnp.where(temperature > 0.0, temperature, 1.0)
     l = logits.astype(jnp.float32) / safe_t[:, None]
@@ -107,9 +108,94 @@ def sample_logits_batched(logits, keys, temperature, top_k, top_p):
     cum = jnp.cumsum(probs, axis=-1)
     n_keep = jnp.sum((cum - probs) < p_eff[:, None], axis=-1, keepdims=True)
     thresh = jnp.take_along_axis(desc, n_keep - 1, axis=-1)
-    l = jnp.where(l < thresh, _NEG_INF, l)
+    return jnp.where(l < thresh, _NEG_INF, l)
+
+
+def sample_logits_batched(logits, keys, temperature, top_k, top_p):
+    """Traced per-row sampling: ``(B, V) logits → (B,) int32 tokens`` with
+    PER-ROW sampling params — the serving engine's slot-batched counterpart
+    of :func:`sample_logits` (whose params are Python scalars resolved at
+    trace time, so one compiled program serves one sampling config).
+
+    Rows with ``temperature <= 0`` are greedy argmax; the rest draw a
+    categorical from :func:`filter_logits_batched`'s filtered logits.
+    ``keys`` is a (B,) batch of PRNG keys (one independent stream per row,
+    so slots sharing a step draw from unrelated streams). A single busy
+    slot in the serving engine reproduces ``tools/generate.py``; the whole
+    thing jits into the engine's fixed decode step."""
+    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+    l = filter_logits_batched(logits, temperature, top_k, top_p)
     sampled = jax.vmap(jax.random.categorical)(keys, l).astype(jnp.int32)
-    return jnp.where(temperature > 0.0, sampled, greedy)
+    return jnp.where(temperature.astype(jnp.float32) > 0.0, sampled, greedy)
+
+
+def rejection_verify_row(filtered_logits, drafts, seed, made):
+    """Lossless rejection-sampling speculative verify for ONE slot's draft
+    block (Leviathan et al. / Chen et al., 2023) — the piece that lets
+    SAMPLED lanes run speculative decode instead of falling back to plain
+    per-token steps.
+
+    ``filtered_logits`` (k+1, V) f32: the TARGET model's logits over the
+    draft block, already passed through :func:`filter_logits_batched` with
+    the slot's sampling params (position ``j`` conditions on the accepted
+    prefix + drafts ``0..j-1``; position ``k`` is the bonus position after
+    all drafts). ``drafts`` (k,) int32. ``seed``/``made`` int32 scalars:
+    the key for the token at emission offset ``j`` is
+    ``fold_in(PRNGKey(seed), made + j)`` — indexed by EMITTED-token count,
+    so rounds consume disjoint key indices (a round emitting ``n`` tokens
+    advances ``made`` by ``n``; draws computed this round at indices
+    ``>= made + n`` are discarded masked lanes and influence nothing).
+
+    The general scheme accepts draft ``i`` with prob ``min(1, p/q)`` and
+    resamples the first rejection from the normalized residual
+    ``max(0, p - q)``. The repo's drafters (n-gram and the learned draft
+    model) propose GREEDILY — ``q`` is a point mass at the drafted token —
+    so this is the degenerate (still lossless) case: accept prob is
+    ``p(draft)`` and the residual is ``p`` with the drafted token zeroed.
+    Each emitted token is marginally an exact draw from ``softmax(
+    filtered_logits)`` — the plain sampled-decode distribution.
+
+    Returns ``(emitted (k+1,) int32, accepts (,) int32)``: ``emitted[j]``
+    for ``j < accepts`` are the accepted drafts, ``emitted[accepts]`` is
+    the residual resample (or the bonus draw when all ``k`` accepted);
+    entries past that are junk the engine's length masking discards."""
+    s, v = filtered_logits.shape  # s = k + 1
+    k = s - 1
+    p = jax.nn.softmax(filtered_logits, axis=-1)
+    base = jax.random.PRNGKey(seed)
+    keys = jax.vmap(lambda j: jax.random.fold_in(base, made + j))(
+        jnp.arange(s, dtype=jnp.int32)
+    )
+    # Two independent streams per key index: sub-key 1 drives the accept
+    # uniform, sub-key 2 the resample/bonus categorical.
+    u = jax.vmap(
+        lambda kj: jax.random.uniform(jax.random.fold_in(kj, 1))
+    )(keys[:k])
+    accept = u < p[jnp.arange(k), drafts]  # q is one-hot: min(1, p/q) = p
+    accepts = jnp.cumprod(accept.astype(jnp.int32)).sum()
+    # Residual at the first rejection: p with the drafted token removed,
+    # renormalized by the categorical. If the rejected draft held ~all the
+    # mass the residual logits are uniformly _NEG_INF and the draw
+    # degenerates to token 0 — a measure-≈0 lane (p(draft) ≈ 1 almost
+    # always accepts).
+    is_draft = jnp.arange(v)[None, :] == drafts[:, None]
+    resid = jnp.where(
+        is_draft, _NEG_INF, jnp.log(jnp.maximum(p[:k], 1e-38))
+    )
+    alt_keys = jax.vmap(lambda kj: jax.random.fold_in(kj, 2))(keys)
+    resampled = jax.vmap(jax.random.categorical)(
+        alt_keys[:k], resid
+    ).astype(jnp.int32)
+    # All k accepted → the bonus position draws from the target directly
+    # (nothing was proposed there, so no residual correction applies).
+    bonus = jax.random.categorical(
+        alt_keys[k], filtered_logits[k]
+    ).astype(jnp.int32)
+    alt = jnp.concatenate([resampled, bonus[None]])
+    drafts_pad = jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)])
+    j = jnp.arange(s)
+    emitted = jnp.where(j < accepts, drafts_pad, alt)
+    return emitted, accepts
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,

@@ -1,0 +1,357 @@
+"""Weight-only quantization for the serving ``TransformerLM``.
+
+The decode fast path is KV/weight-BANDWIDTH bound (~91% of HBM roofline for
+bf16 weights — BASELINE.md decode roofline), so the next rungs of serving
+speed come from moving fewer weight bytes, not from better overlap. This
+module applies the discipline the KV pool already proved (int8 rows +
+f32 scales, dequant in-register — ``transformer.quantize_kv_rows``) to the
+transformer's MATMUL weights:
+
+* **int8, symmetric per-output-channel.** ``W ≈ Q · s`` with ``Q`` int8
+  ``(in, out)`` and ``s`` f32 ``(out,)``. The scale is per OUTPUT column, so
+  it factors out of the contraction EXACTLY::
+
+      x @ (Q * s[None, :]) == (x @ Q) * s
+
+  — the jitted matmul consumes the int8 values directly (cast to the
+  compute dtype in-register; every int in [-127, 127] is exact in bf16)
+  and applies the scale to the (rows, out) RESULT. No dequantized copy of
+  the weight ever materializes in HBM, and the quantization error is the
+  rounding of ``W/s`` alone.
+
+* **int4, group-wise along the input (reduction) axis.** Groups of
+  ``group_size`` input rows share one f32 scale (``gscale`` is
+  ``(in/group_size, out)``), and two int4 values pack per uint8 byte along
+  the input axis (stored as ``value + 8`` nibbles — jnp.int4 is avoided as
+  unreliable on CPU backends). A per-group scale does NOT factor out of the
+  contraction (different addends carry different scales), so the forward
+  unpacks and dequantizes IN-REGISTER — nibble ops + a (groups, gs, out)
+  broadcast multiply that XLA fuses into the matmul's operand read; the
+  f32 weight tile exists only in registers/VMEM, never in HBM.
+
+Only the four per-block matmul projections quantize (``qkv``/``proj``/
+``mlp_in``/``mlp_out`` — the bulk of the bytes); embeddings, LayerNorms,
+``lm_head``, and biases stay high-precision (``quantize_lm_params`` stores
+them bf16 by default — they are a small fraction of bytes and dominate
+quality sensitivity). The quantized tree is a plain pytree (int values +
+f32 scales) that flows through ``SlotEngine``/``ShardedSlotEngine``
+unchanged; ``parallel/rules.py`` carries matching partition rules so
+quantized leaves shard under TP with their scales riding the same axis.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "QuantDense",
+    "dequantize_lm_params",
+    "pack_int4",
+    "quantize_int4_groupwise",
+    "quantize_int8_channelwise",
+    "quantize_lm_params",
+    "tree_bytes",
+    "unpack_int4",
+    "validate_weight_quant",
+]
+
+# The per-block matmul kernels that quantize; everything else stays
+# high-precision. Mirrors the SERVE_TP_RULES name patterns.
+QUANT_KERNEL_RE = re.compile(r"(?:^|/)(?:qkv|proj|mlp_in|mlp_out)/kernel$")
+
+_MODES = ("int8", "int4")
+
+
+def validate_weight_quant(weight_dtype, group_size: int, d_model: int,
+                          d_ff: int, tp: int = 1) -> None:
+    """Config-time validation with actionable errors (the
+    ``validate_tp_mesh`` discipline: say WHAT failed, WHY the constraint
+    exists, and a concrete fix). ``tp`` > 1 additionally checks that each
+    device's shard of the row-parallel kernels is group-aligned."""
+    if weight_dtype in ("", None):
+        if group_size:
+            raise ValueError(
+                f"quant_group_size={group_size} set but weight_dtype is "
+                "unset — group size only applies to int4 weight "
+                "quantization. Set weight_dtype='int4' or drop the group "
+                "size."
+            )
+        return
+    if weight_dtype not in _MODES:
+        raise ValueError(
+            f"weight_dtype must be None, 'int8' or 'int4', got "
+            f"{weight_dtype!r}"
+        )
+    if weight_dtype == "int8":
+        if group_size:
+            raise ValueError(
+                f"quant_group_size={group_size} set with weight_dtype="
+                "'int8' — int8 is strictly per-output-channel (the scale "
+                "factors out of the contraction exactly, so grouping buys "
+                "nothing). Set quant_group_size=0, or use weight_dtype="
+                "'int4' for group-wise quantization."
+            )
+        return
+    # int4: group-wise along the input axis, nibble-packed in pairs.
+    if group_size <= 0:
+        raise ValueError(
+            "weight_dtype='int4' requires quant_group_size > 0 (e.g. 32/"
+            "64/128): 16 levels per-channel loses too much precision, so "
+            "int4 is group-wise along the reduction axis by construction."
+        )
+    if group_size % 2:
+        raise ValueError(
+            f"quant_group_size={group_size} must be even: two int4 values "
+            "pack per uint8 byte along the input axis, and a packed pair "
+            "must not straddle a group boundary."
+        )
+    for dim_name, dim in (("d_model", d_model), ("d_ff", d_ff)):
+        if dim % group_size:
+            divisors = [g for g in range(2, dim + 1, 2) if dim % g == 0]
+            raise ValueError(
+                f"quant_group_size={group_size} does not divide "
+                f"{dim_name}={dim} — int4 groups tile the matmul input "
+                f"axes (d_model and d_ff) exactly. Pick a group size "
+                f"dividing both, e.g. one of {divisors[:8]}."
+            )
+        if tp > 1 and dim % (group_size * tp):
+            raise ValueError(
+                f"int4 under tp={tp} needs {dim_name}={dim} divisible by "
+                f"quant_group_size*tp={group_size * tp}: the row-parallel "
+                f"kernels shard their input axis across 'model', and each "
+                f"device's shard must hold whole scale groups. Shrink the "
+                f"group size or the mesh."
+            )
+
+
+def pack_int4(q):
+    """``(in, out)`` int values in [-8, 7] → ``(in/2, out)`` uint8, two
+    nibbles per byte along the INPUT axis (row ``2k`` in the low nibble,
+    ``2k+1`` in the high nibble), biased by +8 to stay unsigned."""
+    if q.shape[0] % 2:
+        raise ValueError(f"int4 pack needs an even input dim, got {q.shape}")
+    s = (q + 8).astype(jnp.uint8)
+    return s[0::2] | (s[1::2] << 4)
+
+
+def unpack_int4(packed):
+    """Inverse of :func:`pack_int4`: ``(in/2, out)`` uint8 → ``(in, out)``
+    int32 in [-8, 7]. Defensively re-casts to uint8 first so a tree-wide
+    float cast (e.g. ``build_generate_fn``'s ``cast_params``) round-trips —
+    every packed value ≤ 255 is exact in bf16/f32."""
+    p = packed.astype(jnp.uint8)
+    lo = (p & 0xF).astype(jnp.int32) - 8
+    hi = (p >> 4).astype(jnp.int32) - 8
+    return jnp.stack([lo, hi], axis=1).reshape(p.shape[0] * 2, p.shape[1])
+
+
+def quantize_int8_channelwise(w):
+    """``(in, out)`` float kernel → ``(int8 (in, out), f32 scale (out,))``,
+    symmetric absmax per OUTPUT channel (mirrors ``quantize_kv_rows``)."""
+    wf = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=0)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale[None, :]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_int4_groupwise(w, group_size: int):
+    """``(in, out)`` float kernel → ``(packed uint8 (in/2, out), f32 gscale
+    (in/group_size, out))``, symmetric absmax per (input-group, output
+    channel)."""
+    in_f, out = w.shape
+    if in_f % group_size or group_size % 2 or group_size <= 0:
+        raise ValueError(
+            f"group_size {group_size} must be positive, even, and divide "
+            f"the input dim {in_f}"
+        )
+    wg = jnp.asarray(w, jnp.float32).reshape(in_f // group_size, group_size, out)
+    amax = jnp.max(jnp.abs(wg), axis=1)
+    gscale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wg / gscale[:, None, :]), -8, 7).astype(jnp.int32)
+    return pack_int4(q.reshape(in_f, out)), gscale
+
+
+def dequantize_int8(q, scale):
+    return q.astype(jnp.float32) * scale[None, :]
+
+
+def dequantize_int4(packed, gscale, group_size: int):
+    w = unpack_int4(packed)
+    in_f, out = w.shape
+    wg = w.reshape(in_f // group_size, group_size, out).astype(jnp.float32)
+    return (wg * gscale[:, None, :]).reshape(in_f, out)
+
+
+class QuantDense(nn.Module):
+    """Weight-only-quantized drop-in for the transformer's matmul
+    ``nn.Dense`` layers. Parameter leaves (what the rules table and bundle
+    loader see):
+
+    * int8: ``kernel_q`` int8 ``(in, out)`` + ``scale`` f32 ``(out,)``
+    * int4: ``kernel_q`` uint8 ``(in/2, out)`` (packed) + ``gscale`` f32
+      ``(in/group_size, out)``
+    * ``bias`` f32 ``(out,)`` either way (when ``use_bias``)
+
+    Init produces zero weights / unit scales — the module exists to be
+    LOADED (``quantize_lm_params`` output or a ``tools/quantize_lm.py``
+    bundle restored against this template), not trained.
+    """
+
+    features: int
+    mode: str  # 'int8' | 'int4'
+    group_size: int = 0
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_f = x.shape[-1]
+        x = x.astype(self.dtype)
+        contract = (((x.ndim - 1,), (0,)), ((), ()))
+        if self.mode == "int8":
+            kq = self.param(
+                "kernel_q", nn.initializers.zeros, (in_f, self.features),
+                jnp.int8,
+            )
+            scale = self.param(
+                "scale", nn.initializers.ones, (self.features,), jnp.float32
+            )
+            # The per-output-channel scale factors out of the contraction
+            # exactly: run the matmul on the raw int8 values (cast to the
+            # compute dtype in-register — ints ≤ 127 are exact in bf16)
+            # and scale the small (rows, out) RESULT in f32.
+            y = jax.lax.dot_general(
+                x, kq.astype(self.dtype), contract,
+                preferred_element_type=jnp.float32,
+            ) * scale
+        elif self.mode == "int4":
+            if self.group_size <= 0 or in_f % self.group_size:
+                raise ValueError(
+                    f"int4 QuantDense needs group_size dividing the input "
+                    f"dim: {self.group_size} vs {in_f}"
+                )
+            groups = in_f // self.group_size
+            kq = self.param(
+                "kernel_q", nn.initializers.zeros,
+                (in_f // 2, self.features), jnp.uint8,
+            )
+            gscale = self.param(
+                "gscale", nn.initializers.ones, (groups, self.features),
+                jnp.float32,
+            )
+            # Group scales do NOT factor out of the contraction — unpack
+            # and dequantize in-register (XLA fuses the nibble ops and the
+            # broadcast multiply into the matmul operand read; no f32
+            # weight copy lands in HBM).
+            w = unpack_int4(kq).reshape(groups, self.group_size, self.features)
+            w = (w.astype(jnp.float32) * gscale[:, None, :]).reshape(
+                in_f, self.features
+            )
+            y = jax.lax.dot_general(
+                x, w.astype(self.dtype), contract,
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            raise ValueError(f"QuantDense mode must be int8/int4, got "
+                             f"{self.mode!r}")
+        y = y.astype(self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+def _flatten(params):
+    from flax import traverse_util
+
+    return traverse_util.flatten_dict(params)
+
+
+def _unflatten(flat):
+    from flax import traverse_util
+
+    return traverse_util.unflatten_dict(flat)
+
+
+def quantize_lm_params(params, mode: str, group_size: int = 0,
+                       hp_dtype=jnp.bfloat16):
+    """Quantize a ``TransformerLM`` param tree (the BARE ``params`` dict the
+    serving engine holds) for serving under ``TransformerConfig(
+    weight_dtype=mode, quant_group_size=group_size)``.
+
+    The four matmul kernels per block become ``kernel_q`` + ``scale``
+    (int8) or ``kernel_q`` + ``gscale`` (int4); every OTHER floating leaf
+    (embeddings, norms, lm_head, biases) casts to ``hp_dtype`` (bf16 by
+    default — pass ``None`` to keep the stored dtype, e.g. for f32 CPU
+    parity tests)."""
+    if mode not in _MODES:
+        raise ValueError(f"mode must be int8/int4, got {mode!r}")
+    if mode == "int4" and group_size <= 0:
+        raise ValueError("int4 quantization requires group_size > 0")
+    out = {}
+    for path, leaf in _flatten(params).items():
+        name = "/".join(path)
+        if QUANT_KERNEL_RE.search(name):
+            if mode == "int8":
+                q, s = quantize_int8_channelwise(leaf)
+                out[path[:-1] + ("kernel_q",)] = q
+                out[path[:-1] + ("scale",)] = s
+            else:
+                q, s = quantize_int4_groupwise(leaf, group_size)
+                out[path[:-1] + ("kernel_q",)] = q
+                out[path[:-1] + ("gscale",)] = s
+        elif hp_dtype is not None and jnp.issubdtype(
+            jnp.asarray(leaf).dtype, jnp.floating
+        ):
+            out[path] = jnp.asarray(leaf).astype(hp_dtype)
+        else:
+            out[path] = leaf
+    return _unflatten(out)
+
+
+def dequantize_lm_params(qparams, mode: str, group_size: int = 0,
+                         dtype=jnp.float32):
+    """Inverse of :func:`quantize_lm_params` up to rounding error: rebuilds
+    plain ``kernel`` leaves (and casts every float leaf to ``dtype``) so
+    the tree loads into an UNQUANTIZED ``TransformerLM`` — the quality-
+    floor eval path and the tests' reference model."""
+    flat = _flatten(qparams)
+    out = {}
+    for path, leaf in flat.items():
+        if path[-1] == "kernel_q":
+            if mode == "int8":
+                w = dequantize_int8(leaf, flat[path[:-1] + ("scale",)])
+            else:
+                w = dequantize_int4(
+                    leaf, flat[path[:-1] + ("gscale",)], group_size
+                )
+            out[path[:-1] + ("kernel",)] = w.astype(dtype)
+        elif (path[-1] in ("scale", "gscale")
+              and path[:-1] + ("kernel_q",) in flat):
+            # Quant scales (kernel_q siblings) fold into the rebuilt
+            # kernel; LayerNorm 'scale' params pass through below.
+            continue
+        elif jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            out[path] = jnp.asarray(leaf).astype(dtype)
+        else:
+            out[path] = leaf
+    return _unflatten(out)
+
+
+def tree_bytes(params) -> int:
+    """Total stored bytes of a param tree (int values + scales included) —
+    the numerator/denominator of the bench's weight-bytes ratio gates."""
+    return int(
+        sum(
+            jnp.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+    )

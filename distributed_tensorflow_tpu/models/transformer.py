@@ -88,6 +88,16 @@ class TransformerConfig:
     # intermediates, for one extra forward's FLOPs. The standard long-context
     # trade on TPU, where HBM (not MXU) is the bottleneck.
     remat: bool = False
+    # Weight-only quantization for serving (None = plain Dense): the four
+    # per-block matmul projections (qkv/proj/mlp_in/mlp_out) become
+    # ``models.quant.QuantDense`` — int8 per-output-channel (scale factors
+    # out of the contraction exactly) or int4 group-wise along the input
+    # axis (``quant_group_size`` rows per f32 scale, dequant in-register).
+    # Embeddings / norms / lm_head / biases stay high-precision. Decode is
+    # weight-bandwidth bound past the KV wins (BASELINE.md roofline), so
+    # fewer weight bytes is the direct tok/s lever.
+    weight_dtype: str | None = None  # None | 'int8' | 'int4'
+    quant_group_size: int = 0  # int4 only; 0 elsewhere
 
     def __post_init__(self):
         # Every string-enum field that SELECTS behavior is validated here:
@@ -101,6 +111,18 @@ class TransformerConfig:
         if self.kv_cache_dtype not in (None, "int8"):
             raise ValueError(
                 f"kv_cache_dtype must be None or 'int8', got {self.kv_cache_dtype!r}"
+            )
+        if self.weight_dtype is not None or self.quant_group_size:
+            # Lazy import: quant.py is standalone (flax/jax only), but the
+            # module-level import order models/__init__ establishes should
+            # not matter for constructing a config.
+            from distributed_tensorflow_tpu.models.quant import (
+                validate_weight_quant,
+            )
+
+            validate_weight_quant(
+                self.weight_dtype, self.quant_group_size, self.d_model,
+                self.d_ff,
             )
 
     @property
@@ -119,6 +141,26 @@ def quantize_kv_rows(x):
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
     return q, scale
+
+
+def matmul_dense(cfg: TransformerConfig, features: int, name: str):
+    """The four per-block matmul projections (``qkv``/``proj``/``mlp_in``/
+    ``mlp_out``) route through here so ``cfg.weight_dtype`` can swap them
+    for weight-only-quantized layers (``models/quant.py::QuantDense`` —
+    int values + f32 scales, dequant fused into the forward). Embeddings,
+    norms, and ``lm_head`` never do: they are a small fraction of the
+    bytes and dominate quality sensitivity."""
+    if getattr(cfg, "weight_dtype", None):
+        from distributed_tensorflow_tpu.models.quant import QuantDense
+
+        return QuantDense(
+            features, mode=cfg.weight_dtype,
+            group_size=cfg.quant_group_size, dtype=cfg.compute_dtype,
+            use_bias=cfg.use_bias, name=name,
+        )
+    return nn.Dense(
+        features, dtype=cfg.compute_dtype, name=name, use_bias=cfg.use_bias
+    )
 
 
 def _attention_fn(cfg: TransformerConfig, prefer_packed: bool = False) -> Callable:
@@ -202,10 +244,7 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
         )
     group = cfg.num_heads // kv
     # GQA shrinks the fused projection: [q (H·dh) | k (KV·dh) | v (KV·dh)].
-    qkv = nn.Dense(
-        cfg.d_model + 2 * kv * dh, dtype=cfg.compute_dtype, name="qkv",
-        use_bias=cfg.use_bias,
-    )(h)
+    qkv = matmul_dense(cfg, cfg.d_model + 2 * kv * dh, "qkv")(h)
 
     rope = getattr(cfg, "position", "learned") == "rope"
     layout = getattr(attend, "input_layout", "bhsd")
@@ -382,10 +421,7 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
         if quant == "int8":
             cache["k_scale"] = k_scale
             cache["v_scale"] = v_scale
-    attn = nn.Dense(
-        cfg.d_model, dtype=cfg.compute_dtype, name="proj",
-        use_bias=cfg.use_bias,
-    )(attn)
+    attn = matmul_dense(cfg, cfg.d_model, "proj")(attn)
     if cfg.dropout_rate:
         attn = nn.Dropout(cfg.dropout_rate, deterministic=not train)(attn)
     return x + attn, cache
@@ -409,15 +445,9 @@ class Block(nn.Module):
         )
 
         h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(x)
-        h = nn.Dense(
-            cfg.d_ff, dtype=cfg.compute_dtype, name="mlp_in",
-            use_bias=cfg.use_bias,
-        )(h)
+        h = matmul_dense(cfg, cfg.d_ff, "mlp_in")(h)
         h = nn.gelu(h)
-        h = nn.Dense(
-            cfg.d_model, dtype=cfg.compute_dtype, name="mlp_out",
-            use_bias=cfg.use_bias,
-        )(h)
+        h = matmul_dense(cfg, cfg.d_model, "mlp_out")(h)
         if cfg.dropout_rate:
             h = nn.Dropout(cfg.dropout_rate, deterministic=not train)(h)
         x = x + h

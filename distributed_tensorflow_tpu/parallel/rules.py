@@ -62,10 +62,24 @@ TP_TRAIN_RULES = (
 # biases (row-parallel, added after the reduce) and embeddings / norms /
 # lm_head fall through to replicated — the lm_head matmul runs once per
 # emitted token on a (slots, d_model) activation, not worth a collective.
+#
+# Weight-only-quantized leaves (models/quant.py::QuantDense) shard like
+# the kernels they replace, with scales riding the SAME axis:
+#   * column-parallel (qkv/mlp_in): ``kernel_q`` (in[/2 packed], out)
+#     splits the out axis; the per-output-channel int8 ``scale`` (out,)
+#     and the int4 ``gscale`` (groups, out) ride the out shard.
+#   * row-parallel (proj/mlp_out): ``kernel_q`` splits the input axis —
+#     int4 packed pairs and scale groups stay intact on one device
+#     because ServeConfig validation pins group_size | dim/tp; ``gscale``
+#     (groups, out) rides the group (input) shard. The int8 per-output
+#     ``scale`` multiplies AFTER the all-reduce, so it falls through to
+#     replicated with the row-parallel biases.
 SERVE_TP_RULES = (
-    (r"(?:^|/)(?:qkv|mlp_in)/kernel$", P(None, "model")),
-    (r"(?:^|/)(?:qkv|mlp_in)/bias$", P("model")),
-    (r"(?:^|/)(?:proj|mlp_out)/kernel$", P("model", None)),
+    (r"(?:^|/)(?:qkv|mlp_in)/kernel(?:_q)?$", P(None, "model")),
+    (r"(?:^|/)(?:qkv|mlp_in)/(?:bias|scale)$", P("model")),
+    (r"(?:^|/)(?:qkv|mlp_in)/gscale$", P(None, "model")),
+    (r"(?:^|/)(?:proj|mlp_out)/kernel(?:_q)?$", P("model", None)),
+    (r"(?:^|/)(?:proj|mlp_out)/gscale$", P("model", None)),
     (r".*", P()),
 )
 

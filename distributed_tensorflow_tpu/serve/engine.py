@@ -35,17 +35,25 @@ the compile count must never grow — the ``RecompileSentinel`` contract):
   boundary page — never a shared prefix page, since writes land at
   positions ``>= p``).
 
-* **speculative verify** (``spec_k > 0``, greedy rounds only) — the host
-  drafts ``spec_k`` tokens by prompt-lookup (n-gram continuation of the
-  slot's own history; ``models/decoding.propose_ngram_drafts``) and ONE
-  forward of ``[cur_tok, d_0..d_{k-1}]`` verifies them. With greedy
-  selection the emitted stream is ``targets[:a+1]`` where ``targets`` are
-  the argmax outputs and ``a`` counts leading ``d_i == targets[i]``
-  matches: each accepted draft equals the token greedy decoding would
-  have fed, so by induction the output is TOKEN-IDENTICAL to the plain
-  path — speculation changes latency, never content. Rejected drafts
-  leave stale KV above the accepted length, which the overwrite
-  invariant below already makes unreadable.
+* **speculative verify** (``spec_k > 0``, two compiled variants) — the
+  host drafts ``spec_k`` tokens by prompt-lookup (n-gram continuation of
+  the slot's own history; ``models/decoding.propose_ngram_drafts``) and
+  ONE forward of ``[cur_tok, d_0..d_{k-1}]`` verifies them. All-greedy
+  rounds run the greedy variant: the emitted stream is ``targets[:a+1]``
+  where ``targets`` are the argmax outputs and ``a`` counts leading
+  ``d_i == targets[i]`` matches — each accepted draft equals the token
+  greedy decoding would have fed, so by induction the output is
+  TOKEN-IDENTICAL to the plain path. Rounds with any sampled lane run
+  the rejection-sampling variant (``models/decoding.
+  rejection_verify_row``): draft ``i`` is accepted with probability
+  ``min(1, p/q)`` against the target's FILTERED distribution (same
+  ``filter_logits_batched`` as the plain sampled step) and the first
+  rejection resamples from the normalized residual — each emitted token
+  is an exact draw from the plain sampled-decode distribution, so
+  speculation changes latency, never content (greedy) or the output
+  DISTRIBUTION (sampled). Rejected drafts leave stale KV above the
+  accepted length, which the overwrite invariant below already makes
+  unreadable.
 
 * **chunked prefill** (``prefill_chunk_tokens > 0``, paged only) — a
   prompt whose post-adoption tail exceeds the chunk width is fed across
@@ -102,8 +110,10 @@ import numpy as np
 from distributed_tensorflow_tpu.models.decoding import (
     build_draft_fn,
     decode_step,
+    filter_logits_batched,
     init_cache,
     propose_ngram_drafts,
+    rejection_verify_row,
     sample_logits_batched,
 )
 from distributed_tensorflow_tpu.models.transformer import TransformerLM
@@ -305,6 +315,7 @@ class SlotEngine:
             "spec_drafts_accepted_model": 0,
             "spec_drafts_proposed_model": 0,
             "spec_rounds": 0,
+            "spec_rounds_sampled": 0,
             "plain_rounds": 0,
             "prefill_chunks": 0,
             "prefill_tokens_last_iter": 0,
@@ -527,27 +538,41 @@ class SlotEngine:
                 )
             return jnp.argmax(logits, -1).astype(jnp.int32)
 
-        def make_spec():
+        def make_spec(rs: bool):
             S = self.spec_k + 1
 
             def spec_fn(
                 pool_layers, params, ptabs, active, lengths, tok, drafts,
-                made, budget, eos,
+                temp, top_k, top_p, seed, made, budget, eos,
             ):
-                """One speculative verify round (greedy slots only). Feeds
+                """One speculative verify round. Feeds
                 ``[cur_tok, d_0..d_{k-1}]`` (S tokens) per slot in ONE
                 forward; ``targets = argmax(logits)`` are the greedy
-                continuations after each fed token. With ``a`` = leading
-                ``d_i == targets[i]`` matches, the emitted stream is
-                ``targets[:a+1]`` — token-identical to ``a+1`` plain
-                rounds, because each accepted draft IS the token the plain
-                path would have fed next. KV for all S positions is
-                written (then truncated by moving ``lengths`` up only
-                ``n_final``): rejected rows sit above the filled length —
-                stale-until-overwritten, per the module invariant. The
-                whole table row scatters back (shared prefix pages get
-                byte-identical values; overrun past the slot's bound pages
-                lands in trash)."""
+                continuations after each fed token.
+
+                Greedy lanes (and the whole ``rs=False`` variant): with
+                ``a`` = leading ``d_i == targets[i]`` matches, the emitted
+                stream is ``targets[:a+1]`` — token-identical to ``a+1``
+                plain rounds, because each accepted draft IS the token the
+                plain path would have fed next.
+
+                Sampled lanes (``rs=True`` variant, rows with
+                ``temp > 0``): rejection-sampling verify
+                (``models/decoding.rejection_verify_row``) over the SAME
+                forward's logits, filtered with the slot's sampling params
+                by the SAME ``filter_logits_batched`` the plain path uses
+                — each emitted token is an exact draw from the plain
+                sampled-decode distribution (lossless speculation), and
+                ``a`` counts the accepted drafts.
+
+                Either way the emitted count is ``a + 1`` before budget /
+                eos truncation, so the KV bookkeeping is shared: all S
+                positions are written (then truncated by moving
+                ``lengths`` up only ``n_final``) — rejected rows sit above
+                the filled length, stale-until-overwritten, per the module
+                invariant. The whole table row scatters back (shared
+                prefix pages get byte-identical values; overrun past the
+                slot's bound pages lands in trash)."""
 
                 def one(row, length, t, d):
                     cache = gather_cache(pool_layers, row, length)
@@ -555,43 +580,60 @@ class SlotEngine:
                     logits, cache = model.apply(
                         {"params": params}, x, cache=cache
                     )
-                    targets = jnp.argmax(logits[0], -1).astype(jnp.int32)
                     pages = [
                         {k: split_pages(v[0]) for k, v in l.items()}
                         for l in cache["layers"]
                     ]
-                    return pages, targets
+                    return pages, logits[0]
 
-                pages, targets = jax.vmap(one)(ptabs, lengths, tok, drafts)
+                pages, logits = jax.vmap(one)(ptabs, lengths, tok, drafts)
+                targets = jnp.argmax(logits, -1).astype(jnp.int32)
                 dest = jnp.where(active[:, None], ptabs, TRASH_PAGE)
                 new_pool = [
                     {k: pl[k].at[dest].set(pages[li][k]) for k in pl}
                     for li, pl in enumerate(pool_layers)
                 ]
-                # Acceptance: longest matching draft prefix, then budget /
-                # eos truncation on the accepted stream.
+                # Acceptance: longest accepted draft prefix, then budget /
+                # eos truncation on the emitted stream E.
                 match = drafts == targets[:, : S - 1]  # (slots, S-1)
                 lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
                 a = lead.sum(axis=1)  # (slots,) accepted drafts
+                E = targets  # (slots, S) emitted stream candidates
+                if rs:
+                    def verify(lg, d, tm, tk, tp_, sd, md):
+                        filt = filter_logits_batched(
+                            lg,
+                            jnp.full((S,), tm),
+                            jnp.full((S,), tk, jnp.int32),
+                            jnp.full((S,), tp_),
+                        )
+                        return rejection_verify_row(filt, d, sd, md)
+
+                    E_rs, a_rs = jax.vmap(verify)(
+                        logits, drafts, temp, top_k, top_p, seed, made
+                    )
+                    is_sampled = temp > 0.0
+                    a = jnp.where(is_sampled, a_rs, a)
+                    E = jnp.where(is_sampled[:, None], E_rs, E)
                 n0 = a + 1  # candidate emit count
                 n1 = jnp.minimum(n0, budget - made)
                 idx = jnp.arange(S)[None, :]
-                eos_in = (targets == eos[:, None]) & (idx < n1[:, None])
+                eos_in = (E == eos[:, None]) & (idx < n1[:, None])
                 any_eos = eos_in.any(axis=1)
                 first_eos = jnp.argmax(eos_in, axis=1)
                 n_final = jnp.where(any_eos, first_eos + 1, n1)
                 n_final = jnp.where(active, n_final, 0)
                 new_lengths = lengths + n_final
                 new_made = made + n_final
-                rows = jnp.arange(targets.shape[0])
+                rows = jnp.arange(E.shape[0])
                 last = jnp.clip(n_final - 1, 0, S - 1)
-                new_tok = jnp.where(active, targets[rows, last], tok)
+                new_tok = jnp.where(active, E[rows, last], tok)
                 finished = active & ((new_made >= budget) | any_eos)
                 valid = (idx < n_final[:, None]) & active[:, None]
                 accepted = jnp.where(active, jnp.minimum(a, n_final - 1), 0)
                 return (
                     new_pool, active & ~finished, new_lengths, new_tok,
-                    new_made, targets.T, valid.T, accepted,
+                    new_made, E.T, valid.T, accepted,
                 )
 
             return spec_fn
@@ -619,7 +661,15 @@ class SlotEngine:
             make_step(True), "step", step_donate
         )
         self._spec = (
-            self._jit_program(make_spec(), "spec", (0,))
+            self._jit_program(make_spec(rs=False), "spec", (0,))
+            if self.spec_k
+            else None
+        )
+        # The rejection-sampling variant serves rounds with ANY sampled
+        # lane (its `where` handles mixed greedy rows); the greedy variant
+        # keeps all-greedy rounds free of the filter's full-vocab sorts.
+        self._spec_rs = (
+            self._jit_program(make_spec(rs=True), "spec", (0,))
             if self.spec_k
             else None
         )
@@ -1044,7 +1094,6 @@ class SlotEngine:
         any_sampled = bool((self.temp[self.active] > 0.0).any())
         if (
             self.spec_k
-            and not any_sampled
             and not self._force_plain
             # Verify writes S positions starting at each slot's length; a
             # slot within spec_k+1 of max_len would clamp the write — fall
@@ -1054,7 +1103,7 @@ class SlotEngine:
                  <= self.max_len).all()
             )
         ):
-            return self._spec_round()
+            return self._spec_round(any_sampled)
         self.stats["plain_rounds"] += 1
         step = self._step_sampled if any_sampled else self._step_greedy
         if self.paged:
@@ -1074,17 +1123,23 @@ class SlotEngine:
         return self._finish_round(layers, active, lengths, tok, made,
                                   toks, valid)
 
-    def _spec_round(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def _spec_round(
+        self, any_sampled: bool = False
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         drafts = self._propose_drafts()
-        out = self._spec(
+        spec = self._spec_rs if any_sampled else self._spec
+        out = spec(
             self.pool.layers, self.params, self.pool.page_tables,
-            self.active, self.lengths, self.cur_tok, drafts, self.made,
-            self.budget, self.eos,
+            self.active, self.lengths, self.cur_tok, drafts, self.temp,
+            self.top_k, self.top_p, self.seed, self.made, self.budget,
+            self.eos,
         )
         layers, active, lengths, tok, made, toks, valid, accepted = out
         proposed = int(self.active.sum()) * self.spec_k
         accepted_n = int(np.asarray(accepted).sum())
         self.stats["spec_rounds"] += 1
+        if any_sampled:
+            self.stats["spec_rounds_sampled"] += 1
         self.stats["spec_drafts_proposed"] += proposed
         self.stats["spec_drafts_accepted"] += accepted_n
         self.stats[f"spec_drafts_proposed_{self.drafter}"] += proposed
@@ -1152,15 +1207,24 @@ class SlotEngine:
         ``__graft_entry__``'s collective-count asserts; asserted under
         churn in ``tests/test_serve_engine.py``). Covers: greedy prefill +
         PLAIN greedy step (forced even when speculation is on — the spec
-        path falls back to it near max_len), the speculative verify
-        program (which also compiles the learned-draft program when one
-        is loaded), the sampled prefill/step pair, every prefill bucket
-        width, and — when chunked prefill can trigger — one chunked
-        prompt driven to completion (chunk calls reuse the bucket
-        programs, so this compiles nothing new; it asserts that)."""
+        path falls back to it near max_len), BOTH speculative verify
+        variants (greedy and rejection-sampling; the greedy pass also
+        compiles the learned-draft program when one is loaded), the
+        sampled prefill/step pair (the plain sampled step forced the
+        same way when speculation is on), every prefill bucket width,
+        and — when chunked prefill can trigger — one chunked prompt
+        driven to completion (chunk calls reuse the bucket programs, so
+        this compiles nothing new; it asserts that)."""
         passes: list[dict] = [{"temperature": 0.0, "_plain": True}]
         if self.spec_k:
             passes.append({"temperature": 0.0})
+            # Sampled lanes take the spec path too (rejection-sampling
+            # verify), so the plain sampled step needs its own forced
+            # pass — it still serves the end-of-window fallback rounds.
+            passes.append(
+                {"temperature": 1.0, "top_k": 2, "top_p": 0.9,
+                 "_plain": True}
+            )
         passes.append({"temperature": 1.0, "top_k": 2, "top_p": 0.9})
         for kwargs in passes:
             force = kwargs.pop("_plain", False)
@@ -1182,16 +1246,25 @@ class SlotEngine:
         # The passes above prefilled through the SMALLEST bucket (p=1);
         # compile the remaining widths too — a length-b throwaway prompt
         # forces bucket b exactly, and max_new=1 finishes at start() so
-        # only the prefill programs are exercised.
-        for width in self.prefill_buckets[1:]:
-            p_warm = min(width, self.max_len - 1)
-            for kwargs in ({}, {"temperature": 1.0, "top_k": 2}):
-                slot = self.acquire_slot()
-                try:
-                    self.start(slot, [0] * p_warm, max_new_tokens=1,
-                               seed=0, **kwargs)
-                finally:
-                    self.release(slot)
+        # only the prefill programs are exercised. Adoption is disabled
+        # for these passes: the greedy pass would otherwise insert its
+        # [0]*width pages and the identical SAMPLED prompt would adopt
+        # them and prefill through a smaller tail bucket, leaving the
+        # full-width sampled prefill uncompiled (first sampled
+        # prefill_len-wide prompt in traffic would then recompile).
+        prefix, self.prefix = self.prefix, None
+        try:
+            for width in self.prefill_buckets[1:]:
+                p_warm = min(width, self.max_len - 1)
+                for kwargs in ({}, {"temperature": 1.0, "top_k": 2}):
+                    slot = self.acquire_slot()
+                    try:
+                        self.start(slot, [0] * p_warm, max_new_tokens=1,
+                                   seed=0, **kwargs)
+                    finally:
+                        self.release(slot)
+        finally:
+            self.prefix = prefix
         if self.paged and 0 < self.prefill_chunk_tokens < self.max_len - 1:
             # One chunked prompt per sampling variant, driven through
             # step() to completion (budget 1 finishes at the final chunk).
@@ -1229,6 +1302,8 @@ class SlotEngine:
                self._step_greedy, self._step_sampled]
         if self._spec is not None:
             fns.append(self._spec)
+        if self._spec_rs is not None:
+            fns.append(self._spec_rs)
         if self._draft is not None:
             fns.append(self._draft)
         own = sum(
@@ -1249,6 +1324,41 @@ class SlotEngine:
         the pool's kv-head axis ``tp`` ways; everything else about the
         pool (page tables, accounting) is host-side and free."""
         return int(self.pool.hbm_bytes) // max(1, self.tp)
+
+    @property
+    def weight_dtype(self) -> str:
+        """Weight quantization mode serving this replica: ``'int8'`` /
+        ``'int4'`` (``models/quant.py`` trees) or ``'native'`` for the
+        stored high-precision weights. Surfaced through ``/healthz`` and
+        the fleet registry so the router can tell variants apart."""
+        return getattr(self.cfg, "weight_dtype", None) or "native"
+
+    @property
+    def draft_weight_dtype(self) -> str:
+        """Quantization mode of the learned drafter (``''`` when the
+        engine runs the host n-gram drafter — it has no weights). The
+        issue contract quantizes the drafter HARDER than the target
+        (int4 drafter over int8 target); this label lets dashboards
+        verify that pairing per replica."""
+        if self.draft_cfg is None:
+            return ""
+        return getattr(self.draft_cfg, "weight_dtype", None) or "native"
+
+    @property
+    def weight_bytes_per_device(self) -> int:
+        """Target-model weight bytes RESIDENT per device (the drafter is
+        accounted separately — it is small by construction). For sharded
+        leaves the per-device share is the mean addressable-shard size
+        (each mesh device holds exactly one shard: a split leaf counts
+        ``nbytes/tp``, a replicated one full ``nbytes``)."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                total += sum(sh.data.nbytes for sh in shards) // len(shards)
+            else:
+                total += leaf.nbytes
+        return int(total)
 
 
 class ShardedSlotEngine(SlotEngine):
@@ -1305,6 +1415,17 @@ class ShardedSlotEngine(SlotEngine):
                 "use SlotEngine for a single-device replica"
             )
         validate_tp_mesh(cfg, tp)
+        if getattr(cfg, "weight_dtype", None):
+            from distributed_tensorflow_tpu.models.quant import (
+                validate_weight_quant,
+            )
+
+            # TP adds a constraint config-time validation can't know: the
+            # row-parallel int4 shards must hold whole scale groups.
+            validate_weight_quant(
+                cfg.weight_dtype, cfg.quant_group_size, cfg.d_model,
+                cfg.d_ff, tp=tp,
+            )
         page_size = kw.get("page_size")
         if page_size is not None and page_size <= 0:
             raise ValueError(
@@ -1365,10 +1486,10 @@ class ShardedSlotEngine(SlotEngine):
             ins = (kvs, psh) + (rep,) * 11
             outs = (kvs,) + (rep,) * 6
         elif kind == "spec":
-            # (pool, params, ptabs, active, lengths, tok, drafts, made,
-            #  budget, eos) -> (pool, active, lengths, tok, made,
-            #  targets.T, valid.T, accepted)
-            ins = (kvs, psh) + (rep,) * 8
+            # (pool, params, ptabs, active, lengths, tok, drafts, temp,
+            #  top_k, top_p, seed, made, budget, eos) -> (pool, active,
+            #  lengths, tok, made, emitted.T, valid.T, accepted)
+            ins = (kvs, psh) + (rep,) * 12
             outs = (kvs,) + (rep,) * 7
         else:  # pragma: no cover - new kinds must be wired explicitly
             raise ValueError(f"unknown program kind {kind!r}")

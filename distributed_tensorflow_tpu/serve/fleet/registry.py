@@ -69,7 +69,9 @@ class ProbeResult:
     shed_total: float = 0.0
     tp: int = 1              # tensor-parallel width of the replica's mesh
     devices: int = 1         # devices it spans — a tp-wide replica is ONE
-    detail: str = ""         # replica, not tp independent ones
+    #                          replica, not tp independent ones
+    weight_dtype: str = ""   # 'native'/'int8'/'int4' weight quantization
+    detail: str = ""
 
 
 @dataclass
@@ -115,6 +117,7 @@ def http_probe(base_url: str, timeout_s: float = 2.0) -> ProbeResult:
                    if body.get("slots") else 0.0),
         tp=int(body.get("mesh", {}).get("tp", 1)),
         devices=int(body.get("mesh", {}).get("devices", 1)),
+        weight_dtype=str(body.get("weight_dtype", "")),
     )
     try:
         with urllib.request.urlopen(
@@ -354,6 +357,7 @@ class ReplicaRegistry:
                         "slots": r.last.slots,
                         "tp": r.last.tp,
                         "devices": r.last.devices,
+                        "weight_dtype": r.last.weight_dtype,
                         "shed_total": r.last.shed_total,
                         "dispatched_total": r.dispatched_total,
                         "error_total": r.error_total,

@@ -203,6 +203,11 @@ def make_server(
                             getattr(scheduler.engine, "mesh_device_count", 1)
                         ),
                     },
+                    # Weight quantization mode ('native'/'int8'/'int4') —
+                    # the router tells quantized variants apart by this.
+                    "weight_dtype": str(
+                        getattr(scheduler.engine, "weight_dtype", "native")
+                    ),
                 }
                 if getattr(scheduler.engine, "paged", False):
                     # Page capacity is the real admission gate under the

@@ -1085,6 +1085,13 @@ def load_lm_bundle(path: str, fallback_shapes: dict | None = None):
         num_layers=dim("num_layers", 4),
         d_ff=dim("d_ff", 512),
         max_seq_len=dim("max_seq_len", 128),
+        # Quantized bundles (tools/quantize_lm.py): the mode must ride the
+        # metadata so the init template below grows the matching
+        # kernel_q/scale leaf structure — from_state_dict restores by
+        # structure, and int leaves cannot load into a float-kernel tree.
+        weight_dtype=(shape_meta.get("weight_dtype")
+                      or fb.get("weight_dtype") or None),
+        quant_group_size=dim("quant_group_size", 0),
         compute_dtype=jnp.bfloat16
         if jax.default_backend() == "tpu"
         else jnp.float32,

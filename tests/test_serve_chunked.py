@@ -9,9 +9,9 @@ requires byte-identical streams. Around it: the scheduler interleave
 property (decode rows keep landing while a long prefill is in flight),
 page accounting through the chunked admission path (bind-up-front,
 ``pages_bound == pages_needed``, all returned on release), draft-model
-spec parity under eos/budget truncation and the sampled-lane fallback,
-``build_draft_fn`` shape/validation units, and a tiny in-process
-``distill`` smoke.
+spec parity under eos/budget truncation plus sampled lanes on the
+rejection-sampling verify path, ``build_draft_fn`` shape/validation
+units, and a tiny in-process ``distill`` smoke.
 """
 
 import jax
@@ -274,9 +274,9 @@ def test_chunked_page_accounting(params):
 @pytest.mark.spec
 def test_model_spec_parity_under_eos_budget_and_sampling(params, draft):
     """Learned-drafter rounds must match the no-spec engine exactly under
-    eos/budget truncation, and a sampled request in the batch must force
-    the plain fallback (spec rounds are greedy-only) without corrupting
-    either stream's length accounting."""
+    eos/budget truncation, and sampled requests must take the
+    rejection-sampling verify path (spec rounds are no longer
+    greedy-only) without corrupting either stream's length accounting."""
     dcfg, dparams = draft
     rng = np.random.default_rng(3)
     prompts = [rng.integers(1, 64, int(n)).tolist() for n in (5, 21, 35)]
@@ -307,8 +307,10 @@ def test_model_spec_parity_under_eos_budget_and_sampling(params, draft):
     assert spec.stats["spec_drafts_proposed_model"] > 0
     assert spec.stats["spec_drafts_proposed_ngram"] == 0
 
-    # Sampled lane: every spec round must fall back to plain (verify is
-    # greedy-only); the engine still completes both requests.
+    # Sampled lanes: spec rounds now run the rejection-sampling verify
+    # (PR 11) instead of falling back to plain decode — the rounds are
+    # counted as sampled spec rounds, every stream still completes at its
+    # exact budget, and the tokens stay in-vocab.
     spec2 = SlotEngine(CFG, params, slots=2, max_len=64, prefill_len=16,
                        page_size=8, prefill_chunk_tokens=8, spec_k=4,
                        draft_params=dparams, draft_cfg=dcfg)
@@ -318,12 +320,17 @@ def test_model_spec_parity_under_eos_budget_and_sampling(params, draft):
         (prompts[1], {"max_new_tokens": 8, "temperature": 1.0,
                       "top_k": 4, "seed": 8}),
     ]
-    spec2.warmup()  # warmup's own greedy pass takes one spec round
-    spec_rounds0 = spec2.stats["spec_rounds"]
+    spec2.warmup()
+    rounds0 = spec2.stats["spec_rounds_sampled"]
+    compiles = spec2.compile_count()
     out = _drive(spec2, mixed, warm=False)
     assert all(len(out[i]) == 8 for i in range(2))
-    assert spec2.stats["spec_rounds"] == spec_rounds0, (
-        "sampled lanes must not take the greedy verify path"
+    assert all(0 <= t < CFG.vocab_size for s in out.values() for t in s)
+    assert spec2.stats["spec_rounds_sampled"] > rounds0, (
+        "sampled lanes must run the rejection-sampling verify path"
+    )
+    assert spec2.compile_count() == compiles, (
+        "sampled spec rounds recompiled after warmup"
     )
 
 

@@ -165,3 +165,38 @@ def test_bench_serving_smoke_meets_floor():
     assert speedup["value"] >= 1.5, speedup
     assert "0 recompiles after warmup" in recs["serve_throughput_tok_s"]["detail"]
     assert recs["serve_p99_ttft_ms"]["value"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.quant
+def test_bench_serving_quant_smoke_meets_gates():
+    """PR 11's bench phase end-to-end on the smoke shape: byte ratios
+    under the FRAC_CEILS, quality deltas under the nats ceilings, the
+    int8 engine beating its own sequential baseline (noise-margin gate,
+    as above — the strict 2.6 lives in bench.FLOORS), and the sampled-
+    lane RS accept metric present with its in-run asserts (0 recompiles,
+    spec_rounds_sampled > 0) having held."""
+    env = {**os.environ, "BENCH_SMOKE": "1", "JAX_PLATFORMS": "cpu",
+           "DTF_COMPILATION_CACHE": "0"}
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import json, bench; "
+         "print(json.dumps(bench.bench_serving_quant()))"],
+        # The quant phase pays two engine warmups + two quantize passes on
+        # top of the distill bench_serving also pays — 560s is too tight
+        # on a contended box.
+        cwd=_REPO, capture_output=True, text=True, timeout=900, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    recs = {r["metric"]: r for r in json.loads(out.stdout.splitlines()[-1])}
+    import bench
+    for mode in ("int8", "int4"):
+        byte_rec = recs[f"serve_weight_bytes_per_device_{mode}"]
+        assert byte_rec["frac"] <= bench.FRAC_CEILS[byte_rec["metric"]], byte_rec
+        loss_rec = recs[f"serve_quant_evalloss_delta_{mode}"]
+        assert loss_rec["frac"] <= bench.FRAC_CEILS[loss_rec["metric"]], loss_rec
+    assert recs["serve_speedup_vs_sequential_int8"]["value"] >= 1.5
+    rs = recs["serve_spec_accept_rate_sampled"]
+    assert 0.0 <= rs["value"] <= 1.0
+    assert "sampled spec rounds" in rs["detail"]

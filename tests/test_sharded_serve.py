@@ -119,7 +119,7 @@ _VARIANTS = {
          {"max_new_tokens": b})
         for t, b in ((3, 8), (5, 6), (2, 10), (4, 7))
     ],
-    # sampled lanes (spec falls back to plain rounds) mixed with greedy
+    # sampled lanes (rejection-sampling verify rounds) mixed with greedy
     "sampled": [
         (_RNG.integers(1, 64, 9).tolist(),
          {"max_new_tokens": 8, "temperature": 0.8, "top_k": 16, "seed": 1}),
@@ -237,6 +237,32 @@ def test_serve_rules_on_real_param_tree(params):
     assert specs["lm_head"]["kernel"] == P()
 
 
+@pytest.mark.quant
+@pytest.mark.parametrize("mode,gs", [("int8", 0), ("int4", 16)])
+def test_serve_rules_on_quantized_param_tree(params, mode, gs):
+    """Quantized leaves shard like the kernels they replace: column-parallel
+    scales ride the out axis, int4 group scales ride their kernel's layout,
+    and the row-parallel int8 scale stays replicated (it multiplies AFTER
+    the tp all-reduce)."""
+    from distributed_tensorflow_tpu.models.quant import quantize_lm_params
+
+    qparams = quantize_lm_params(params, mode, group_size=gs, hp_dtype=None)
+    specs = match_partition_rules(SERVE_TP_RULES, qparams)
+    b0 = specs["block_0"]
+    assert b0["qkv"]["kernel_q"] == P(None, "model")
+    assert b0["mlp_in"]["kernel_q"] == P(None, "model")
+    assert b0["proj"]["kernel_q"] == P("model", None)
+    assert b0["mlp_out"]["kernel_q"] == P("model", None)
+    if mode == "int8":
+        assert b0["qkv"]["scale"] == P("model")
+        assert b0["proj"]["scale"] == P()  # applied after the all-reduce
+    else:
+        assert b0["qkv"]["gscale"] == P(None, "model")
+        assert b0["proj"]["gscale"] == P("model", None)
+    assert specs["tok_embed"]["embedding"] == P()
+    assert specs["lm_head"]["kernel"] == P()
+
+
 def test_tp_train_rules_match_tp_param_specs():
     """The rules table IS tensor_parallel.tp_param_specs now — the fold
     must be observationally identical on a TpTransformerLM-shaped tree."""
@@ -281,6 +307,39 @@ def test_serve_config_rejects_tp_not_dividing_d_model():
     assert ServeConfig(tp=1).validate_mesh(shapes) is None
 
 
+@pytest.mark.quant
+def test_serve_config_validate_quant():
+    """Config-time quant validation, beside the tp-mesh checks it mirrors:
+    every rejection names the offending flag pair and what would fix it."""
+    # off = no-op, whatever the shapes
+    assert ServeConfig().validate_quant(CFG) is None
+    # group_size without a mode: nothing to group
+    with pytest.raises(ValueError, match="quant_group_size"):
+        ServeConfig(quant_group_size=16).validate_quant(CFG)
+    # int8 is per-channel — grouping does not apply
+    with pytest.raises(ValueError, match="int8"):
+        ServeConfig(weight_dtype="int8",
+                    quant_group_size=16).validate_quant(CFG)
+    # int4 requires a group size...
+    with pytest.raises(ValueError, match="group"):
+        ServeConfig(weight_dtype="int4").validate_quant(CFG)
+    # ...that divides both matmul reduction dims (d_model=32, d_ff=64)
+    with pytest.raises(ValueError, match="divide"):
+        ServeConfig(weight_dtype="int4",
+                    quant_group_size=24).validate_quant(CFG)
+    # unknown mode names the accepted ones
+    with pytest.raises(ValueError, match="int8"):
+        ServeConfig(weight_dtype="fp8").validate_quant(CFG)
+    # int4 under tp: per-shard reduction dims must still group evenly
+    with pytest.raises(ValueError, match="tp"):
+        ServeConfig(weight_dtype="int4", quant_group_size=32,
+                    tp=2).validate_quant(CFG)
+    # valid configs pass
+    assert ServeConfig(weight_dtype="int8").validate_quant(CFG) is None
+    assert ServeConfig(weight_dtype="int4",
+                       quant_group_size=16).validate_quant(CFG) is None
+
+
 # -- healthz / registry topology -------------------------------------------
 
 
@@ -306,9 +365,11 @@ def test_healthz_and_probe_report_mesh(engines):
             with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
                 body = json.loads(r.read())
             assert body["mesh"] == {"tp": want_tp, "devices": want_tp}
+            assert body["weight_dtype"] == "native"  # CFG is unquantized
             probe = http_probe(base, timeout_s=10.0)
             assert probe.ok and probe.tp == want_tp
             assert probe.devices == want_tp
+            assert probe.weight_dtype == "native"
         finally:
             server.shutdown()
             server.server_close()

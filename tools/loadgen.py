@@ -232,7 +232,8 @@ def _scrape_health(url, server):
     router. Never raises — a server without the endpoints just yields
     nulls."""
     fastpath = {"prefix_hit_rate": None, "spec_accept_rate": None,
-                "spec_accept_rate_by_drafter": {}}
+                "spec_accept_rate_by_drafter": {},
+                "weight_dtype": None, "weight_bytes_per_device": None}
     if url:
         import urllib.request
 
@@ -261,6 +262,19 @@ def _scrape_health(url, server):
                     drafter = sample.get("labels", {}).get("drafter", "?")
                     fastpath["spec_accept_rate_by_drafter"][drafter] = float(
                         sample["value"])
+                elif sample["name"] == "serve_weight_bytes_per_device":
+                    fastpath["weight_bytes_per_device"] = int(sample["value"])
+        except Exception:
+            pass
+        # Quant mode rides /healthz (it is a string — no Prometheus home).
+        try:
+            import urllib.error
+            try:
+                with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+                    body = json.loads(r.read())
+            except urllib.error.HTTPError as err:  # 503 is still an answer
+                body = json.loads(err.read())
+            fastpath["weight_dtype"] = body.get("weight_dtype")
         except Exception:
             pass
         return slo, recompiles, fastpath
@@ -277,8 +291,12 @@ def _scrape_health(url, server):
     if metrics is not None:
         fastpath["prefix_hit_rate"] = float(metrics.prefix_hit_rate)
         fastpath["spec_accept_rate"] = float(metrics.spec_accept_rate)
+        snap = metrics.snapshot()
         fastpath["spec_accept_rate_by_drafter"] = (
-            metrics.snapshot().get("spec_accept_rate_by_drafter", {}))
+            snap.get("spec_accept_rate_by_drafter", {}))
+        fastpath["weight_dtype"] = snap.get("weight_dtype")
+        wb = snap.get("weight_bytes_per_device")
+        fastpath["weight_bytes_per_device"] = int(wb) if wb else None
     return slo, recompiles, fastpath
 
 
@@ -579,6 +597,8 @@ def main(argv=None):
         "serve_spec_accept_rate": fastpath["spec_accept_rate"],
         "serve_spec_accept_rate_by_drafter":
             fastpath["spec_accept_rate_by_drafter"],
+        "weight_dtype": fastpath["weight_dtype"],
+        "serve_weight_bytes_per_device": fastpath["weight_bytes_per_device"],
         "t_wall": time.time(),
         "concurrency": args.concurrency,
         "rate": args.rate,

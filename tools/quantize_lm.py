@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Export a weight-quantized serving bundle from a trained TransformerLM.
+
+Post-training weight-only quantization (``models/quant.py``): matmul
+kernels become int8 (symmetric per-output-channel) or int4 (group-wise
+along the reduction axis, nibble-packed); embeddings, norms, biases and
+the lm_head stay high precision (cast to bf16 by default — they are a
+rounding error of the footprint at serving shapes but dominate quality).
+The output is a normal ``serve_lm.py`` bundle whose metadata carries
+``weight_dtype``/``quant_group_size``, so ``load_lm_bundle`` rebuilds the
+quantized param structure and the engine runs it directly.
+
+The drafter should be quantized HARDER than the target: draft quality
+only costs extra verify rounds (acceptance drops), never output quality —
+the rejection-sampling verify step guarantees the target distribution
+regardless of the drafter. Hence the one-invocation pairing below quantizes
+the target to int8 and the draft head to int4.
+
+Example:
+  python tools/quantize_lm.py --model lm.msgpack --out lm.int8.msgpack \\
+      --mode int8
+  python tools/quantize_lm.py --model lm.msgpack --out lm.int8.msgpack \\
+      --draft_model draft.msgpack --draft_out draft.int4.msgpack
+  python tools/serve_lm.py --model lm.int8.msgpack --spec_k 4 \\
+      --draft_model draft.int4.msgpack
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _config_meta(cfg, mode, group_size):
+    """The full metadata ``config`` dict ``load_lm_bundle`` reads — every
+    shape key plus the quant mode, so the loader's init template grows the
+    int kernel_q/scale structure the state dict carries."""
+    return {
+        "vocab_size": int(cfg.vocab_size),
+        "d_model": int(cfg.d_model),
+        "num_heads": int(cfg.num_heads),
+        "num_kv_heads": int(cfg.num_kv_heads or 0),
+        "attention_window": int(cfg.attention_window or 0),
+        "use_bias": int(cfg.use_bias),
+        "rope": int(cfg.position == "rope"),
+        "rope_theta": float(cfg.rope_theta),
+        "num_layers": int(cfg.num_layers),
+        "d_ff": int(cfg.d_ff),
+        "max_seq_len": int(cfg.max_seq_len),
+        "weight_dtype": mode,
+        "quant_group_size": int(group_size),
+    }
+
+
+def quantize_bundle(src, dst, mode, group_size, hp_dtype_name="bfloat16"):
+    """Load ``src``, quantize, write ``dst``. Returns (orig_bytes, new_bytes)
+    for the footprint report."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.quant import (
+        quantize_lm_params,
+        tree_bytes,
+        validate_weight_quant,
+    )
+    from distributed_tensorflow_tpu.train.checkpoint import (
+        export_inference_bundle,
+        load_lm_bundle,
+    )
+
+    cfg, params, meta = load_lm_bundle(src)
+    if getattr(cfg, "weight_dtype", None):
+        raise SystemExit(
+            f"{src} is already quantized ({cfg.weight_dtype}) — quantize "
+            "from the high-precision training bundle, not a quantized one "
+            "(requantizing compounds rounding error)")
+    validate_weight_quant(mode, group_size, int(cfg.d_model), int(cfg.d_ff))
+    hp_dtype = jnp.bfloat16 if hp_dtype_name == "bfloat16" else jnp.float32
+    qparams = quantize_lm_params(
+        params, mode, group_size=group_size, hp_dtype=hp_dtype)
+    metadata = {k: v for k, v in meta.items() if k != "format"}
+    metadata["config"] = _config_meta(cfg, mode, group_size)
+    metadata["quantized_from"] = os.path.basename(src)
+    export_inference_bundle(dst, qparams, metadata=metadata)
+    return tree_bytes(params), tree_bytes(qparams)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--model", required=True,
+                        help="high-precision bundle to quantize (the target)")
+    parser.add_argument("--out", required=True,
+                        help="output path for the quantized target bundle")
+    parser.add_argument("--mode", default="int8", choices=("int8", "int4"),
+                        help="target weight dtype")
+    parser.add_argument(
+        "--group_size", type=int, default=0,
+        help="int4 group size along the reduction axis (default 64; "
+        "ignored for int8)")
+    parser.add_argument(
+        "--hp_dtype", default="bfloat16", choices=("bfloat16", "float32"),
+        help="dtype for the high-precision leaves (embeddings/norms/lm_head)")
+    parser.add_argument(
+        "--draft_model", default="",
+        help="optionally also quantize this draft bundle (harder: int4)")
+    parser.add_argument("--draft_out", default="",
+                        help="output path for the quantized draft bundle")
+    parser.add_argument(
+        "--draft_group_size", type=int, default=0,
+        help="int4 group size for the drafter (default: --group_size or 64)")
+    args = parser.parse_args(argv)
+
+    gs = args.group_size or (64 if args.mode == "int4" else 0)
+    orig, new = quantize_bundle(
+        args.model, args.out, args.mode, gs, args.hp_dtype)
+    print(f"quantize_lm: {args.model} -> {args.out} mode={args.mode} "
+          f"group_size={gs} bytes {orig} -> {new} "
+          f"({new / max(1, orig):.3f}x)", flush=True)
+
+    if bool(args.draft_model) != bool(args.draft_out):
+        raise SystemExit("--draft_model and --draft_out go together")
+    if args.draft_model:
+        dgs = args.draft_group_size or args.group_size or 64
+        orig, new = quantize_bundle(
+            args.draft_model, args.draft_out, "int4", dgs, args.hp_dtype)
+        print(f"quantize_lm: {args.draft_model} -> {args.draft_out} "
+              f"mode=int4 group_size={dgs} bytes {orig} -> {new} "
+              f"({new / max(1, orig):.3f}x)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,42 @@ def build_stack(serve_cfg, cfg, params):
         )
 
         draft_cfg, draft_params, _ = load_lm_bundle(draft_path)
+    # --quant / --weight_dtype: weight-only quantized serving. A
+    # pre-quantized bundle (tools/quantize_lm.py — its cfg already carries
+    # weight_dtype) serves as-is; a high-precision one is quantized on the
+    # fly. The drafter is quantized HARDER than the target (int4): drafter
+    # rounding error only costs acceptance (extra verify rounds), never
+    # output quality — the rejection-sampling verify step guarantees the
+    # target distribution regardless of the drafter.
+    quant = str(getattr(serve_cfg, "weight_dtype", "") or "")
+    if quant or getattr(serve_cfg, "quant_group_size", 0):
+        from dataclasses import replace
+
+        from distributed_tensorflow_tpu.models.quant import (
+            quantize_lm_params,
+            validate_weight_quant,
+        )
+
+        gs = int(getattr(serve_cfg, "quant_group_size", 0))
+        if quant == "int4" and not gs:
+            gs = 64  # serving default; explicit --quant_group_size overrides
+        if not getattr(cfg, "weight_dtype", None):
+            tp_q = max(1, int(getattr(serve_cfg, "tp", 1)))
+            validate_weight_quant(
+                quant or None, gs, int(cfg.d_model), int(cfg.d_ff), tp=tp_q)
+            cfg = replace(cfg, weight_dtype=quant, quant_group_size=gs)
+            params = quantize_lm_params(
+                params, quant, group_size=gs, hp_dtype=cfg.compute_dtype)
+        if draft_params is not None and not getattr(
+                draft_cfg, "weight_dtype", None):
+            dgs = gs or 64
+            validate_weight_quant(
+                "int4", dgs, int(draft_cfg.d_model), int(draft_cfg.d_ff))
+            draft_cfg = replace(
+                draft_cfg, weight_dtype="int4", quant_group_size=dgs)
+            draft_params = quantize_lm_params(
+                draft_params, "int4", group_size=dgs,
+                hp_dtype=cfg.compute_dtype)
     # --tp N > 1: the SAME stack on a TP-partitioned model. Validate the
     # mesh against the model BEFORE any engine/jit work so a bad tp fails
     # with the config-level message, and build the sharded engine mode —
@@ -153,11 +189,19 @@ def main(argv=None):
         "--kv_cache_dtype", default="", choices=("", "int8"),
         help="KV-pool storage dtype ('' = compute dtype)",
     )
+    parser.add_argument(
+        "--quant", default="", choices=("", "int8", "int4"),
+        help="weight-only quantization (alias for --weight_dtype; a "
+        "pre-quantized bundle serves as-is, a high-precision one is "
+        "quantized on the fly; the drafter is quantized harder: int4)",
+    )
     args, rest = parser.parse_known_args(argv)
 
     from distributed_tensorflow_tpu.config import ServeConfig, parse_flags
 
     serve_cfg = parse_flags(ServeConfig, argv=rest)
+    if args.quant:
+        serve_cfg.weight_dtype = args.quant
 
     import jax
     import jax.numpy as jnp
@@ -216,7 +260,7 @@ def main(argv=None):
         f"serving on http://{host}:{port}  slots={engine.slots} "
         f"max_len={engine.max_len} prefill_len={engine.prefill_len} "
         f"kv={kv_desc} mesh=tp{engine.tp}x{engine.mesh_device_count}dev "
-        f"compiled={engine.compile_count()}",
+        f"weights={engine.weight_dtype} compiled={engine.compile_count()}",
         flush=True,
     )
 
