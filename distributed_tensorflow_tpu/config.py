@@ -662,3 +662,83 @@ class FleetConfig:
     fleet_slo_interval_s: float = field(
         default=1.0, metadata={"help": "router SLO evaluation tick period"}
     )
+
+
+@dataclass
+class DeployConfig:
+    """Checkpoint hot-swap + canary + variants (``serve/deploy/``).
+
+    All off by default: with ``watch_dir`` empty no watcher starts and
+    the serving stack is byte-identical to the pre-deploy build. Flags
+    carry a ``deploy_``/``canary_`` prefix so they compose with
+    :class:`ServeConfig` / :class:`FleetConfig` in one parser."""
+
+    watch_dir: str = field(
+        default="",
+        metadata={"help": "checkpoint dir to poll for committed steps; "
+                  "empty = hot-swap disabled"},
+    )
+    watch_interval_s: float = field(
+        default=0.25, metadata={"help": "watcher poll period"}
+    )
+    deploy_params_key: str = field(
+        default="auto",
+        metadata={"help": "subtree of the checkpoint to serve: 'auto' "
+                  "(tree['params'] when present), '' (whole tree), or a "
+                  "'/'-separated path"},
+    )
+    deploy_variant: str = field(
+        default="",
+        metadata={"help": "variant new checkpoints deploy into; empty = "
+                  "the live/default variant (in-place hot swap)"},
+    )
+    canary_percent: float = field(
+        default=0.0,
+        metadata={"help": "percent of client_id hash lanes (0-100) routed "
+                  "to the canary variant once it exists"},
+    )
+    canary_variant: str = field(
+        default="canary",
+        metadata={"help": "name of the canary variant in the table"},
+    )
+    canary_rows: int = field(
+        default=4,
+        metadata={"help": "held-out canary eval batch rows"},
+    )
+    canary_len: int = field(
+        default=16,
+        metadata={"help": "held-out canary eval sequence length"},
+    )
+    canary_probes: int = field(
+        default=2,
+        metadata={"help": "probe prompts greedily continued pre-flip"},
+    )
+    max_loss_ratio: float = field(
+        default=1.5,
+        metadata={"help": "candidate/live canary eval-loss ratio above "
+                  "which the swap rolls back"},
+    )
+
+    def validate(self) -> None:
+        if not 0.0 <= self.canary_percent <= 100.0:
+            raise ValueError(
+                f"canary_percent must be in [0, 100], got "
+                f"{self.canary_percent}"
+            )
+        if self.max_loss_ratio <= 0:
+            raise ValueError(
+                f"max_loss_ratio must be > 0, got {self.max_loss_ratio}"
+            )
+        if self.watch_interval_s <= 0:
+            raise ValueError(
+                f"watch_interval_s must be > 0, got {self.watch_interval_s}"
+            )
+        if self.canary_rows < 1 or self.canary_len < 2:
+            raise ValueError(
+                "canary batch needs >= 1 row and length >= 2 (next-token "
+                f"loss), got rows={self.canary_rows} len={self.canary_len}"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.watch_dir)

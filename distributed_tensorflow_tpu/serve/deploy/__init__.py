@@ -1,0 +1,38 @@
+"""serve/deploy: close the train->serve loop.
+
+Three layers, composed by ``tools/serve_lm.py`` when
+``DeployConfig.watch_dir`` is set:
+
+* :mod:`.watcher` — poll a checkpoint dir for newly COMMITTED steps and
+  hand assembled param trees downstream;
+* :mod:`.swap`    — stage, canary (non-finite scan + held-out eval loss
+  + probe prompts), then flip the engine's param reference at a
+  scheduler iteration boundary, or roll back with a flight-recorder
+  dump. Zero dropped requests, zero recompiles;
+* :mod:`.variants` — named weight variants with deterministic
+  ``client_id`` hash-lane canary routing.
+"""
+
+from distributed_tensorflow_tpu.serve.deploy.swap import (
+    SwapResult,
+    WeightSwapper,
+    make_canary_batch,
+)
+from distributed_tensorflow_tpu.serve.deploy.variants import (
+    DEFAULT_VARIANT,
+    Variant,
+    VariantTable,
+    variant_lane,
+)
+from distributed_tensorflow_tpu.serve.deploy.watcher import CheckpointWatcher
+
+__all__ = [
+    "CheckpointWatcher",
+    "WeightSwapper",
+    "SwapResult",
+    "make_canary_batch",
+    "VariantTable",
+    "Variant",
+    "variant_lane",
+    "DEFAULT_VARIANT",
+]

@@ -1,0 +1,182 @@
+"""Named weight variants: `variant -> params buffer`, canary-lane routing.
+
+One hot-swap slot generalizes to a table of named, device-resident weight
+bundles — the production form of the paper's transfer-learning half
+(many retrained heads over a shared trunk, each head a serveable
+variant). The table answers two questions:
+
+* **Which variant does this request get?** ``resolve(client_id)`` hashes
+  the client into one of 100 deterministic lanes (crc32 — stable across
+  processes and restarts, unlike salted ``hash()``) and routes lanes
+  ``< canary_percent`` to the canary variant when one is registered.
+  Same client, same variant, every time, on every replica and on the
+  fleet router — that determinism is what makes A/B results attributable.
+* **Which buffer does the engine run?** ``activate(engine, name)`` flips
+  the engine's live param reference to the variant's staged buffer via
+  :meth:`SlotEngine.adopt_weights` — a reference swap between jitted
+  rounds (all table buffers were staged through ``stage_weights`` at
+  registration, so activation is transfer-free and recompile-free).
+
+The scheduler owns the serving discipline built on top: requests queue
+per-variant, slots pin the variant they were admitted under for their
+lifetime, and the engine switches variants only at an empty iteration
+boundary (no active or prefilling slot left) — one batched program, one
+params tree per round, no mixed-variant rounds.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+
+__all__ = ["Variant", "VariantTable", "variant_lane", "DEFAULT_VARIANT"]
+
+DEFAULT_VARIANT = "main"
+
+
+def variant_lane(client_id: str) -> int:
+    """Deterministic 0..99 lane for a client id (crc32, process-stable).
+    The empty/anonymous client id lands in a lane like any other —
+    anonymous traffic still splits at the canary percentage."""
+    return zlib.crc32(str(client_id).encode()) % 100
+
+
+class Variant:
+    """One named weight bundle: a device-staged params tree plus the
+    metadata the fleet advertises (checkpoint step, quant mode)."""
+
+    __slots__ = ("name", "params", "step", "weight_dtype", "drafter")
+
+    def __init__(self, name, params, step=0, weight_dtype="native",
+                 drafter=""):
+        self.name = str(name)
+        self.params = params
+        self.step = int(step)
+        self.weight_dtype = str(weight_dtype)
+        self.drafter = str(drafter)
+
+
+class VariantTable:
+    """Thread-safe ``variant -> params buffer`` table bound to one engine.
+
+    The engine's boot params seed the default variant. ``set()`` stages a
+    candidate through ``engine.stage_weights`` (structure/dtype validated,
+    device-placed — including through the sharded engine's rule-table
+    shardings) so every table entry is flip-ready; ``activate()`` is then
+    a pure reference swap the scheduler performs at iteration boundaries.
+    """
+
+    def __init__(self, engine, *, default=DEFAULT_VARIANT,
+                 canary_percent=0.0, canary_variant="canary"):
+        if not 0.0 <= float(canary_percent) <= 100.0:
+            raise ValueError(
+                f"canary_percent must be in [0, 100], got {canary_percent}"
+            )
+        self.engine = engine
+        self.default = str(default)
+        self.canary_percent = float(canary_percent)
+        self.canary_variant = str(canary_variant)
+        self._lock = threading.Lock()
+        self._variants: dict[str, Variant] = {}
+        self._variants[self.default] = Variant(
+            self.default, engine.params,
+            step=int(getattr(engine, "weight_version", 0)),
+            weight_dtype=getattr(engine, "weight_dtype", "native"),
+            drafter=getattr(engine, "drafter", ""),
+        )
+        engine.serving_variant = self.default
+
+    # -- table ------------------------------------------------------------
+
+    def set(self, name: str, params, *, step: int = 0) -> Variant:
+        """Register/replace a variant with an UNstaged candidate tree
+        (validates + device-places it). Raises ValueError on a tree the
+        engine could not swap to."""
+        staged = self.engine.stage_weights(params)
+        return self.set_staged(name, staged, step=step)
+
+    def set_staged(self, name: str, staged, *, step: int = 0) -> Variant:
+        """Register a candidate that is ALREADY staged (the swapper's
+        post-canary path — it staged once for the canary run)."""
+        v = Variant(
+            str(name), staged, step=step,
+            weight_dtype=getattr(self.engine, "weight_dtype", "native"),
+            drafter=getattr(self.engine, "drafter", ""),
+        )
+        with self._lock:
+            self._variants[v.name] = v
+        return v
+
+    def remove(self, name: str) -> None:
+        if name == self.default:
+            raise ValueError(f"cannot remove the default variant {name!r}")
+        with self._lock:
+            self._variants.pop(name, None)
+
+    def get(self, name: str) -> Variant | None:
+        with self._lock:
+            return self._variants.get(name)
+
+    def names(self) -> tuple:
+        with self._lock:
+            return tuple(sorted(self._variants))
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._variants
+
+    # -- routing ----------------------------------------------------------
+
+    def resolve(self, client_id: str) -> str:
+        """Variant for a client: its hash lane against the canary rule.
+        Lanes below ``canary_percent`` get the canary variant IF it is
+        registered; everyone else (and everyone, before a canary is
+        deployed) gets the default."""
+        with self._lock:
+            has_canary = (self.canary_percent > 0.0
+                          and self.canary_variant in self._variants)
+        if has_canary and variant_lane(client_id) < self.canary_percent:
+            return self.canary_variant
+        return self.default
+
+    # -- engine binding ----------------------------------------------------
+
+    def activate(self, name: str) -> None:
+        """Flip the engine onto ``name``'s buffer. Driver-thread-only, at
+        an iteration boundary (the scheduler's contract); a no-op when the
+        variant is already live."""
+        with self._lock:
+            v = self._variants.get(name)
+        if v is None:
+            raise KeyError(f"unknown variant {name!r}")
+        if (self.engine.serving_variant == v.name
+                and self.engine.params is v.params):
+            return
+        self.engine.adopt_weights(v.params, version=v.step, variant=v.name)
+
+    def refresh_default(self) -> None:
+        """Point the default variant at the engine's CURRENT live buffer
+        (after an in-place hot swap of the default lane)."""
+        with self._lock:
+            v = self._variants[self.default]
+            v.params = self.engine.params
+            v.step = int(getattr(self.engine, "weight_version", 0))
+
+    # -- readout -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-ready table view for /healthz and the fleet registry."""
+        with self._lock:
+            return {
+                "default": self.default,
+                "canary_percent": self.canary_percent,
+                "canary_variant": self.canary_variant,
+                "variants": {
+                    v.name: {
+                        "step": v.step,
+                        "weight_dtype": v.weight_dtype,
+                        "drafter": v.drafter,
+                    }
+                    for v in self._variants.values()
+                },
+            }

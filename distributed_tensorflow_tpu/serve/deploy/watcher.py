@@ -1,0 +1,185 @@
+"""Checkpoint watcher: committed-manifest poll -> staged swap candidate.
+
+The consumer half of the zero-stall checkpoint pipeline
+(``train/checkpoint.py``). A trainer's saves become visible one atomic
+rename at a time — shard npz, then manifest, then the chief's
+``COMMIT.json`` — so the watcher needs no coordination with the writer:
+it polls :func:`train.checkpoint.list_committed_steps` (commit marker =
+visibility, the same rule restores use) and a step either exists
+completely or not at all. Torn or uncommitted step dirs are skipped
+exactly like ``obs/aggregate.py`` skips torn fleet files; a COMMITTED
+step whose files turn out unreadable (bad disk, crashed writer that
+somehow committed) is warned about once, remembered, and never retried —
+the walk-back discipline, pointed forward.
+
+What the watcher hands downstream is a fully assembled param tree (shard
+entries stitched back to whole arrays), extracted from the saved state by
+``params_key``: trainers checkpoint ``{"params": ..., "opt_state": ...,
+"global_step": ...}``, a serving engine wants the params subtree. The
+handoff target is any ``on_candidate(step, tree)`` callable — in the
+serving stack that is :meth:`deploy.swap.WeightSwapper.submit`, which
+stages, canaries, and flips; the watcher itself never touches the engine.
+
+``DTT_FAULT=deploy_nan:1`` poisons the next delivered candidate's first
+floating leaf with NaN — the poisoned-checkpoint drill that the swapper's
+canary must catch (rollback, zero poisoned tokens served).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+import numpy as np
+
+from distributed_tensorflow_tpu.train.checkpoint import (
+    list_committed_steps,
+    read_step,
+)
+from distributed_tensorflow_tpu.utils import faults
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+__all__ = ["CheckpointWatcher"]
+
+# stderr: a serving CLI's stdout carries data (bench compact line,
+# loadgen JSONL) and must stay log-free.
+log = get_logger(__name__, stream=sys.stderr)
+
+
+def _extract_params(tree, params_key: str):
+    """Pull the serving subtree out of a checkpointed state tree.
+    ``"auto"``: use ``tree["params"]`` when present (the trainer-state
+    layout), else the whole tree (a bare params publish). An explicit
+    key must exist."""
+    if params_key == "auto":
+        if isinstance(tree, dict) and "params" in tree:
+            return tree["params"]
+        return tree
+    if not params_key:
+        return tree
+    node = tree
+    for part in params_key.split("/"):
+        if not isinstance(node, dict) or part not in node:
+            raise KeyError(
+                f"params_key {params_key!r} not found in checkpoint tree"
+            )
+        node = node[part]
+    return node
+
+
+def _poison_first_float_leaf(tree):
+    """The deploy_nan fault: NaN-poison the first floating leaf (copy —
+    the on-disk checkpoint stays intact, as a real bad save would differ
+    from its neighbors only in content)."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    for i, leaf in enumerate(flat):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating):
+            bad = np.array(arr, copy=True)
+            bad.reshape(-1)[0] = np.nan
+            flat = list(flat)
+            flat[i] = bad
+            break
+    return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+class CheckpointWatcher:
+    """Poll a checkpoint directory for newly COMMITTED steps and deliver
+    assembled param trees to ``on_candidate(step, tree)``.
+
+    Single consumer, monotone: steps are delivered in ascending order,
+    each at most once, starting strictly after ``start_after`` (default:
+    whatever is already committed at construction — a freshly booted
+    replica already loaded its bundle; only NEW saves are swaps). When
+    several steps commit between polls only the NEWEST is delivered —
+    serving wants the latest weights, not a replay of the backlog.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        on_candidate,
+        *,
+        poll_interval_s: float = 0.25,
+        params_key: str = "auto",
+        start_after: int | None = None,
+    ):
+        self.directory = directory
+        self.on_candidate = on_candidate
+        self.poll_interval_s = float(poll_interval_s)
+        self.params_key = str(params_key)
+        if start_after is None:
+            existing = list_committed_steps(directory)
+            start_after = existing[-1] if existing else -1
+        self.last_step = int(start_after)
+        self._bad: set[int] = set()   # committed-but-unreadable: never retry
+        self.delivered_total = 0
+        self.skipped_total = 0
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- one poll ----------------------------------------------------------
+
+    def poll_once(self) -> int | None:
+        """Check for new committed steps; deliver the newest readable one.
+        Returns the delivered step or None. Never raises for checkpoint-
+        content problems (skip + warn is the contract); on_candidate
+        exceptions DO propagate — a broken swap path is the caller's bug,
+        not a bad checkpoint."""
+        steps = [
+            s for s in list_committed_steps(self.directory)
+            if s > self.last_step and s not in self._bad
+        ]
+        for step in reversed(steps):  # newest first
+            try:
+                tree = read_step(self.directory, step)
+                params = _extract_params(tree, self.params_key)
+            except (OSError, KeyError) as e:
+                log.warning(
+                    "deploy watcher: committed step %d unreadable "
+                    "(%s: %s) — skipping it permanently",
+                    step, type(e).__name__, e,
+                )
+                self._bad.add(step)
+                self.skipped_total += 1
+                continue
+            if faults.fire("deploy_nan"):
+                params = _poison_first_float_leaf(params)
+            # Everything this poll saw is consumed: older unread steps are
+            # superseded by the one being delivered.
+            self.last_step = steps[-1]
+            self.delivered_total += 1
+            self.on_candidate(step, params)
+            return step
+        if steps:
+            # All new steps were unreadable; don't re-walk them next poll.
+            self.last_step = steps[-1]
+        return None
+
+    # -- background loop ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("watcher already started")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — the watcher must not die
+                    log.exception("deploy watcher: poll failed")
+                self._stop.wait(self.poll_interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="deploy-watcher", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout)
+        self._thread = None

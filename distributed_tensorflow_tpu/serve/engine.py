@@ -183,7 +183,14 @@ class SlotEngine:
         if spec_k and not page_size:
             raise ValueError("spec_k > 0 requires the paged KV layout")
         self.cfg = cfg
-        self.params = params
+        # Place params through the same path swap candidates stage through
+        # (``_place_params``): a checkpoint bundle arrives as host numpy,
+        # and numpy vs device-array arguments key DIFFERENT pjit cache
+        # entries — boot params must look exactly like adopted ones or the
+        # first post-swap round grows the compile caches (the poll-mode
+        # sentinel counts that as a recompile) and re-uploads weights every
+        # dispatch until then.
+        self.params = self._place_params(params)
         self.model = TransformerLM(cfg)
         self.slots = int(slots)
         self.max_len = max_len
@@ -265,6 +272,11 @@ class SlotEngine:
         # after warmup and every round, it turns the zero-recompile
         # invariant into the alerting ``recompile_events_total`` metric.
         self.sentinel = sentinel
+        # Deploy surface (serve/deploy/): the checkpoint step currently
+        # serving and the named variant it belongs to. adopt_weights()
+        # maintains both; /healthz and the fleet registry report them.
+        self.weight_version = 0
+        self.serving_variant = ""
         # Mesh topology: the base engine is one fully-replicated process.
         # ShardedSlotEngine sets these BEFORE delegating here so the pool
         # and program hooks below see them.
@@ -1360,6 +1372,73 @@ class SlotEngine:
                 total += leaf.nbytes
         return int(total)
 
+    # -- weight hot-swap (serve/deploy/) -----------------------------------
+    #
+    # ``self.params`` is a per-call traced argument to every jitted program
+    # and is NEVER in a donate_argnums set (prefill donates the KV operand,
+    # step donates the pool layers) — so replacing the reference between
+    # rounds is donation-safe, and as long as the candidate matches the
+    # live tree's structure/shapes/dtypes the jit signatures are unchanged:
+    # zero recompiles by construction, which the RecompileSentinel then
+    # asserts empirically.
+
+    def check_swap_compatible(self, candidate) -> None:
+        """Raise ``ValueError`` unless ``candidate`` has the live param
+        tree's exact treedef, leaf shapes, and leaf dtypes — the validated
+        precondition for a zero-recompile swap. Called before any device
+        transfer so a wrong-architecture checkpoint is rejected for free."""
+        cur, cur_def = jax.tree_util.tree_flatten(self.params)
+        new, new_def = jax.tree_util.tree_flatten(candidate)
+        if cur_def != new_def:
+            raise ValueError(
+                "adopt_weights: candidate tree structure differs from the "
+                f"serving tree ({new_def} vs {cur_def})"
+            )
+        for i, (a, b) in enumerate(zip(cur, new)):
+            if tuple(np.shape(a)) != tuple(np.shape(b)):
+                raise ValueError(
+                    f"adopt_weights: leaf {i} shape {np.shape(b)} != "
+                    f"serving {np.shape(a)}"
+                )
+            da = jnp.asarray(a).dtype if not hasattr(a, "dtype") else a.dtype
+            db = jnp.result_type(b)
+            if np.dtype(da) != np.dtype(db):
+                raise ValueError(
+                    f"adopt_weights: leaf {i} dtype {db} != serving {da} "
+                    "(a dtype change is a different jit signature — "
+                    "recompile — so it must ship as a new replica, not a "
+                    "hot swap)"
+                )
+
+    def _place_params(self, candidate):
+        """Device placement for a swap candidate: plain device_put here;
+        the sharded engine routes through its SERVE_TP_RULES shardings."""
+        return jax.device_put(candidate)
+
+    def stage_weights(self, candidate):
+        """Validate + place a candidate param tree on the engine's devices
+        WITHOUT touching the live reference — the double-buffer half of a
+        hot swap. Safe to call from a watcher thread while the driver
+        thread keeps decoding on the old buffers (the transfer allocates
+        fresh buffers; nothing donates params). Returns the staged tree."""
+        self.check_swap_compatible(candidate)
+        return self._place_params(candidate)
+
+    def adopt_weights(self, candidate, *, version=None, variant=None):
+        """Flip the live param reference to ``candidate`` and return the
+        previous tree (the rollback buffer). MUST be called between engine
+        rounds on the driver thread — the scheduler's iteration boundary —
+        so no jitted program is mid-flight on either buffer set. In-flight
+        slots simply continue on the new weights next round; their KV
+        cache carries over (same architecture by the precondition)."""
+        candidate = self.stage_weights(candidate)
+        prev, self.params = self.params, candidate
+        if version is not None:
+            self.weight_version = int(version)
+        if variant is not None:
+            self.serving_variant = str(variant)
+        return prev
+
 
 class ShardedSlotEngine(SlotEngine):
     """The SlotEngine on a TP-partitioned model — same slot API, same
@@ -1462,6 +1541,12 @@ class ShardedSlotEngine(SlotEngine):
             cfg, self.slots, max_len, self.page_size, kv_pages,
             kv_sharding=self._kv_shard,
         )
+
+    def _place_params(self, candidate):
+        # Swap candidates stage through the SAME rule-table shardings as
+        # the boot-time params, so the jitted programs' in_shardings keep
+        # matching and the flip stays resharding- and recompile-free.
+        return jax.device_put(candidate, self._param_sh)
 
     def _jit_program(self, fn, kind, donate):
         """Jit under the mesh with explicit in/out shardings per program

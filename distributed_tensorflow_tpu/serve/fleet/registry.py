@@ -71,6 +71,15 @@ class ProbeResult:
     devices: int = 1         # devices it spans — a tp-wide replica is ONE
     #                          replica, not tp independent ones
     weight_dtype: str = ""   # 'native'/'int8'/'int4' weight quantization
+    # Deploy state from the healthz "deploy" section: which checkpoint
+    # step is live, which variant the engine is running, and the full
+    # set of variants this replica can serve (+ its canary rule) — what
+    # variant-aware routing keys on.
+    weight_version: int = 0
+    serving_variant: str = ""
+    variants: tuple = ()
+    canary_percent: float = 0.0
+    canary_variant: str = ""
     detail: str = ""
 
 
@@ -119,6 +128,15 @@ def http_probe(base_url: str, timeout_s: float = 2.0) -> ProbeResult:
         devices=int(body.get("mesh", {}).get("devices", 1)),
         weight_dtype=str(body.get("weight_dtype", "")),
     )
+    deploy = body.get("deploy", {})
+    if isinstance(deploy, dict):
+        result.weight_version = int(deploy.get("weight_version", 0))
+        result.serving_variant = str(deploy.get("serving_variant", ""))
+        result.variants = tuple(
+            sorted(deploy.get("variants", {}))
+        ) if isinstance(deploy.get("variants"), dict) else ()
+        result.canary_percent = float(deploy.get("canary_percent", 0.0))
+        result.canary_variant = str(deploy.get("canary_variant", ""))
     try:
         with urllib.request.urlopen(
                 base_url + "/metrics", timeout=timeout_s) as resp:
@@ -289,8 +307,14 @@ class ReplicaRegistry:
 
     # -- dispatch policy --------------------------------------------------
 
-    def pick(self, exclude=()) -> Replica | None:
-        """Least-loaded UP replica not excluded and not in backoff."""
+    def pick(self, exclude=(), variant: str | None = None) -> Replica | None:
+        """Least-loaded UP replica not excluded and not in backoff.
+
+        ``variant``: prefer replicas that advertise the named variant in
+        their healthz deploy table. Preference, not a hard filter: if no
+        UP replica carries the variant, fall back to least-loaded overall
+        (a replica without the variant serves its default — degraded
+        attribution beats a 503 while a rollout propagates)."""
         now = self.clock()
         with self._lock:
             candidates = [
@@ -300,6 +324,12 @@ class ReplicaRegistry:
             ]
             if not candidates:
                 return None
+            if variant:
+                carrying = [r for r in candidates
+                            if variant in r.last.variants
+                            or variant == r.last.serving_variant]
+                if carrying:
+                    candidates = carrying
             return min(candidates, key=lambda r: (r.load_score(),
                                                   r.replica_id))
 
@@ -358,6 +388,10 @@ class ReplicaRegistry:
                         "tp": r.last.tp,
                         "devices": r.last.devices,
                         "weight_dtype": r.last.weight_dtype,
+                        "weight_version": r.last.weight_version,
+                        "serving_variant": r.last.serving_variant,
+                        "variants": list(r.last.variants),
+                        "canary_percent": r.last.canary_percent,
                         "shed_total": r.last.shed_total,
                         "dispatched_total": r.dispatched_total,
                         "error_total": r.error_total,

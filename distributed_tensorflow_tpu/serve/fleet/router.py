@@ -48,6 +48,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from distributed_tensorflow_tpu.obs import export as obs_export
+from distributed_tensorflow_tpu.serve.deploy.variants import variant_lane
 
 __all__ = ["FleetRouter", "make_router_server"]
 
@@ -56,7 +57,11 @@ __all__ = ["FleetRouter", "make_router_server"]
 # else is either success or the client's own fault — relay verbatim.
 _RETRYABLE_STATUS = frozenset({429, 503})
 
-_HOP_HEADERS = ("content-type", "retry-after")
+# Replica response headers relayed to the client verbatim. X-Variant /
+# X-Weight-Version carry the serving attribution end to end so loadgen
+# splits its report per variant through the router too.
+_HOP_HEADERS = ("content-type", "retry-after", "x-variant",
+                "x-weight-version")
 
 
 class _Forwarded(Exception):
@@ -163,6 +168,9 @@ class FleetRouter:
         handler.send_response(200)
         handler.send_header("Content-Type", ctype)
         handler.send_header("Cache-Control", "no-cache")
+        variant = resp.getheader("X-Variant")
+        if variant is not None:
+            handler.send_header("X-Variant", variant)
         handler.send_header("X-Replica", replica.replica_id)
         handler.send_header("X-Attempts", str(attempt + 1))
         handler.end_headers()
@@ -200,15 +208,38 @@ class FleetRouter:
         except ValueError:
             return None
 
+    # -- variant routing ----------------------------------------------------
+
+    def resolve_variant(self, client_id: str) -> str | None:
+        """Fleet-level canary resolve: the same deterministic client_id
+        hash lanes the replicas use, against the canary rule the fleet
+        advertises (the widest canary_percent among UP replicas — during
+        a rollout the most-upgraded replica's rule wins). None = no
+        canary running, route purely by load."""
+        percent = 0.0
+        canary = ""
+        for r in self.registry.replicas:
+            if (r.state == "up" and r.last.canary_variant
+                    and r.last.canary_percent > percent
+                    and r.last.canary_variant in r.last.variants):
+                percent = r.last.canary_percent
+                canary = r.last.canary_variant
+        if canary and variant_lane(client_id) < percent:
+            return canary
+        return None
+
     # -- the dispatch loop -------------------------------------------------
 
-    def dispatch(self, handler, body: bytes, *, streaming: bool) -> None:
-        """Route one /generate to the fleet; always answers the client."""
+    def dispatch(self, handler, body: bytes, *, streaming: bool,
+                 variant: str | None = None) -> None:
+        """Route one /generate to the fleet; always answers the client.
+        ``variant`` biases ``pick`` toward replicas advertising that
+        variant (explicit client pin or the fleet canary resolve)."""
         started_at = self.clock()
         tried: set[str] = set()
         last_error = None  # (status, body_bytes, retry_after | None)
         for attempt in range(self.max_attempts):
-            replica = self.registry.pick(exclude=tried)
+            replica = self.registry.pick(exclude=tried, variant=variant)
             if replica is None:
                 break
             tried.add(replica.replica_id)
@@ -338,14 +369,23 @@ def make_router_server(
                 return
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n)
+            variant = None
             try:
                 parsed = json.loads(body or b"{}")
                 streaming = bool(isinstance(parsed, dict)
                                  and parsed.get("stream", False))
+                if isinstance(parsed, dict):
+                    # Explicit pin wins; otherwise the fleet canary rule
+                    # resolves from client_id — same lanes the replica
+                    # itself would use, so router and replica agree.
+                    variant = (str(parsed.get("variant", "")) or
+                               router.resolve_variant(
+                                   str(parsed.get("client_id", ""))))
             except ValueError:
                 streaming = False  # replica will answer 400 either way
             try:
-                router.dispatch(self, body, streaming=streaming)
+                router.dispatch(self, body, streaming=streaming,
+                                variant=variant)
             except (BrokenPipeError, ConnectionResetError):
                 pass  # client left mid-proxy; nothing to answer
 

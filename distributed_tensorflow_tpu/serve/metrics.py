@@ -155,6 +155,23 @@ class ServingMetrics:
             "leaves count their per-device shard). The quantization "
             "win shows here: int8 trees land near 0.5x of bf16, int4 "
             "near 0.3x at serving shapes.")
+        # Deploy instruments (PR 12): which checkpoint step is live,
+        # traffic attribution per weight variant, and swap outcomes —
+        # the three numbers a rollout dashboard needs.
+        self._weight_version = r.gauge(
+            "serve_weight_version",
+            "Checkpoint step of the live weights (0 = boot bundle, "
+            "never hot-swapped).")
+        self._variant_requests = r.counter(
+            "serve_variant_requests_total",
+            "Completed requests per weight variant (label '' = "
+            "single-variant serving).",
+            labels=("variant",))
+        self._swap_total = r.counter(
+            "serve_swap_total",
+            "Weight hot-swap attempts by outcome (ok | rollback).",
+            labels=("outcome",))
+        self._variant_names: set = set()
         # Dtype strings mirrored out of the engine at sync time; ride
         # the snapshot (loadgen's report) since gauges hold floats.
         self._weight_dtype = "native"
@@ -188,11 +205,20 @@ class ServingMetrics:
         for lane, depth in enumerate(depths):
             self._lane_depth.labels(lane=str(lane)).set(float(depth))
 
-    def record_completed(self) -> None:
+    def record_completed(self, variant: str = "") -> None:
         self._completed.inc()
+        self._variant_names.add(variant)
+        self._variant_requests.labels(variant=variant).inc()
 
     def record_shed(self) -> None:
         self._shed.inc()
+
+    def record_swap(self, outcome: str) -> None:
+        """Count one hot-swap attempt (``"ok"`` or ``"rollback"``)."""
+        self._swap_total.labels(outcome=str(outcome)).inc()
+
+    def record_weight_version(self, step: int) -> None:
+        self._weight_version.set(float(step))
 
     def sync_engine(self, engine) -> None:
         """Mirror the engine's cumulative fast-path stats into registry
@@ -277,6 +303,20 @@ class ServingMetrics:
     def spec_accept_rate(self) -> float:
         return float(self._spec_accept_rate.value)
 
+    @property
+    def weight_version(self) -> int:
+        return int(self._weight_version.value)
+
+    def swap_count(self, outcome: str) -> int:
+        return int(self._swap_total.labels(outcome=str(outcome)).value)
+
+    def variant_requests(self) -> dict:
+        """Completed-request counts per variant seen so far."""
+        return {
+            v: int(self._variant_requests.labels(variant=v).value)
+            for v in sorted(self._variant_names)
+        }
+
     # -- readout ----------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -307,6 +347,12 @@ class ServingMetrics:
             "weight_bytes_per_device": self._weight_bytes_per_device.value,
             "weight_dtype": self._weight_dtype,
             "draft_weight_dtype": self._draft_weight_dtype,
+            "weight_version": self.weight_version,
+            "variant_requests": self.variant_requests(),
+            "swaps": {
+                "ok": self.swap_count("ok"),
+                "rollback": self.swap_count("rollback"),
+            },
         }
 
     def publish(self, writer, step: int) -> None:

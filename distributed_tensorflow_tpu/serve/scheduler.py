@@ -54,6 +54,25 @@ Draining (``begin_drain``): stop accepting (``/healthz`` flips 503) while
 the loop keeps serving queued + in-flight work — the graceful half of
 shutdown the fleet router relies on: a draining replica finishes what it
 accepted and receives nothing new.
+
+Variants (PR 12): with a :class:`deploy.variants.VariantTable` attached,
+requests queue PER VARIANT (resolved at submit from an explicit
+``Request.variant`` or the table's ``client_id`` hash-lane canary rule)
+and each slot pins the variant it was admitted under for its lifetime —
+the engine runs exactly one params tree per round, so the scheduler
+switches the engine between variant buffers only at an EMPTY iteration
+boundary (no active or prefilling slot left), a pure reference flip
+(zero recompiles). ``variant_quantum`` bounds starvation: after that
+many consecutive admissions for one variant while another has queued
+work, admission pauses so the boundary arrives and the engine rotates.
+Without a table every request lands in the single ``""`` queue and
+behavior is exactly the pre-variant scheduler.
+
+Iteration-boundary callbacks (``at_boundary``): deploy's hot-swap needs
+a moment on the driver thread when no jitted program is mid-flight to
+canary and flip the live param reference. Callbacks run at the top of
+``step()`` and in the background loop's idle branch — so a swap
+submitted to an idle replica still applies promptly.
 """
 
 from __future__ import annotations
@@ -107,6 +126,9 @@ class Request:
     priority: int = 1
     client_id: str = ""
     stream: bool = False
+    # Explicit variant pin (requires a VariantTable; unknown names get a
+    # typed "invalid" rejection). Empty = resolve from client_id lanes.
+    variant: str = ""
 
 
 @dataclass(frozen=True)
@@ -116,6 +138,11 @@ class Completion:
     ttft_s: float
     latency_s: float
     finish_reason: str  # "length" | "eos"
+    # Attribution: which weight variant served this request and which
+    # checkpoint step those weights came from (pinned at admission, so a
+    # mid-flight hot swap of OTHER requests never relabels this one).
+    variant: str = ""
+    weight_version: int = 0
 
 
 @dataclass(frozen=True)
@@ -136,6 +163,7 @@ class PendingRequest:
 
     request: Request
     submitted_at: float
+    variant: str = ""  # resolved at submit; the queue it waits in
     _event: threading.Event = field(default_factory=threading.Event)
     _outcome: Completion | Rejection | None = None
     _stream_q: _queue.Queue | None = None
@@ -341,22 +369,38 @@ class Scheduler:
         clock=time.monotonic,
         lane_weights=DEFAULT_LANE_WEIGHTS,
         client_weights=None,
+        variants=None,
+        variant_quantum: int = 32,
     ):
         if max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1, got {max_queue_depth}"
             )
+        if variant_quantum < 1:
+            raise ValueError(
+                f"variant_quantum must be >= 1, got {variant_quantum}"
+            )
         self.engine = engine
         self.max_queue_depth = int(max_queue_depth)
         self.metrics = metrics
         self.clock = clock
-        self._queue = _FairQueue(lane_weights, client_weights)
-        self._lock = threading.Lock()  # guards _queue and accept/drain state
+        self.variants = variants  # deploy.variants.VariantTable | None
+        self.variant_quantum = int(variant_quantum)
+        self._lane_weights = lane_weights
+        self._client_weights = client_weights
+        # One _FairQueue per variant ("" = the single queue when no
+        # table is attached — behavior identical to pre-variant builds).
+        self._queues: dict[str, _FairQueue] = {
+            "": _FairQueue(lane_weights, client_weights)
+        }
+        self._variant_served = 0  # consecutive admissions, current variant
+        self._lock = threading.Lock()  # guards _queues and accept/drain state
         self._accepting = True
         self._draining = False
         self._drain_deadline: float | None = None
         self._inflight: dict[int, _InFlight] = {}
         self._ids = itertools.count()
+        self._boundary: deque = deque()  # thread-safe append/popleft
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
 
@@ -378,6 +422,27 @@ class Scheduler:
             pending.finish(Rejection(request.request_id, "invalid", err))
             self._count_shed()
             return pending
+        variant = request.variant
+        if self.variants is not None:
+            if variant:
+                if variant not in self.variants:
+                    pending.finish(
+                        Rejection(request.request_id, "invalid",
+                                  f"unknown variant {variant!r}")
+                    )
+                    self._count_shed()
+                    return pending
+            else:
+                variant = self.variants.resolve(request.client_id)
+        elif variant:
+            pending.finish(
+                Rejection(request.request_id, "invalid",
+                          f"variant {variant!r} requested but no variant "
+                          f"table is configured")
+            )
+            self._count_shed()
+            return pending
+        pending.variant = variant
         with self._lock:
             if not self._accepting:
                 pending.finish(
@@ -387,23 +452,34 @@ class Scheduler:
                 )
                 self._count_shed()
                 return pending
-            if len(self._queue) >= self.max_queue_depth:
+            depth = sum(len(q) for q in self._queues.values())
+            if depth >= self.max_queue_depth:
                 pending.finish(
                     Rejection(
                         request.request_id, "queue_full",
-                        f"queue depth {len(self._queue)} >= "
-                        f"{self.max_queue_depth}",
+                        f"queue depth {depth} >= {self.max_queue_depth}",
                     )
                 )
                 self._count_shed()
                 return pending
-            self._queue.push(pending)
-            depth = len(self._queue)
-            lane_depths = self._queue.depths()
+            if variant not in self._queues:
+                self._queues[variant] = _FairQueue(
+                    self._lane_weights, self._client_weights
+                )
+            self._queues[variant].push(pending)
+            depth += 1
+            lane_depths = self._lane_depths_locked()
         if self.metrics is not None:
             self.metrics.record_queue_depth(depth)
             self.metrics.record_lane_depths(lane_depths)
         return pending
+
+    def _lane_depths_locked(self) -> tuple[int, ...]:
+        totals = [0] * NUM_LANES
+        for q in self._queues.values():
+            for lane, d in enumerate(q.depths()):
+                totals[lane] += d
+        return tuple(totals)
 
     def _validate(self, r: Request) -> str | None:
         e = self.engine
@@ -435,9 +511,29 @@ class Scheduler:
 
     # -- engine-driver side (one thread) ----------------------------------
 
+    # -- iteration-boundary callbacks (deploy hot-swap) --------------------
+
+    def at_boundary(self, fn) -> None:
+        """Run ``fn()`` on the driver thread at the next iteration
+        boundary — after the previous engine round returned, before the
+        next admission. Thread-safe; callbacks run once, in submission
+        order, and exceptions propagate to the driver (a broken swap
+        path is a bug, not traffic)."""
+        self._boundary.append(fn)
+
+    def _run_boundary(self) -> None:
+        while True:
+            try:
+                fn = self._boundary.popleft()
+            except IndexError:
+                return
+            fn()
+
     def step(self) -> int:
-        """One serving iteration (shed → admit → decode → complete).
-        Returns the number of requests completed this iteration."""
+        """One serving iteration (boundary callbacks → shed → admit →
+        decode → complete). Returns the number of requests completed
+        this iteration."""
+        self._run_boundary()
         now = self.clock()
         self._shed_expired(now)
         self._admit(now)
@@ -481,10 +577,13 @@ class Scheduler:
 
     def _shed_expired(self, now: float) -> None:
         with self._lock:
-            expired = self._queue.remove_if(
-                lambda p: (p.request.deadline_s is not None
-                           and now - p.submitted_at > p.request.deadline_s)
-            )
+            expired = []
+            for q in self._queues.values():
+                expired.extend(q.remove_if(
+                    lambda p: (p.request.deadline_s is not None
+                               and now - p.submitted_at
+                               > p.request.deadline_s)
+                ))
         for pending in expired:
             r = pending.request
             pending.finish(
@@ -496,15 +595,52 @@ class Scheduler:
             )
             self._count_shed()
 
+    def _current_variant(self) -> str:
+        return self.engine.serving_variant if self.variants is not None else ""
+
     def _admit(self, now: float) -> None:
         while True:
             with self._lock:
-                if not len(self._queue):
+                if not any(len(q) for q in self._queues.values()):
                     return
-                slot = self.engine.acquire_slot()
-                if slot is None:
+                cur = self._current_variant()
+                curq = self._queues.get(cur)
+                cur_depth = len(curq) if curq is not None else 0
+                others = sorted(
+                    v for v, q in self._queues.items()
+                    if v != cur and len(q)
+                )
+                switch_to = None
+                if others and (cur_depth == 0
+                               or self._variant_served
+                               >= self.variant_quantum):
+                    if (self._inflight or getattr(
+                            self.engine, "prefilling_count", 0)):
+                        # The engine can only change variant buffers at
+                        # an EMPTY boundary (slots pin their variant).
+                        # Stop admitting so the current cohort drains;
+                        # decode keeps running in step().
+                        return
+                    # Rotate round-robin by name so two busy variants
+                    # alternate rather than one always winning the tie.
+                    switch_to = next(
+                        (v for v in others if v > cur), others[0]
+                    )
+                elif cur_depth == 0:
                     return
-                pending = self._queue.pop()
+                if switch_to is None:
+                    slot = self.engine.acquire_slot()
+                    if slot is None:
+                        return
+                    pending = self._queues[cur].pop()
+            if switch_to is not None:
+                # Empty iteration boundary: flip the engine onto the
+                # next variant's staged buffer — a reference swap
+                # between jitted rounds, no recompile — then resume
+                # admitting from that variant's queue.
+                self.variants.activate(switch_to)
+                self._variant_served = 0
+                continue
             r = pending.request
             try:
                 first, finished = self.engine.start(
@@ -521,25 +657,28 @@ class Scheduler:
                 # holds all its pages up front, so progress is guaranteed.
                 self.engine.release(slot)
                 with self._lock:
-                    self._queue.push_front(pending)
+                    self._queues[pending.variant].push_front(pending)
                 return
             except Exception as exc:  # _validate should prevent this
                 self.engine.release(slot)
                 pending.finish(Rejection(r.request_id, "invalid", str(exc)))
                 self._count_shed()
                 continue
+            self._variant_served += 1
             done_at = self.clock()
+            wv = int(getattr(self.engine, "weight_version", 0))
             if first is None:
                 # Chunked prefill scheduled: the slot is PREFILLING and
                 # the first token arrives from a later engine round (the
                 # step() collection loop records TTFT then).
                 self._inflight[slot] = _InFlight(pending, None, done_at,
-                                                 None)
+                                                 None, pending.variant, wv)
                 continue
             ttft = done_at - pending.submitted_at
             if self.metrics is not None:
                 self.metrics.record_ttft(ttft)
-            fl = _InFlight(pending, first, done_at, ttft)
+            fl = _InFlight(pending, first, done_at, ttft, pending.variant,
+                           wv)
             pending.push_tokens([int(first)])
             if finished:
                 self.engine.release(slot)
@@ -567,10 +706,12 @@ class Scheduler:
                 ttft_s=fl.ttft_s,
                 latency_s=now - fl.pending.submitted_at,
                 finish_reason=reason,
+                variant=fl.variant,
+                weight_version=fl.weight_version,
             )
         )
         if self.metrics is not None:
-            self.metrics.record_completed()
+            self.metrics.record_completed(variant=fl.variant)
 
     def run_until_idle(self, max_steps: int | None = None) -> int:
         """Drive ``step()`` until queue and slots are empty; returns total
@@ -578,8 +719,9 @@ class Scheduler:
         total = 0
         steps = 0
         while True:
+            self._run_boundary()
             with self._lock:
-                queued = len(self._queue)
+                queued = sum(len(q) for q in self._queues.values())
             if queued == 0 and not self._inflight:
                 return total
             total += self.step()
@@ -601,8 +743,11 @@ class Scheduler:
 
         def loop():
             while not self._stop.is_set():
+                # Boundary callbacks must drain even while idle — a hot
+                # swap submitted to a quiet replica still has to apply.
+                self._run_boundary()
                 with self._lock:
-                    idle = not len(self._queue)
+                    idle = not any(len(q) for q in self._queues.values())
                 if idle and not self._inflight:
                     self._stop.wait(poll_s)
                     continue
@@ -638,7 +783,7 @@ class Scheduler:
     @property
     def idle(self) -> bool:
         with self._lock:
-            queued = len(self._queue)
+            queued = sum(len(q) for q in self._queues.values())
         return queued == 0 and not self._inflight
 
     def stop(self, timeout: float = 5.0) -> None:
@@ -651,7 +796,9 @@ class Scheduler:
             self._thread.join(timeout)
             self._thread = None
         with self._lock:
-            leftovers = self._queue.drain_all()
+            leftovers = []
+            for q in self._queues.values():
+                leftovers.extend(q.drain_all())
         leftovers.extend(fl.pending for fl in self._inflight.values())
         for slot in list(self._inflight):
             del self._inflight[slot]
@@ -667,12 +814,17 @@ class Scheduler:
     @property
     def queue_depth(self) -> int:
         with self._lock:
-            return len(self._queue)
+            return sum(len(q) for q in self._queues.values())
 
     @property
     def lane_depths(self) -> tuple[int, ...]:
         with self._lock:
-            return self._queue.depths()
+            return self._lane_depths_locked()
+
+    def variant_depths(self) -> dict[str, int]:
+        """Queued requests per variant (healthz/debug readout)."""
+        with self._lock:
+            return {v: len(q) for v, q in self._queues.items() if len(q)}
 
     @property
     def inflight_count(self) -> int:
@@ -703,12 +855,18 @@ class Scheduler:
 class _InFlight:
     """Host-side accumulation for a request occupying a slot."""
 
-    __slots__ = ("pending", "tokens", "started_at", "ttft_s")
+    __slots__ = ("pending", "tokens", "started_at", "ttft_s", "variant",
+                 "weight_version")
 
-    def __init__(self, pending, first_token, started_at, ttft_s):
+    def __init__(self, pending, first_token, started_at, ttft_s,
+                 variant="", weight_version=0):
         self.pending = pending
         # first_token/ttft_s are None while the slot is PREFILLING
         # (chunked prefill) — both arrive with the final chunk's round.
         self.tokens = [] if first_token is None else [int(first_token)]
         self.started_at = started_at
         self.ttft_s = ttft_s
+        # Pinned at admission: the variant + checkpoint step the slot
+        # was started under (attribution survives later hot swaps).
+        self.variant = variant
+        self.weight_version = int(weight_version)

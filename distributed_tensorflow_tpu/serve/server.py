@@ -112,6 +112,7 @@ def _parse_request(body: dict, codec) -> Request:
         priority=priority,
         client_id=str(body.get("client_id", "")),
         stream=bool(body.get("stream", False)),
+        variant=str(body.get("variant", "")),
     )
 
 
@@ -216,6 +217,21 @@ def make_server(
                     pool = scheduler.engine.pool
                     body["pages_free"] = pool.pages_free
                     body["pages_total"] = pool.pages_allocatable
+                # Deploy state: which checkpoint step is live and which
+                # variants this replica can serve — the fleet registry
+                # reads this to route variant-pinned traffic.
+                deploy = {
+                    "weight_version": int(
+                        getattr(scheduler.engine, "weight_version", 0)
+                    ),
+                    "serving_variant": str(
+                        getattr(scheduler.engine, "serving_variant", "")
+                    ),
+                }
+                variants = getattr(scheduler, "variants", None)
+                if variants is not None:
+                    deploy.update(variants.snapshot())
+                body["deploy"] = deploy
                 drain_fn = getattr(scheduler, "drain_remaining_s", None)
                 remaining = drain_fn() if drain_fn is not None else None
                 if remaining is not None:
@@ -269,7 +285,13 @@ def make_server(
                 self._send(503, {"error": "timeout", "detail": str(exc)})
                 return
             if isinstance(outcome, Completion):
-                self._send(200, self._completion_payload(outcome))
+                # Attribution headers: which variant/weights served this
+                # request (the router relays them; loadgen splits its
+                # report by them).
+                self._send(200, self._completion_payload(outcome), {
+                    "X-Variant": outcome.variant,
+                    "X-Weight-Version": str(outcome.weight_version),
+                })
             else:
                 self._send_rejection(outcome)
 
@@ -280,6 +302,8 @@ def make_server(
                 "ttft_ms": outcome.ttft_s * 1e3,
                 "latency_ms": outcome.latency_s * 1e3,
                 "finish_reason": outcome.finish_reason,
+                "variant": outcome.variant,
+                "weight_version": outcome.weight_version,
             }
             if codec is not None:
                 payload["text"] = codec.decode(list(outcome.tokens))
@@ -310,6 +334,10 @@ def make_server(
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("X-Accel-Buffering", "no")
+            # Streams commit headers before completion: the variant was
+            # pinned at submit, so it is already exact; the final `done`
+            # frame carries the full attribution (incl. weight_version).
+            self.send_header("X-Variant", getattr(pending, "variant", ""))
             # No Content-Length on purpose: HTTP/1.0 close-delimited body,
             # so proxies cannot wait for "the whole response".
             self.end_headers()

@@ -25,6 +25,7 @@ import queue
 import shutil
 import threading
 import time
+import zipfile
 from typing import Any
 
 import jax
@@ -399,6 +400,84 @@ class _ShardStore:
                             node[idx] = [] if "i" in toks[i + 1] else {}
                         node = node[idx]
         return out
+
+
+# ---------------------------------------------------------------------------
+# Public sharded-format surface (serve/deploy/ and tools).
+#
+# The deploy watcher consumes checkpoints through these three functions
+# instead of re-parsing ``manifest_p*.json`` privately: the manifest walk,
+# the commit-marker rule (only COMMIT.json makes a step visible) and the
+# shard reassembly live in ONE place — :class:`_ShardStore` — no matter
+# whether the reader is a restore, a watcher, or a CLI.
+# ---------------------------------------------------------------------------
+
+
+def list_committed_steps(directory: str) -> list:
+    """Committed sharded-format steps under ``directory``, ascending.
+
+    A step counts only once its ``COMMIT.json`` marker exists (written by
+    the chief via atomic rename at finalize) — torn or uncommitted step
+    dirs (process killed mid-write, finalize never ran) are invisible,
+    exactly like restores treat them. Orbax-format steps are NOT listed:
+    this is the watch surface for the per-process shard+manifest format.
+    """
+    return _ShardStore(directory).committed_steps()
+
+
+def read_step(directory: str, step: int, template: Any | None = None):
+    """Read one COMMITTED sharded-format step.
+
+    ``template=None`` reassembles plain dicts/lists with numpy leaves
+    (cross-process-sharded leaves are stitched back to full arrays);
+    with a template, leaves restore against it like ``restore_latest``.
+    Raises ``OSError`` for an uncommitted/missing step or a committed dir
+    whose shard/manifest files are missing or torn (the caller — e.g. the
+    deploy watcher — skips and walks on, like restores walk back).
+    """
+    store = _ShardStore(directory)
+    d = store.step_dir(step)
+    if not store.is_committed(d):
+        raise OSError(
+            f"checkpoint step {step} in {directory} is not committed "
+            f"(no {_ShardStore.COMMIT})"
+        )
+    try:
+        return store.read(step, template)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError,
+            zipfile.BadZipFile) as e:
+        raise OSError(
+            f"checkpoint step {step} in {directory} is committed but "
+            f"unreadable: {type(e).__name__}: {e}"
+        ) from e
+
+
+def write_committed_step(directory: str, step: int, tree: Any) -> str:
+    """Publish ``tree`` as ONE committed sharded-format step from this
+    process (shard_p<K>.npz + manifest_p<K>.json + COMMIT.json, all via
+    atomic renames). This is the single-process producer half of the
+    watch surface: trainers publish a weight tree for serving without a
+    multi-process finalize (whose commit is collective), and tests/bench
+    drop checkpoints the deploy watcher can adopt. Returns the step dir.
+
+    Host-fetchable leaves only (replicated or single-process); a
+    cross-process-sharded leaf cannot be published from one process.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    units = []
+    for path, leaf in flat:
+        arr = np.asarray(jax.device_get(leaf))
+        unit = _Unit(
+            None, jax.tree_util.keystr(path), _path_tokens(path),
+            arr.shape, arr.dtype, None,
+        )
+        unit.host = arr
+        units.append(unit)
+    store = _ShardStore(directory)
+    store.write_local(step, units)
+    faults.maybe_fail("ckpt_publish", f"step {step}")
+    store.commit(step)
+    return store.step_dir(step)
 
 
 class CheckpointManager:

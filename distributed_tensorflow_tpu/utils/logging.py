@@ -9,10 +9,12 @@ import sys
 _FORMAT = "%(asctime)s %(levelname).1s %(name)s] %(message)s"
 
 
-def get_logger(name: str = "dtf_tpu", level: int = logging.INFO) -> logging.Logger:
+def get_logger(
+    name: str = "dtf_tpu", level: int = logging.INFO, stream=None
+) -> logging.Logger:
     logger = logging.getLogger(name)
     if not logger.handlers:
-        handler = logging.StreamHandler(sys.stdout)
+        handler = logging.StreamHandler(stream if stream is not None else sys.stdout)
         handler.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
         logger.addHandler(handler)
         logger.setLevel(level)

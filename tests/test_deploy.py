@@ -1,0 +1,673 @@
+"""serve/deploy tests: the close-the-loop plane.
+
+Committed-step helpers (torn/uncommitted/corrupt dirs invisible or
+typed-unreadable), the checkpoint watcher (newest-once delivery, skip
+discipline), zero-recompile hot swap through the canary gate (NaN and
+eval-loss rollbacks, flight-recorder dump), per-variant scheduling with
+deterministic client-lane routing, variant-aware fleet routing, and the
+swap-under-load e2e over real HTTP: a live server adopts a newly
+committed checkpoint mid-burst with zero dropped requests and zero
+recompiles, and a DTT_FAULT-poisoned checkpoint rolls back without
+serving a single token.
+"""
+
+import glob
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from distributed_tensorflow_tpu.obs import recorder as obs_recorder
+from distributed_tensorflow_tpu.serve import (
+    Request,
+    Scheduler,
+    ServingMetrics,
+    SlotEngine,
+)
+from distributed_tensorflow_tpu.serve.deploy import (
+    CheckpointWatcher,
+    VariantTable,
+    WeightSwapper,
+    variant_lane,
+)
+from distributed_tensorflow_tpu.serve.deploy.watcher import _extract_params
+from distributed_tensorflow_tpu.serve.fleet import FleetRouter, ReplicaRegistry
+from distributed_tensorflow_tpu.serve.fleet.registry import ProbeResult
+from distributed_tensorflow_tpu.serve.scheduler import Completion, Rejection
+from distributed_tensorflow_tpu.train.checkpoint import (
+    list_committed_steps,
+    read_step,
+    write_committed_step,
+)
+from distributed_tensorflow_tpu.utils import faults
+
+pytestmark = [pytest.mark.deploy, pytest.mark.serve]
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    num_heads=4,
+    num_layers=2,
+    d_ff=64,
+    max_seq_len=32,
+    compute_dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params_pair():
+    """Two same-structure, different-content param trees."""
+    model = TransformerLM(CFG)
+    zeros = jnp.zeros((1, 8), jnp.int32)
+    return (
+        model.init(jax.random.PRNGKey(0), zeros)["params"],
+        model.init(jax.random.PRNGKey(1), zeros)["params"],
+    )
+
+
+def _tree_allclose(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y)), a, b)
+
+
+# ---------------------------------------------------------------------------
+# Committed-step helpers (the watch surface of train/checkpoint.py)
+# ---------------------------------------------------------------------------
+
+
+def test_write_list_read_roundtrip(tmp_path, params_pair):
+    d = str(tmp_path / "ck")
+    step_dir = write_committed_step(d, 3, {"params": params_pair[0]})
+    assert os.path.isdir(step_dir)
+    assert list_committed_steps(d) == [3]
+    tree = read_step(d, 3)
+    _tree_allclose(tree["params"], jax.device_get(params_pair[0]))
+
+
+def test_steps_list_ascending_regardless_of_publish_order(tmp_path):
+    d = str(tmp_path / "ck")
+    for step in (5, 2, 9):
+        write_committed_step(d, step, {"w": np.arange(4.0, dtype=np.float32)})
+    assert list_committed_steps(d) == [2, 5, 9]
+
+
+def test_uncommitted_step_is_invisible_and_unreadable(tmp_path):
+    """No COMMIT.json (finalize never ran / writer died) = the step does
+    not exist: not listed, and read_step raises a typed OSError."""
+    d = str(tmp_path / "ck")
+    step_dir = write_committed_step(d, 4, {"w": np.ones(4, np.float32)})
+    os.remove(os.path.join(step_dir, "COMMIT.json"))
+    assert list_committed_steps(d) == []
+    with pytest.raises(OSError, match="not committed"):
+        read_step(d, 4)
+
+
+def test_torn_committed_step_raises_typed_oserror(tmp_path):
+    """COMMITTED but the shard file is gone (torn dir): still listed (the
+    commit marker is the visibility rule) but reading is a typed OSError,
+    never a crash deeper in npz parsing."""
+    d = str(tmp_path / "ck")
+    step_dir = write_committed_step(d, 7, {"w": np.ones(4, np.float32)})
+    os.remove(os.path.join(step_dir, "shard_p0.npz"))
+    assert list_committed_steps(d) == [7]
+    with pytest.raises(OSError, match="committed but unreadable"):
+        read_step(d, 7)
+
+
+def test_corrupt_shard_and_manifest_raise_typed_oserror(tmp_path):
+    d = str(tmp_path / "ck")
+    sd = write_committed_step(d, 1, {"w": np.ones(4, np.float32)})
+    with open(os.path.join(sd, "shard_p0.npz"), "wb") as fh:
+        fh.write(b"not an npz at all")
+    with pytest.raises(OSError, match="committed but unreadable"):
+        read_step(d, 1)
+    sd = write_committed_step(d, 2, {"w": np.ones(4, np.float32)})
+    with open(os.path.join(sd, "manifest_p0.json"), "w") as fh:
+        fh.write("{torn json")
+    with pytest.raises(OSError, match="committed but unreadable"):
+        read_step(d, 2)
+
+
+def test_extract_params_modes():
+    tree = {"params": {"w": 1}, "opt_state": {"m": 2}, "global_step": 3}
+    assert _extract_params(tree, "auto") == {"w": 1}
+    assert _extract_params({"w": 1}, "auto") == {"w": 1}  # bare publish
+    assert _extract_params(tree, "") is tree
+    assert _extract_params({"a": {"b": {"w": 5}}}, "a/b") == {"w": 5}
+    with pytest.raises(KeyError):
+        _extract_params(tree, "no/such/key")
+
+
+# ---------------------------------------------------------------------------
+# Watcher
+# ---------------------------------------------------------------------------
+
+
+def test_watcher_delivers_newest_once(tmp_path):
+    d = str(tmp_path / "ck")
+    for step in (1, 2, 3):
+        write_committed_step(d, step, {"w": np.full(4, step, np.float32)})
+    got = []
+    w = CheckpointWatcher(d, lambda s, p: got.append((s, p)), start_after=-1)
+    assert w.poll_once() == 3  # newest only — no backlog replay
+    assert [s for s, _ in got] == [3]
+    np.testing.assert_allclose(got[0][1]["w"], np.full(4, 3.0))
+    assert w.poll_once() is None  # delivered at most once
+    write_committed_step(d, 4, {"w": np.full(4, 4.0, np.float32)})
+    assert w.poll_once() == 4
+    assert w.delivered_total == 2
+
+
+def test_watcher_fresh_boot_skips_existing_steps(tmp_path):
+    """Default start_after: whatever is committed at construction is the
+    bundle the replica already booted from — only NEW saves are swaps."""
+    d = str(tmp_path / "ck")
+    write_committed_step(d, 10, {"w": np.ones(4, np.float32)})
+    got = []
+    w = CheckpointWatcher(d, lambda s, p: got.append(s))
+    assert w.poll_once() is None
+    write_committed_step(d, 11, {"w": np.ones(4, np.float32)})
+    assert w.poll_once() == 11
+    assert got == [11]
+
+
+def test_watcher_skips_unreadable_step_permanently(tmp_path):
+    d = str(tmp_path / "ck")
+    write_committed_step(d, 2, {"w": np.full(4, 2.0, np.float32)})
+    torn = write_committed_step(d, 5, {"w": np.full(4, 5.0, np.float32)})
+    os.remove(os.path.join(torn, "shard_p0.npz"))
+    got = []
+    w = CheckpointWatcher(d, lambda s, p: got.append(s), start_after=-1)
+    # Newest (5) is unreadable -> warn + skip, fall back to 2.
+    assert w.poll_once() == 2
+    assert got == [2]
+    assert w.skipped_total == 1
+    assert w.poll_once() is None  # 5 is remembered bad, never retried
+
+
+def test_watcher_extracts_trainer_state_layout(tmp_path, params_pair):
+    d = str(tmp_path / "ck")
+    write_committed_step(d, 6, {
+        "params": params_pair[0],
+        "global_step": np.asarray(6, np.int32),
+    })
+    got = []
+    w = CheckpointWatcher(d, lambda s, p: got.append(p), start_after=-1)
+    assert w.poll_once() == 6
+    _tree_allclose(got[0], jax.device_get(params_pair[0]))
+
+
+# ---------------------------------------------------------------------------
+# Engine staging + the swap itself
+# ---------------------------------------------------------------------------
+
+
+def test_stage_weights_validates_structure_shape_dtype(params_pair):
+    engine = SlotEngine(CFG, params_pair[0], slots=2, max_len=32,
+                        prefill_len=12)
+    with pytest.raises(ValueError):
+        engine.stage_weights({"wrong": np.ones(4, np.float32)})
+    leaves, treedef = jax.tree_util.tree_flatten(params_pair[1])
+    bad = list(leaves)
+    bad[0] = np.zeros(np.shape(leaves[0]) + (1,), np.float32)  # shape
+    with pytest.raises(ValueError):
+        engine.stage_weights(jax.tree_util.tree_unflatten(treedef, bad))
+    bad = list(leaves)
+    # int32 vs float32 — x64 canonicalization can't paper over this one.
+    bad[0] = np.zeros(np.shape(leaves[0]), np.int32)
+    with pytest.raises(ValueError):
+        engine.stage_weights(jax.tree_util.tree_unflatten(treedef, bad))
+
+
+def test_hot_swap_at_boundary_zero_recompile_new_tokens(params_pair):
+    """The tentpole in one test: a swap submitted while requests are
+    queued applies at the scheduler iteration boundary, the post-swap
+    greedy continuation changes, the weight version rides the
+    Completion, and the engine's compiled-program count never moves."""
+    engine = SlotEngine(CFG, params_pair[0], slots=2, max_len=32,
+                        prefill_len=12)
+    compiled = engine.warmup()
+    metrics = ServingMetrics()
+    sched = Scheduler(engine, max_queue_depth=8, metrics=metrics)
+    prompt = (3, 1, 4, 1, 5)
+
+    before = sched.submit(Request(prompt=prompt, max_new_tokens=8))
+    sched.run_until_idle()
+    tokens_before = before.result(timeout=10).tokens
+    assert before.result(timeout=1).weight_version == 0
+
+    swapper = WeightSwapper(engine, sched, metrics=metrics,
+                            probe_prompts=[prompt])
+    swapper.submit(7, params_pair[1])
+    assert not swapper.wait_applied(timeout=0)  # boundary not reached yet
+    after = sched.submit(Request(prompt=prompt, max_new_tokens=8))
+    sched.run_until_idle()
+    assert swapper.wait_applied(timeout=0)
+    assert swapper.last.outcome == "ok"
+    assert engine.weight_version == 7
+    out = after.result(timeout=10)
+    assert out.weight_version == 7
+    assert out.tokens != tokens_before
+    assert engine.compile_count() == compiled
+    assert metrics.swap_count("ok") == 1
+    assert metrics.weight_version == 7
+
+
+def test_canary_nan_rollback_dumps_flight_recorder(tmp_path, params_pair):
+    engine = SlotEngine(CFG, params_pair[0], slots=2, max_len=32,
+                        prefill_len=12)
+    metrics = ServingMetrics()
+    swapper = WeightSwapper(engine, None, metrics=metrics)
+    leaves, treedef = jax.tree_util.tree_flatten(params_pair[1])
+    leaves = [np.full(np.shape(leaves[0]), np.nan, np.float32),
+              *leaves[1:]]
+    poisoned = jax.tree_util.tree_unflatten(treedef, leaves)
+    old_dir = obs_recorder.get_dump_dir()
+    obs_recorder.set_dump_dir(str(tmp_path))
+    try:
+        result = swapper.submit(9, poisoned)
+    finally:
+        obs_recorder.set_dump_dir(old_dir)
+    assert result.outcome == "rollback"
+    assert "non-finite leaf" in result.reason
+    assert engine.weight_version == 0  # the live reference never moved
+    assert engine.params is not poisoned
+    assert metrics.swap_count("rollback") == 1
+    assert metrics.snapshot()["swaps"]["rollback"] == 1
+    dumps = glob.glob(str(tmp_path / "flight_swap_rollback_*"))
+    assert dumps, "rollback must dump the flight recorder"
+    assert any("deploy_swap" in line for line in open(dumps[0]))
+
+
+def test_canary_eval_loss_gate_rolls_back(params_pair):
+    """A finite candidate that regresses the held-out eval loss beyond
+    max_loss_ratio is rejected (the gate that catches a *plausible* bad
+    checkpoint, not just NaN)."""
+    engine = SlotEngine(CFG, params_pair[0], slots=2, max_len=32,
+                        prefill_len=12)
+    swapper = WeightSwapper(engine, None, max_loss_ratio=0.01)
+    result = swapper.submit(5, params_pair[1])
+    assert result.outcome == "rollback"
+    assert "eval-loss regression" in result.reason
+    assert result.canary_loss is not None
+    assert result.baseline_loss is not None
+    assert engine.weight_version == 0
+
+
+@pytest.mark.fault
+def test_poisoned_checkpoint_fault_rolls_back_via_watcher(
+        tmp_path, params_pair):
+    """DTT_FAULT=deploy_nan:1 end to end: the committed checkpoint is
+    poisoned in-delivery, the canary rejects it, the live weights never
+    move, and the on-disk checkpoint itself stays intact."""
+    d = str(tmp_path / "ck")
+    write_committed_step(d, 4, {"params": params_pair[1]})
+    engine = SlotEngine(CFG, params_pair[0], slots=2, max_len=32,
+                        prefill_len=12)
+    swapper = WeightSwapper(engine, None)
+    w = CheckpointWatcher(d, swapper.submit, start_after=-1)
+    faults.configure("deploy_nan:1")
+    try:
+        assert w.poll_once() == 4
+    finally:
+        faults.reset()
+    assert swapper.last.outcome == "rollback"
+    assert engine.weight_version == 0
+    # The fault poisoned the delivered copy, not the checkpoint on disk.
+    tree = read_step(d, 4)
+    assert all(np.all(np.isfinite(leaf)) for leaf in
+               jax.tree_util.tree_leaves(tree["params"]))
+    # Clean redelivery: a fresh watcher hands over the intact candidate.
+    swapper2 = WeightSwapper(engine, None)
+    w2 = CheckpointWatcher(d, swapper2.submit, start_after=-1)
+    assert w2.poll_once() == 4
+    assert swapper2.last.outcome == "ok"
+    assert engine.weight_version == 4
+
+
+# ---------------------------------------------------------------------------
+# Variants: table, lanes, per-variant scheduling
+# ---------------------------------------------------------------------------
+
+
+def _client_in_lane(below, percent):
+    """A deterministic client id whose crc32 lane is (or is not) below
+    ``percent`` — searched, not hardcoded, so the test survives any
+    canary percentage."""
+    for i in range(1000):
+        cid = f"client-{i}"
+        if (variant_lane(cid) < percent) == below:
+            return cid
+    raise AssertionError("no client id found for the requested lane side")
+
+
+def test_variant_table_resolve_and_lifecycle(params_pair):
+    engine = SlotEngine(CFG, params_pair[0], slots=2, max_len=32,
+                        prefill_len=12)
+    table = VariantTable(engine, canary_percent=30.0)
+    assert engine.serving_variant == "main"
+    canary_client = _client_in_lane(True, 30.0)
+    main_client = _client_in_lane(False, 30.0)
+    # Before the canary variant exists, everyone gets the default.
+    assert table.resolve(canary_client) == "main"
+    table.set("canary", params_pair[1], step=99)
+    assert table.resolve(canary_client) == "canary"
+    assert table.resolve(main_client) == "main"
+    # Determinism: same client, same answer, every time.
+    assert all(table.resolve(canary_client) == "canary" for _ in range(5))
+    assert table.names() == ("canary", "main")
+    snap = table.snapshot()
+    assert snap["variants"]["canary"]["step"] == 99
+    assert snap["canary_percent"] == 30.0
+    with pytest.raises(ValueError):
+        table.remove("main")  # the default is not removable
+    with pytest.raises(KeyError):
+        table.activate("nope")
+    table.remove("canary")
+    assert table.resolve(canary_client) == "main"
+
+
+def test_scheduler_serves_two_variants_with_pinned_versions(params_pair):
+    """Two variants serve concurrently through ONE engine: requests route
+    by client lane (or explicit pin), every completion carries the
+    variant + weight version it was decoded under, variant switches cost
+    zero recompiles, and an unknown variant is a typed rejection."""
+    engine = SlotEngine(CFG, params_pair[0], slots=2, max_len=32,
+                        prefill_len=12)
+    compiled = engine.warmup()
+    table = VariantTable(engine, canary_percent=50.0)
+    table.set("canary", params_pair[1], step=99)
+    metrics = ServingMetrics()
+    sched = Scheduler(engine, max_queue_depth=32, metrics=metrics,
+                      variants=table)
+
+    expected = {}
+    pendings = {}
+    for i in range(8):
+        cid = f"ab-{i}"
+        expected[cid] = table.resolve(cid)
+        pendings[cid] = sched.submit(Request(
+            prompt=(1 + i, 2, 3), max_new_tokens=4, client_id=cid))
+    pinned = sched.submit(Request(prompt=(9, 9), max_new_tokens=4,
+                                  variant="canary"))
+    unknown = sched.submit(Request(prompt=(1,), max_new_tokens=2,
+                                   variant="nope"))
+    out = unknown.result(timeout=1)
+    assert isinstance(out, Rejection) and out.reason == "invalid"
+    assert "nope" in out.detail
+
+    sched.run_until_idle(max_steps=500)
+    assert {v for v in expected.values()} == {"main", "canary"}, (
+        "test client ids must land on both sides of the 50% lane split")
+    for cid, pending in pendings.items():
+        done = pending.result(timeout=10)
+        assert isinstance(done, Completion), done
+        assert done.variant == expected[cid]
+        assert done.weight_version == (99 if expected[cid] == "canary"
+                                       else 0)
+    assert pinned.result(timeout=10).variant == "canary"
+    assert engine.compile_count() == compiled  # variant flips recompile-free
+    counts = metrics.variant_requests()
+    assert counts["main"] + counts["canary"] == 9
+    assert sched.variant_depths() == {}
+
+
+def test_boundary_callbacks_run_without_traffic(params_pair):
+    engine = SlotEngine(CFG, params_pair[0], slots=2, max_len=32,
+                        prefill_len=12)
+    sched = Scheduler(engine, max_queue_depth=4)
+    ran = []
+    sched.at_boundary(lambda: ran.append(1))
+    sched.at_boundary(lambda: ran.append(2))
+    sched.run_until_idle(max_steps=1)
+    assert ran == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# Fleet: variant-aware pick + router canary resolve
+# ---------------------------------------------------------------------------
+
+
+def _fake_probe(results):
+    return lambda url: results[url]
+
+
+def test_registry_variant_pick_and_router_resolve():
+    results = {
+        "http://a": ProbeResult(
+            ok=True, accepting=True, slots=2, queue_depth=5,
+            weight_version=10, serving_variant="main",
+            variants=("canary", "main"),
+            canary_percent=25.0, canary_variant="canary"),
+        "http://b": ProbeResult(
+            ok=True, accepting=True, slots=2, queue_depth=0,
+            weight_version=9, serving_variant="main",
+            variants=("main",)),
+    }
+    registry = ReplicaRegistry(
+        ["http://a", "http://b"], probe=_fake_probe(results), up_after=1)
+    registry.probe_once()
+    assert registry.up_count() == 2
+    # No variant ask: pure least-loaded -> b (queue 0 beats queue 5).
+    assert registry.pick().replica_id == "b"
+    # Variant ask: the replica CARRYING it wins despite more load.
+    assert registry.pick(variant="canary").replica_id == "a"
+    # Preference, not a hard filter: unknown variant falls back to load.
+    assert registry.pick(variant="ghost").replica_id == "b"
+    snap = registry.snapshot()["replicas"]
+    assert snap["a"]["weight_version"] == 10
+    assert snap["a"]["variants"] == ["canary", "main"]
+
+    router = FleetRouter(registry)
+    canary_client = _client_in_lane(True, 25.0)
+    main_client = _client_in_lane(False, 25.0)
+    assert router.resolve_variant(canary_client) == "canary"
+    assert router.resolve_variant(main_client) is None
+    # Replica and router agree because both hash the same crc32 lane —
+    # a client the router steers to the canary lands in the replica
+    # table's canary lane too.
+    assert variant_lane(canary_client) < 25.0
+    assert variant_lane(main_client) >= 25.0
+
+
+# ---------------------------------------------------------------------------
+# e2e: swap under load over real HTTP
+# ---------------------------------------------------------------------------
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), json.loads(err.read())
+
+
+def test_http_swap_under_load_and_poisoned_rollback(tmp_path, params_pair):
+    """ISSUE 12 acceptance, end to end over HTTP: a burst is in flight
+    while a newly committed checkpoint swaps in — zero shed, zero
+    dropped, zero recompiles, responses attribute both weight versions,
+    post-swap output differs — then a poisoned checkpoint (DTT_FAULT
+    deploy_nan) rolls back without the advertised version moving."""
+    import importlib.util
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for p in (repo, os.path.join(repo, "tools")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    spec = importlib.util.spec_from_file_location(
+        "serve_lm", os.path.join(repo, "tools", "serve_lm.py"))
+    serve_lm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve_lm)
+    from distributed_tensorflow_tpu.config import DeployConfig, ServeConfig
+
+    ckpt_dir = str(tmp_path / "ck")
+    serve_cfg = ServeConfig(slots=2, serve_max_len=32, prefill_len=12,
+                            max_queue_depth=32)
+    deploy_cfg = DeployConfig(watch_dir=ckpt_dir, canary_rows=2,
+                              canary_len=12, canary_probes=1)
+    engine, sched, metrics, server = serve_lm.build_stack(
+        serve_cfg, CFG, params_pair[0], deploy_cfg=deploy_cfg)
+    swapper, watcher = server.swapper, server.watcher
+    assert swapper is not None and watcher is not None
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    sched.start(poll_s=0.001)
+    host, port = server.server_address
+    base = f"http://{host}:{port}"
+    probe_payload = {"prompt": [3, 1, 4, 1], "max_new_tokens": 6}
+    try:
+        # Warm the canary path (a long-lived server's first rollout) so
+        # the timed swap below is the steady-state one.
+        swapper.submit(1, params_pair[0])
+        assert swapper.wait_applied(timeout=120)
+        assert swapper.last.outcome == "ok"
+        _, _, before = _post(base + "/generate", probe_payload)
+
+        results = []
+        res_lock = threading.Lock()
+
+        def client(i):
+            status, headers, body = _post(base + "/generate", {
+                "prompt": [1 + (i % 7), 2, 3], "max_new_tokens": 20,
+                "request_id": f"burst-{i}",
+            })
+            with res_lock:
+                results.append((status, headers.get("X-Weight-Version"),
+                                body))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(8)]
+        for th in threads:
+            th.start()
+        # Publish + deliver the new checkpoint WHILE the burst decodes.
+        write_committed_step(ckpt_dir, 10, {"params": params_pair[1]})
+        assert watcher.poll_once() == 10
+        assert swapper.wait_applied(timeout=120)
+        assert swapper.last.outcome == "ok"
+        for th in threads:
+            th.join(60)
+        # Second wave: everything admitted now runs the new weights.
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(8, 12)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(60)
+
+        assert len(results) == 12
+        assert all(status == 200 for status, _, _ in results), results
+        versions = {wv for _, wv, _ in results}
+        assert "10" in versions, versions  # the swap really served traffic
+        assert all(len(body["tokens"]) > 0 for _, _, body in results)
+        assert server.sentinel.post_warm_total == 0  # zero recompiles
+        assert metrics.weight_version == 10
+
+        _, _, after = _post(base + "/generate", probe_payload)
+        assert after["tokens"] != before["tokens"]
+        assert after["weight_version"] == 10
+
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            health = json.loads(resp.read())
+        assert health["deploy"]["weight_version"] == 10
+
+        # Poisoned rollout: fault-poisoned delivery must roll back with
+        # the advertised version unmoved and zero tokens served from it.
+        faults.configure("deploy_nan:1")
+        try:
+            write_committed_step(ckpt_dir, 20, {"params": params_pair[0]})
+            assert watcher.poll_once() == 20
+        finally:
+            faults.reset()
+        assert swapper.wait_applied(timeout=120)
+        assert swapper.last.outcome == "rollback"
+        _, _, post_rb = _post(base + "/generate", probe_payload)
+        assert post_rb["weight_version"] == 10
+        assert post_rb["tokens"] == after["tokens"]
+        assert metrics.snapshot()["swaps"] == {"ok": 2, "rollback": 1}
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# Loadgen attribution + mid-run hook (tools/loadgen.py satellites)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def loadgen():
+    import importlib.util
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for p in (repo, os.path.join(repo, "tools")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    spec = importlib.util.spec_from_file_location(
+        "loadgen", os.path.join(repo, "tools", "loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_per_variant_attribution(loadgen):
+    acct = loadgen._Accounting()
+    for _ in range(4):
+        acct.complete(0.01, 0.05, 10, variant="main", weight_version=5)
+    for _ in range(2):
+        acct.complete(0.02, 0.08, 7, variant="canary", weight_version=99)
+    acct.complete(0.01, 0.05, 3, variant="main", weight_version=10)
+    report = acct.variant_report()
+    assert set(report) == {"canary", "main"}
+    assert report["main"]["completed"] == 5
+    assert report["main"]["tokens"] == 43
+    # A hot swap mid-run shows up as two weight versions in one variant.
+    assert report["main"]["weight_versions"] == [5, 10]
+    assert report["canary"]["weight_versions"] == [99]
+    assert report["canary"]["ttft_ms"]["p50"] == pytest.approx(20.0)
+    assert report["main"]["latency_ms"]["p99"] == pytest.approx(50.0)
+
+
+def test_loadgen_mid_run_hook_fires_once_at_halfway(loadgen):
+    fired = []
+    seen = []
+    lock = threading.Lock()
+
+    def submit_one(payload, timeout_s, acct):
+        with lock:
+            seen.append(payload["i"])
+        acct.complete(0.0, 0.001, 1, variant="")
+
+    acct, _ = loadgen.run_load(
+        submit_one,
+        num_requests=12,
+        concurrency=3,
+        rate=0.0,
+        make_payload=lambda i: {"i": i},
+        timeout_s=5.0,
+        mid_run_hook=lambda: fired.append(len(seen)),
+    )
+    assert acct.completed == 12
+    assert len(fired) == 1  # exactly once
+    # Fired at the halfway index: some requests were already through,
+    # some had not been dispatched yet.
+    assert 0 < fired[0] < 12

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Watch a checkpoint directory the way the serving deploy plane does.
+
+A standalone dry-run of ``serve/deploy/watcher.py``: poll a save dir for
+newly COMMITTED steps (the atomic-rename commit marker discipline from
+``train/checkpoint.py``), optionally assemble each step's shards into a
+full tree to prove it is servable, and emit one JSONL event per
+observation. What prints here is exactly what a serving replica's
+watcher would hand its swapper — so run this against a trainer's
+``--ckpt_dir`` to debug a rollout without touching a live engine.
+
+  python tools/deploy_watch.py --dir runs/ckpt              # follow
+  python tools/deploy_watch.py --dir runs/ckpt --once       # single poll
+  python tools/deploy_watch.py --dir runs/ckpt --validate   # + assembly
+
+Events (one JSON object per line):
+  {"event": "committed", "step": N, ...}     new committed step seen
+  {"event": "validated", "step": N, ...}     shards assembled cleanly
+  {"event": "unreadable", "step": N, ...}    committed but torn/corrupt
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _emit(**event):
+    print(json.dumps(event), flush=True)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dir", required=True,
+                        help="checkpoint directory to watch")
+    parser.add_argument("--interval_s", type=float, default=0.5,
+                        help="poll period")
+    parser.add_argument("--once", action="store_true",
+                        help="one poll, then exit (0 = saw a new step)")
+    parser.add_argument("--validate", action="store_true",
+                        help="assemble each new step's shards (reads the "
+                        "full checkpoint; proves it is servable)")
+    parser.add_argument("--params_key", default="auto",
+                        help="subtree a server would extract ('auto', '', "
+                        "or a '/'-separated path)")
+    parser.add_argument("--from_step", type=int, default=-1,
+                        help="report steps strictly greater than this "
+                        "(-1 = everything already committed, then follow)")
+    args = parser.parse_args(argv)
+
+    from distributed_tensorflow_tpu.serve.deploy.watcher import (
+        _extract_params,
+    )
+    from distributed_tensorflow_tpu.train.checkpoint import (
+        list_committed_steps,
+        read_step,
+    )
+
+    last = args.from_step
+    bad = set()
+
+    def poll():
+        nonlocal last
+        saw = False
+        for step in list_committed_steps(args.dir):
+            if step <= last or step in bad:
+                continue
+            saw = True
+            last = max(last, step)
+            _emit(event="committed", step=step, dir=args.dir,
+                  t=round(time.time(), 3))
+            if not args.validate:
+                continue
+            try:
+                tree = read_step(args.dir, step)
+                params = _extract_params(tree, args.params_key)
+            except (OSError, KeyError) as e:
+                bad.add(step)
+                _emit(event="unreadable", step=step,
+                      error=f"{type(e).__name__}: {e}")
+                continue
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(params)
+            _emit(event="validated", step=step, leaves=len(leaves),
+                  bytes=int(sum(getattr(x, "nbytes", 0) for x in leaves)))
+        return saw
+
+    if args.once:
+        sys.exit(0 if poll() else 1)
+    _emit(event="watching", dir=args.dir, interval_s=args.interval_s)
+    try:
+        while True:
+            poll()
+            time.sleep(args.interval_s)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

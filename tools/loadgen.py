@@ -72,8 +72,14 @@ class _Accounting:
         self.shed_reasons = {}
         self.per_replica = {}
         self.failovers = 0
+        # Deploy attribution, keyed by the X-Variant response header
+        # ("" = single-variant serving): per-variant latency samples +
+        # token counts, and every weight version observed per variant —
+        # a hot swap mid-run shows up as two versions under one variant.
+        self.per_variant = {}
 
-    def complete(self, ttft_s, latency_s, n_tokens, gaps=None):
+    def complete(self, ttft_s, latency_s, n_tokens, gaps=None,
+                 variant=None, weight_version=None):
         """``gaps``: measured inter-token gaps (SSE frame arrivals). When
         absent, the decode-phase mean (latency - ttft) / (n - 1) stands in
         — per-request, so the percentile spread across requests survives."""
@@ -87,6 +93,33 @@ class _Accounting:
             elif n_tokens > 1 and latency_s > ttft_s >= 0:
                 self.intertoken_s.append(
                     (latency_s - ttft_s) / (n_tokens - 1))
+            if variant is not None:
+                v = self.per_variant.setdefault(variant, {
+                    "completed": 0, "tokens": 0, "ttft_s": [],
+                    "latency_s": [], "weight_versions": set(),
+                })
+                v["completed"] += 1
+                v["tokens"] += n_tokens
+                v["ttft_s"].append(ttft_s)
+                v["latency_s"].append(latency_s)
+                if weight_version is not None:
+                    v["weight_versions"].add(int(weight_version))
+
+    def variant_report(self):
+        """JSON-ready per-variant split (p50/p95/p99 + token parity)."""
+        with self.lock:
+            return {
+                name: {
+                    "completed": v["completed"],
+                    "tokens": v["tokens"],
+                    "weight_versions": sorted(v["weight_versions"]),
+                    "ttft_ms": {k: round(x * 1e3, 3) for k, x in
+                                _percentiles(v["ttft_s"]).items()},
+                    "latency_ms": {k: round(x * 1e3, 3) for k, x in
+                                   _percentiles(v["latency_s"]).items()},
+                }
+                for name, v in sorted(self.per_variant.items())
+            }
 
     def reject(self, reason):
         with self.lock:
@@ -156,6 +189,8 @@ def _read_sse(resp, t0, acct):
         time.monotonic() - t0,
         tokens or len(done.get("tokens", ())),
         gaps=gaps,
+        variant=done.get("variant", ""),
+        weight_version=done.get("weight_version"),
     )
     return True
 
@@ -179,11 +214,17 @@ def _http_submit(url, payload, timeout_s, acct, stream=False):
             if ctype.startswith("text/event-stream"):
                 _read_sse(resp, t0, acct)
                 return
+            variant = resp.headers.get("X-Variant")
+            wv = resp.headers.get("X-Weight-Version")
             body = json.loads(resp.read())
         acct.complete(
             body.get("ttft_ms", 0.0) / 1e3,
             time.monotonic() - t0,
             len(body.get("tokens", ())),
+            variant=variant if variant is not None
+            else body.get("variant", ""),
+            weight_version=wv if wv is not None
+            else body.get("weight_version"),
         )
     except urllib.error.HTTPError as e:
         try:
@@ -215,7 +256,9 @@ def _sched_submit(scheduler, payload, timeout_s, acct):
         acct.error()
         return
     if isinstance(outcome, Completion):
-        acct.complete(outcome.ttft_s, outcome.latency_s, len(outcome.tokens))
+        acct.complete(outcome.ttft_s, outcome.latency_s, len(outcome.tokens),
+                      variant=outcome.variant,
+                      weight_version=outcome.weight_version)
     else:
         acct.reject(outcome.reason)
 
@@ -308,11 +351,28 @@ def run_load(
     rate,
     make_payload,
     timeout_s,
+    mid_run_hook=None,
 ):
     """Drive ``submit_one(payload)`` for ``num_requests`` requests.
-    ``rate`` > 0 switches to open loop at that many req/s."""
+    ``rate`` > 0 switches to open loop at that many req/s.
+    ``mid_run_hook`` fires exactly once, just before the request at the
+    halfway index is dispatched — the swap-under-load lever: the e2e
+    test and ``bench_hotswap`` publish a new checkpoint from it, so
+    roughly half the burst lands on each weight version."""
     acct = _Accounting()
     threads = []
+    hook_lock = threading.Lock()
+    hook_done = [mid_run_hook is None]
+
+    def maybe_hook(i):
+        if i < num_requests // 2 or hook_done[0]:
+            return
+        with hook_lock:
+            if hook_done[0]:
+                return
+            hook_done[0] = True
+        mid_run_hook()
+
     t_start = time.monotonic()
     if rate and rate > 0:
         # Open loop: fixed schedule, one thread per in-flight request; late
@@ -322,6 +382,7 @@ def run_load(
             delay = target - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
+            maybe_hook(i)
             th = threading.Thread(
                 target=submit_one, args=(make_payload(i), timeout_s, acct),
                 daemon=True,
@@ -339,6 +400,7 @@ def run_load(
                     if i >= num_requests:
                         return
                     next_idx[0] += 1
+                maybe_hook(i)
                 submit_one(make_payload(i), timeout_s, acct)
 
         for _ in range(max(1, concurrency)):
@@ -401,6 +463,14 @@ def main(argv=None):
         help="mix in prompts LONGER than the prefill window (up to "
         "seq_len - max_new - 1): the chunked-prefill workload — half the "
         "requests draw long, half stay short/heterogeneous",
+    )
+    parser.add_argument(
+        "--swap_mid_run", default="",
+        help="shell command to run once at the halfway request index — "
+        "e.g. a script that publishes a committed checkpoint into the "
+        "target's --watch_dir, turning the run into a swap-under-load "
+        "measurement (per-variant / per-weight-version attribution in "
+        "the report shows the before/after split)",
     )
     parser.add_argument(
         "--prefix_groups", type=int, default=0,
@@ -532,6 +602,14 @@ def main(argv=None):
         def submit_one(payload, timeout_s, acct):
             _sched_submit(scheduler, payload, timeout_s, acct)
 
+    mid_run_hook = None
+    if args.swap_mid_run:
+        import subprocess
+
+        def mid_run_hook():
+            print(f"swap_mid_run: {args.swap_mid_run}", file=sys.stderr)
+            subprocess.run(args.swap_mid_run, shell=True, check=False)
+
     acct, wall_s = run_load(
         submit_one,
         num_requests=args.num_requests,
@@ -539,6 +617,7 @@ def main(argv=None):
         rate=args.rate,
         make_payload=make_payload,
         timeout_s=args.timeout_s,
+        mid_run_hook=mid_run_hook,
     )
     # Scrape server health BEFORE teardown so the report record is
     # self-describing: was the server SLO-degraded during this run, and did
@@ -608,6 +687,8 @@ def main(argv=None):
         "stream": bool(args.stream),
         "per_replica": acct.per_replica,
         "failovers": acct.failovers,
+        "per_variant": acct.variant_report(),
+        "swap_mid_run": args.swap_mid_run,
     }
     print(json.dumps(report))
     if args.report_file:

@@ -22,6 +22,15 @@ parses that, so N replicas never race for ports. SIGTERM/SIGINT to the
 launcher drains the whole fleet: replicas get SIGTERM (their own drain
 path finishes accepted work), then the router exits.
 
+Hot deploy composes through the same forwarding: pass the
+``DeployConfig`` flags (``--watch_dir``, ``--canary_percent``,
+``--deploy_variant``, …) and every replica runs its own checkpoint
+watcher against the shared directory — a committed save rolls across
+the fleet one canaried swap at a time, replicas advertise their live
+weight version + variant table on ``/healthz``, and the router routes
+variant-pinned traffic (explicit ``"variant"`` in the body, or the
+fleet canary resolve on ``client_id``) to replicas that carry it.
+
 ``launch_fleet()`` / ``ReplicaProc`` are importable — ``bench.py`` and
 the e2e kill-a-replica test drive the same spawning code as the CLI.
 """

@@ -48,7 +48,7 @@ class _ByteCodec:
         return decode_tokens(np.asarray(tokens, np.int32))
 
 
-def build_stack(serve_cfg, cfg, params):
+def build_stack(serve_cfg, cfg, params, deploy_cfg=None):
     """(engine, scheduler, metrics, http server) — warmed up, not started.
     Factored out so tests and loadgen --self-serve drive the same wiring
     as the CLI.
@@ -56,7 +56,14 @@ def build_stack(serve_cfg, cfg, params):
     The SLO monitor and recompile sentinel ride along as ``server.slo_monitor``
     / ``server.sentinel`` attributes (the 4-tuple is a published contract).
     The caller owns the monitor's ticker (``main()`` starts it; tests call
-    ``evaluate()`` by hand)."""
+    ``evaluate()`` by hand).
+
+    ``deploy_cfg`` (a ``config.DeployConfig``) adds the hot-swap plane:
+    a VariantTable when canary/variant serving is configured, a
+    WeightSwapper always, and a CheckpointWatcher when ``watch_dir`` is
+    set — riding along as ``server.variant_table`` / ``server.swapper`` /
+    ``server.watcher`` (None when absent). The caller starts/stops the
+    watcher thread."""
     from distributed_tensorflow_tpu import obs
     from distributed_tensorflow_tpu.serve import (
         Scheduler,
@@ -145,13 +152,61 @@ def build_stack(serve_cfg, cfg, params):
         draft_cfg=draft_cfg,
         draft_window=getattr(serve_cfg, "draft_window", 16),
     )
+    variants = swapper = watcher = None
+    if deploy_cfg is not None:
+        from distributed_tensorflow_tpu.serve.deploy import (
+            CheckpointWatcher,
+            VariantTable,
+            WeightSwapper,
+            make_canary_batch,
+        )
+
+        deploy_cfg.validate()
+        if deploy_cfg.canary_percent > 0 or deploy_cfg.deploy_variant:
+            variants = VariantTable(
+                engine,
+                canary_percent=deploy_cfg.canary_percent,
+                canary_variant=deploy_cfg.canary_variant,
+            )
+        canary_batch = make_canary_batch(
+            cfg.vocab_size,
+            rows=deploy_cfg.canary_rows,
+            length=min(deploy_cfg.canary_len, int(cfg.max_seq_len)),
+        )
+        swapper = WeightSwapper(
+            engine,
+            None,  # scheduler bound just below (it needs the table first)
+            metrics=metrics,
+            variants=variants,
+            canary_batch=canary_batch,
+            probe_prompts=[
+                tuple(row[:8]) for row in
+                canary_batch[:deploy_cfg.canary_probes]
+            ],
+            max_loss_ratio=deploy_cfg.max_loss_ratio,
+        )
+        # Compile the canary's eager executables against the live params
+        # while the sentinel still counts compiles as warmup — the first
+        # real swap must not breach the zero-recompile SLO.
+        swapper.prewarm()
     engine.warmup()
     scheduler = Scheduler(
         engine,
         max_queue_depth=serve_cfg.max_queue_depth,
         metrics=metrics,
         lane_weights=getattr(serve_cfg, "lane_weight_tuple", (8, 4, 1)),
+        variants=variants,
     )
+    if swapper is not None:
+        swapper.scheduler = scheduler
+        if deploy_cfg.enabled:
+            target = deploy_cfg.deploy_variant or None
+            watcher = CheckpointWatcher(
+                deploy_cfg.watch_dir,
+                lambda step, p: swapper.submit(step, p, variant=target),
+                poll_interval_s=deploy_cfg.watch_interval_s,
+                params_key=deploy_cfg.deploy_params_key,
+            )
     slo_rules = obs.parse_slo_flag(
         getattr(serve_cfg, "slo", "default"),
         defaults=obs.default_serving_rules)
@@ -169,6 +224,9 @@ def build_stack(serve_cfg, cfg, params):
     server.slo_monitor = slo_monitor
     server.sentinel = sentinel
     server.serving_metrics = metrics
+    server.variant_table = variants
+    server.swapper = swapper
+    server.watcher = watcher
     return engine, scheduler, metrics, server
 
 
@@ -197,9 +255,13 @@ def main(argv=None):
     )
     args, rest = parser.parse_known_args(argv)
 
-    from distributed_tensorflow_tpu.config import ServeConfig, parse_flags
+    from distributed_tensorflow_tpu.config import (
+        DeployConfig,
+        ServeConfig,
+        parse_flags,
+    )
 
-    serve_cfg = parse_flags(ServeConfig, argv=rest)
+    serve_cfg, deploy_cfg = parse_flags(ServeConfig, DeployConfig, argv=rest)
     if args.quant:
         serve_cfg.weight_dtype = args.quant
 
@@ -246,8 +308,17 @@ def main(argv=None):
 
         cfg = replace(cfg, kv_cache_dtype=args.kv_cache_dtype)
 
-    engine, scheduler, metrics, server = build_stack(serve_cfg, cfg, params)
+    engine, scheduler, metrics, server = build_stack(
+        serve_cfg, cfg, params, deploy_cfg=deploy_cfg)
     host, port = server.server_address
+    if server.watcher is not None:
+        print(
+            f"deploy: watching {deploy_cfg.watch_dir} every "
+            f"{deploy_cfg.watch_interval_s}s "
+            f"(variant={deploy_cfg.deploy_variant or '<live>'} "
+            f"canary={deploy_cfg.canary_percent}%)",
+            flush=True,
+        )
     kv_desc = (
         f"paged(page_size={engine.page_size} pages={engine.pool.num_pages} "
         f"prefix={'on' if engine.prefix is not None else 'off'} "
@@ -313,6 +384,8 @@ def main(argv=None):
     scheduler.start()
     if server.slo_monitor is not None:
         server.slo_monitor.start(serve_cfg.slo_interval_s)
+    if server.watcher is not None:
+        server.watcher.start()
 
     # SIGTERM = graceful drain (the fleet contract): stop accepting so
     # /healthz flips 503 and the router marks this replica draining, keep
@@ -344,6 +417,8 @@ def main(argv=None):
         pass
     finally:
         server.shutdown()
+        if server.watcher is not None:
+            server.watcher.stop()
         if server.slo_monitor is not None:
             server.slo_monitor.stop()
         scheduler.stop()
