@@ -1585,6 +1585,284 @@ def bench_fleet() -> list[dict]:
             replica.terminate()
 
 
+def bench_fleet_elastic() -> list[dict]:
+    """ISSUE 13's acceptance run, two phases.
+
+    **Elastic**: a supervised single-replica fleet takes the diurnal
+    loadgen shape (trough -> ramp -> peak -> evening -> night) at an
+    offered rate whose PEAK exceeds one replica's measured capacity.
+    The supervisor must scale up on the sustained pressure crossing
+    within the reaction budget (replica budget max=2), and the run must
+    terminate with every request in a typed bucket — ``--smoke`` exits
+    nonzero on a silent drop, so zero-drops is hard-asserted in-run.
+    The routed p99 TTFT under the shape is recorded against a fixed
+    budget (FRAC_CEILS): queueing through the peak is expected, an
+    unbounded tail (a stalled admission loop or a replica the router
+    keeps dispatching into) is not.
+
+    **Disaggregated**: one prefill-role + one decode-role replica
+    (handoff peers pushed) against a mixed-role baseline replica built
+    from the SAME --demo seed: /generate streams through the KV-page
+    handoff must be token-identical to the baseline for greedy, chunked
+    and sampled lanes, with the handoffs ACCEPTED (a parity win via
+    local fallback would prove nothing, so fallback==0 is asserted
+    too)."""
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from serve_fleet import ReplicaProc, push_handoff_peers
+
+    from distributed_tensorflow_tpu.obs.export import parse_prometheus_text
+    from distributed_tensorflow_tpu.serve.fleet import (
+        FleetRouter,
+        FleetSupervisor,
+        ReplicaRegistry,
+        make_router_server,
+    )
+
+    if SMOKE:
+        shape = ["--vocab_size", "256", "--d_model", "32", "--num_heads",
+                 "4", "--num_layers", "2", "--d_ff", "64", "--seq_len",
+                 "64", "--slots", "2", "--prefill_len", "16",
+                 "--serve_max_len", "64", "--prefill_chunk_tokens", "8"]
+        # Decode-heavy requests: the tiny demo replica sustains ~90
+        # short req/s, which would compress the whole diurnal shape
+        # under one probe cycle. 48 new tokens per request brings the
+        # sustainable rate down to where the shape has wall-clock.
+        load = ["--prompt_len", "8", "--max_new_tokens", "48"]
+        n_cal, conc = 12, 2
+        loadgen_timeout = 300
+        reaction_budget_s = 120.0   # includes the replica's CPU jax boot
+        ttft_budget_ms = 30_000.0
+    else:
+        shape = ["--vocab_size", "512", "--d_model", "256", "--num_heads",
+                 "8", "--num_layers", "4", "--d_ff", "1024", "--seq_len",
+                 "64", "--slots", "4", "--prefill_len", "16",
+                 "--serve_max_len", "64", "--prefill_chunk_tokens", "8"]
+        load = ["--prompt_len", "12", "--max_new_tokens", "32"]
+        n_cal, conc = 16, 4
+        loadgen_timeout = 600
+        reaction_budget_s = 60.0
+        ttft_budget_ms = 10_000.0
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+
+    def spawn(role):
+        extra = [] if role == "mixed" else ["--role", role]
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(tools_dir, "serve_lm.py"),
+             "--port", "0", "--demo", *shape, *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        replica = ReplicaProc(proc)
+        replica.wait_url(300.0)
+        replica.role = role
+        return replica
+
+    def run_loadgen(target, n, extra):
+        with tempfile.NamedTemporaryFile(mode="r", suffix=".jsonl") as fh:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(tools_dir, "loadgen.py"),
+                 "--targets", target, "--num_requests", str(n),
+                 "--smoke", "--seed", "0", "--timeout_s", "240",
+                 "--report_file", fh.name, *load, *extra],
+                env=env, capture_output=True, text=True,
+                timeout=loadgen_timeout,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"loadgen against {target} failed rc={proc.returncode} "
+                    f"(a DROP fails --smoke): {proc.stderr[-500:]}"
+                )
+            return json.loads(fh.read().strip().splitlines()[-1])
+
+    def post_json(url, payload, timeout_s=240.0):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read())
+
+    # ---- phase 1: elastic supervision under the diurnal shape ----------
+    registry = ReplicaRegistry([], up_after=1, down_after=3)
+    supervisor = FleetSupervisor(
+        registry, spawn, min_replicas=1, max_replicas=2,
+        high_watermark=0.8, low_watermark=0.02,
+        scale_up_sustain_s=0.5, scale_down_sustain_s=10_000.0,
+        cooldown_s=2.0, drain_grace_s=30.0)
+    router_server = stop_policy = None
+    try:
+        supervisor._spawn_one("mixed")  # boot BEFORE the policy thread:
+        # the closed-loop calibration below saturates the single replica
+        # on purpose and must not itself trigger a scale-up.
+        registry.start(interval_s=0.2)
+        router = FleetRouter(registry)
+        router_server = make_router_server(router, port=0)
+        threading.Thread(
+            target=router_server.serve_forever, daemon=True).start()
+        deadline = time.monotonic() + 30
+        while registry.up_count() < 1 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        host, port = router_server.server_address
+        router_url = f"http://{host}:{port}"
+        cal = run_loadgen(router_url, n_cal, ["--concurrency", str(conc)])
+        rate = 0.9 * cal["completed"] / cal["wall_s"]  # peak = 1.44x
+        # Size the run so every phase of the 5-phase diurnal shape spans
+        # ~3 s of wall-clock REGARDLESS of this box's speed — the peak
+        # must outlive the probe interval (0.2 s) and the supervisor's
+        # sustain window (0.5 s), or pressure can never be "sustained".
+        n_open = max(40, min(800, int(rate * 3.8 * 3.0) + 1))
+
+        stop_policy = threading.Event()
+
+        def policy_loop():
+            while not stop_policy.wait(0.2):
+                try:
+                    supervisor.tick()
+                except Exception:  # noqa: BLE001 — keep ticking
+                    pass
+
+        threading.Thread(target=policy_loop, daemon=True).start()
+        scaled_at = [None]
+
+        def watch_members(t0):
+            while scaled_at[0] is None and not stop_policy.is_set():
+                if supervisor.member_count() >= 2:
+                    scaled_at[0] = time.monotonic() - t0
+                    return
+                time.sleep(0.05)
+
+        t0 = time.monotonic()
+        threading.Thread(
+            target=watch_members, args=(t0,), daemon=True).start()
+        shaped = run_loadgen(
+            router_url, n_open,
+            ["--rate", f"{rate:.3f}", "--shape", "diurnal"])
+        # The decision fires during the peak; the replica's boot may
+        # outlive the (short) shaped run — keep waiting on the budget.
+        while (scaled_at[0] is None
+               and time.monotonic() - t0 < reaction_budget_s):
+            time.sleep(0.2)
+        assert shaped["dropped_without_shed"] == 0, shaped
+        assert scaled_at[0] is not None, (
+            f"no scale-up within {reaction_budget_s}s of the diurnal run "
+            f"(peak 1.44x single capacity, rate {rate:.2f} req/s)"
+        )
+        reaction_s = scaled_at[0]
+        assert reaction_s <= reaction_budget_s
+        p99 = float(shaped["ttft_ms"]["p99"])
+        shape_note = (
+            f"diurnal x5 phases, {n_open} req at {rate:.2f} req/s offered "
+            f"(peak 1.44x single capacity), replica budget 1..2"
+        )
+    finally:
+        if stop_policy is not None:
+            stop_policy.set()
+        if router_server is not None:
+            router_server.shutdown()
+            router_server.server_close()
+        registry.stop()
+        supervisor.stop(drain=False)
+
+    # ---- phase 2: disaggregated tiers vs mixed-role baseline -----------
+    tiers = []
+    try:
+        for role in ("mixed", "prefill", "decode"):
+            tiers.append(spawn(role))
+        mixed, prefill, decode = tiers
+        push_handoff_peers([prefill.url], [decode.url])
+        rng_toks = list(range(3, 3 + 24))
+        cases = [
+            {"prompt": rng_toks[:8], "max_new_tokens": 8},
+            # 24 > prefill_chunk_tokens AND > prefill_len: chunked prefill
+            # runs on the prefill tier, pages travel after first token.
+            {"prompt": rng_toks, "max_new_tokens": 6},
+            {"prompt": rng_toks[:10], "max_new_tokens": 8,
+             "temperature": 0.8, "top_k": 4, "seed": 7},
+            {"prompt": rng_toks, "max_new_tokens": 6,
+             "temperature": 1.0, "top_k": 8, "seed": 3},
+        ]
+        for i, case in enumerate(cases):
+            ref = post_json(mixed.url + "/generate", case)["tokens"]
+            got = post_json(prefill.url + "/generate", case)["tokens"]
+            assert got == ref, (
+                f"handoff parity case {i} ({case}): {got} != {ref}"
+            )
+        with urllib.request.urlopen(
+                prefill.url + "/metrics", timeout=10) as resp:
+            samples = parse_prometheus_text(resp.read().decode())
+        handoff = {
+            s["labels"]["outcome"]: s["value"] for s in samples
+            if s["name"] == "serve_handoff_total"
+        }
+        # Parity must have flowed THROUGH the decode tier: every case
+        # accepted, none quietly decoded locally via the fallback path.
+        assert handoff.get("accepted", 0) >= len(cases), handoff
+        assert handoff.get("fallback", 0) == 0, handoff
+    finally:
+        for replica in tiers:
+            replica.terminate(grace_s=5.0)
+
+    return [
+        {
+            "metric": "fleet_elastic_zero_drops",
+            "value": 1.0,
+            "unit": "bool",
+            "detail": (
+                f"{shaped['completed']} completed / {shaped['shed']} shed "
+                f"/ 0 dropped under {shape_note}; loadgen --smoke exits "
+                "nonzero on any silent drop, so 1.0 is hard-asserted "
+                "in-run; >= 1.0 ENFORCED (bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "fleet_elastic_scaleup",
+            "value": 1.0,
+            "unit": "bool",
+            "detail": (
+                f"supervisor reached 2 members {reaction_s:.1f}s after "
+                f"load start (budget {reaction_budget_s:.0f}s incl. the "
+                f"replacement's CPU boot) under {shape_note}; reaction "
+                "<= budget hard-asserted in-run; >= 1.0 ENFORCED "
+                "(bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "fleet_elastic_ttft_p99_ms",
+            "value": round(p99, 2),
+            "unit": "ms",
+            "frac": round(p99 / ttft_budget_ms, 4),
+            "detail": (
+                f"routed p99 TTFT under {shape_note}, as a fraction of "
+                f"the {ttft_budget_ms:.0f} ms budget (queueing through "
+                "the 1.44x peak is expected; an unbounded tail is not); "
+                "frac <= 1.0 ENFORCED (bench.FRAC_CEILS)"
+            ),
+        },
+        {
+            "metric": "fleet_handoff_token_parity",
+            "value": 1.0,
+            "unit": "bool",
+            "detail": (
+                f"{len(cases)} /generate streams (greedy short, chunked "
+                "24-token prompt, 2 sampled lanes) through prefill->"
+                f"decode KV-page handoff == mixed baseline; "
+                f"{handoff.get('accepted', 0):.0f} accepted / 0 fallback "
+                "(a fallback parity win would prove nothing); "
+                ">= 1.0 ENFORCED (bench.FLOORS)"
+            ),
+        },
+    ]
+
+
 def bench_hotswap() -> list[dict]:
     """The deploy plane's acceptance run: a live engine adopts a newly
     COMMITTED checkpoint mid-burst with zero dropped requests and zero
@@ -2603,6 +2881,22 @@ FLOORS = {
     # spreading load (dispatch collapsed onto one replica) or the extra
     # hop started serializing streams.
     "fleet_speedup_vs_single": 1.6,
+    # The elastic plane's three binary acceptance gates (ISSUE 13),
+    # reported as 1.0 only after bench_fleet_elastic hard-asserts them
+    # in-run: (a) the diurnal shape whose peak exceeds one replica's
+    # capacity terminated with every request completed or typed-shed —
+    # zero silent drops — while the supervisor was live; (b) the
+    # supervisor scaled 1 -> 2 within the reaction budget of the
+    # sustained pressure crossing (budget includes the replacement's
+    # boot, so a dead policy loop OR a spawn path that stopped working
+    # both trip it); (c) prefill->decode KV-page handoff streams were
+    # token-identical to a same-seed mixed-role baseline across greedy,
+    # chunked and sampled lanes with every handoff ACCEPTED and zero
+    # fallbacks (a parity win via local fallback would mask a dead
+    # decode tier). MISSING (the bench crashed) is a violation too.
+    "fleet_elastic_zero_drops": 1.0,
+    "fleet_elastic_scaleup": 1.0,
+    "fleet_handoff_token_parity": 1.0,
     # The deploy plane's two binary acceptance gates, reported as 1.0
     # only after bench_hotswap hard-asserts them in-run: (a) a live
     # engine adopted a newly committed checkpoint mid-burst with zero
@@ -2673,6 +2967,14 @@ FRAC_CEILS = {
     # packed-nibble corruption), not that the model got unlucky.
     "serve_quant_evalloss_delta_int8": 0.01,
     "serve_quant_evalloss_delta_int4": 0.15,
+    # Routed p99 TTFT under the diurnal shape at the fixed 1..2 replica
+    # budget, as a fraction of the mode's absolute budget (30 s smoke /
+    # 10 s full — generous because queue wait through the 1.44x peak is
+    # the shape's POINT, and the scale-up replica boots mid-run). frac
+    # near 1 means the tail stopped being bounded by the peak's backlog:
+    # admission stalled, the router kept dispatching into the booting
+    # replica, or scale-up stopped relieving pressure at all.
+    "fleet_elastic_ttft_p99_ms": 1.0,
     # Hot-swap stall vs the drain-and-restart alternative: frac = the
     # timed swap's boundary-callback wall time (validate + warm canary +
     # pointer flip, measured with the canary's eager eval pre-warmed as
@@ -2735,6 +3037,13 @@ def main() -> None:
             # bind on full/TPU runs, where it is always in the suite.
             *(() if SMOKE else (bench_serving_quant,)),
             bench_fleet,
+            # The elastic bench boots 5 serve_lm subprocesses across its
+            # two phases (~3 min of CPU jax boots) — like the quant
+            # bench, that blows test_bench's whole-suite smoke budget.
+            # Smoke coverage lives in its dedicated slow test
+            # (test_bench_fleet_elastic_smoke_meets_gates); the floors
+            # bind on full/TPU runs, where it is always in the suite.
+            *(() if SMOKE else (bench_fleet_elastic,)),
             bench_hotswap,
             bench_flash_kernel,
             bench_mnist_real_accuracy,

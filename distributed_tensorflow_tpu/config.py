@@ -563,6 +563,28 @@ class ServeConfig:
             "0 for '' / 'int8'"
         },
     )
+    role: str = field(
+        default="mixed",
+        metadata={
+            "help": "disaggregated-tier role: 'prefill' (runs prompt "
+            "prefill + first token, then hands the slot's KV pages to a "
+            "decode peer), 'decode' (imports handed-off slots via POST "
+            "/handoff), 'mixed' (classic single-tier replica; default)"
+        },
+    )
+    handoff_peers: str = field(
+        default="",
+        metadata={
+            "help": "comma-separated decode-tier base URLs a prefill "
+            "replica pushes handoffs to (also settable at runtime via "
+            "POST /admin/handoff_peers)"
+        },
+    )
+
+    @property
+    def handoff_peer_list(self) -> tuple:
+        return tuple(u.strip() for u in self.handoff_peers.split(",")
+                     if u.strip())
 
     @property
     def lane_weight_tuple(self) -> tuple:
@@ -661,6 +683,67 @@ class FleetConfig:
     )
     fleet_slo_interval_s: float = field(
         default=1.0, metadata={"help": "router SLO evaluation tick period"}
+    )
+    # Disaggregated tiers: when either count is > 0 the launcher spawns
+    # role-tagged replicas instead of num_replicas mixed ones and pushes
+    # the decode tier's URLs to every prefill replica's handoff outbox.
+    prefill_replicas: int = field(
+        default=0,
+        metadata={"help": "prefill-tier replicas (0 = no disaggregation; "
+                  "with decode_replicas, replaces num_replicas)"},
+    )
+    decode_replicas: int = field(
+        default=0,
+        metadata={"help": "decode-tier replicas receiving KV-page "
+                  "handoffs (0 = no disaggregation)"},
+    )
+    # Elastic supervision (tools/serve_fleet.py --supervise).
+    supervise: bool = field(
+        default=False,
+        metadata={"help": "run the FleetSupervisor: replica processes "
+                  "become supervised + autoscaled instead of a static "
+                  "launch list (replacements re-announce on stdout)"},
+    )
+    min_replicas: int = field(
+        default=1,
+        metadata={"help": "autoscaler floor (supervised mode)"},
+    )
+    max_replicas: int = field(
+        default=4,
+        metadata={"help": "autoscaler ceiling (supervised mode)"},
+    )
+    scale_high_watermark: float = field(
+        default=0.85,
+        metadata={"help": "fleet_pressure above this (sustained) scales "
+                  "up"},
+    )
+    scale_low_watermark: float = field(
+        default=0.25,
+        metadata={"help": "fleet_pressure below this (sustained) scales "
+                  "down"},
+    )
+    scale_up_sustain_s: float = field(
+        default=1.0,
+        metadata={"help": "seconds pressure must hold above the high "
+                  "watermark before a scale-up"},
+    )
+    scale_down_sustain_s: float = field(
+        default=10.0,
+        metadata={"help": "seconds pressure must hold below the low "
+                  "watermark before a scale-down"},
+    )
+    scale_cooldown_s: float = field(
+        default=5.0,
+        metadata={"help": "seconds after any scaling decision during "
+                  "which no further decision is taken (flap control)"},
+    )
+    supervisor_tick_s: float = field(
+        default=0.5, metadata={"help": "policy loop evaluation period"}
+    )
+    drain_grace_s: float = field(
+        default=15.0,
+        metadata={"help": "scale-down drain window: SIGTERM -> graceful "
+                  "drain -> SIGKILL after this many seconds"},
     )
 
 

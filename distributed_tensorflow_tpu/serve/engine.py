@@ -1439,6 +1439,96 @@ class SlotEngine:
             self.serving_variant = str(variant)
         return prev
 
+    # -- slot handoff (prefill tier -> decode tier) ------------------------
+    #
+    # Disaggregated serving moves a slot BETWEEN engines after prefill:
+    # the prefill tier runs (possibly chunked) prefill to completion, then
+    # exports the slot's KV pages plus the per-slot host registers; the
+    # decode tier imports them and continues decoding. Token parity is by
+    # construction: every sampling key is ``fold_in(PRNGKey(seed), made)``
+    # and the registers travel exactly, so the continuation is the same
+    # token stream local decode would have produced. Export gathers pages
+    # eagerly and import scatters them eagerly + rebinds the (host numpy)
+    # page table — no new jitted program on either side, so the
+    # zero-recompile contract holds on both tiers.
+
+    def export_slot(self, slot: int, *, history=None) -> dict:
+        """Capture ``slot``'s decode state as a host-serializable bundle.
+
+        The slot must be post-prefill and still active (a request that
+        finished at its first token has nothing to hand off). ``history``
+        (prompt + emitted tokens) feeds the importing engine's drafter;
+        when the exporter tracks history itself (``spec_k > 0``) its own
+        register wins. The slot stays live here — the caller releases it
+        only once the peer acknowledged the import (fallback to local
+        decode otherwise, so no request is ever lost)."""
+        if not self.paged:
+            raise RuntimeError("slot handoff requires the paged KV layout")
+        if self.prefilling[slot]:
+            raise RuntimeError(f"slot {slot} is mid-chunked-prefill")
+        if not self.active[slot]:
+            raise RuntimeError(f"slot {slot} is not active")
+        if self.spec_k:
+            history = self.history[slot, : int(self.hist_len[slot])]
+        hist = (np.asarray(history, np.int32).ravel().tolist()
+                if history is not None else [])
+        return {
+            "length": int(self.lengths[slot]),
+            "cur_tok": int(self.cur_tok[slot]),
+            "made": int(self.made[slot]),
+            "budget": int(self.budget[slot]),
+            "eos": int(self.eos[slot]),
+            "temperature": float(self.temp[slot]),
+            "top_k": int(self.top_k[slot]),
+            "top_p": float(self.top_p[slot]),
+            "seed": int(self.seed[slot]),
+            "history": hist,
+            "page_size": self.page_size,
+            "pages": self.pool.export_pages(slot),
+        }
+
+    def import_slot(self, slot: int, bundle: dict) -> None:
+        """Adopt an exported slot bundle into a freshly acquired ``slot``.
+
+        Raises :class:`InsufficientPages` (slot registers untouched — the
+        caller releases the slot and retries or tells the exporter to
+        decode locally) when the pool cannot back the payload. On success
+        the slot is active and the next :meth:`step` continues the
+        request exactly where the exporter stopped."""
+        if not self.paged:
+            raise RuntimeError("slot handoff requires the paged KV layout")
+        if bundle["page_size"] != self.page_size:
+            raise ValueError(
+                f"handoff page_size {bundle['page_size']} != engine "
+                f"page_size {self.page_size}"
+            )
+        length = int(bundle["length"])
+        headroom = int(bundle["budget"]) - int(bundle["made"])
+        if length + headroom > self.max_len:
+            raise ValueError(
+                f"handoff length {length} + {headroom} remaining > engine "
+                f"max_len {self.max_len}"
+            )
+        self.pool.import_pages(slot, bundle["pages"])
+        self.active[slot] = True
+        self.prefilling[slot] = False
+        self.lengths[slot] = length
+        self.cur_tok[slot] = int(bundle["cur_tok"])
+        self.temp[slot] = float(bundle["temperature"])
+        self.top_k[slot] = int(bundle["top_k"])
+        self.top_p[slot] = float(bundle["top_p"])
+        self.seed[slot] = np.uint32(int(bundle["seed"]) & 0xFFFFFFFF)
+        self.made[slot] = int(bundle["made"])
+        self.budget[slot] = int(bundle["budget"])
+        self.eos[slot] = int(bundle["eos"])
+        if self.spec_k:
+            hist = np.asarray(bundle.get("history", ()), np.int32).ravel()
+            hist = hist[: self.max_len]
+            self.history[slot, : hist.size] = hist
+            self.hist_len[slot] = hist.size
+        if self.sentinel is not None:
+            self.sentinel.poll(self.compile_count())
+
 
 class ShardedSlotEngine(SlotEngine):
     """The SlotEngine on a TP-partitioned model — same slot API, same

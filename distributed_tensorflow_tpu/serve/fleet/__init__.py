@@ -8,12 +8,24 @@ chief in front of parameter-holding workers.
 
 * :mod:`registry` — replica membership + active health-checking
   (``/healthz`` poll + ``/metrics`` scrape) with an up→draining→down
-  state machine and flap hysteresis, plus least-loaded ``pick()``.
+  state machine and flap hysteresis, plus least-loaded ``pick()`` and
+  per-role tier views for disaggregated fleets.
 * :mod:`router` — HTTP front door: dispatch with bounded failover,
   unbuffered streaming proxy, fleet gauges / ``/fleet.json`` /
   ``/metrics``, and SLO wiring over the fleet signals.
+* :mod:`elastic` — :class:`FleetSupervisor`: replica subprocess
+  lifecycles + the autoscaling policy loop (watermarks, SLO breaches,
+  cooldowns, drain-then-stop scale-down, dead-replica replacement).
+* :mod:`handoff` — the prefill→decode KV-page handoff: wire codec for
+  exported slots and the prefill-side push client.
 """
 
+from distributed_tensorflow_tpu.serve.fleet.elastic import FleetSupervisor
+from distributed_tensorflow_tpu.serve.fleet.handoff import (
+    HandoffOutbox,
+    decode_bundle,
+    encode_bundle,
+)
 from distributed_tensorflow_tpu.serve.fleet.registry import (
     ProbeResult,
     Replica,
@@ -30,4 +42,8 @@ __all__ = [
     "ReplicaRegistry",
     "FleetRouter",
     "make_router_server",
+    "FleetSupervisor",
+    "HandoffOutbox",
+    "encode_bundle",
+    "decode_bundle",
 ]

@@ -1,0 +1,353 @@
+"""Elastic fleet supervision: replica lifecycles + autoscaling policy.
+
+:class:`FleetSupervisor` is the paper's ``tf.train.Supervisor`` /
+``ClusterSpec`` pair recast for a serving fleet: instead of a static
+worker list compiled into the cluster spec, membership is a *policy
+output* — the supervisor owns replica subprocess lifecycles (spawn,
+replace, drain-then-stop) and resizes the fleet from the signals the
+router plane already maintains:
+
+* **fleet_pressure** (demand / up-capacity, from the
+  :class:`~.registry.ReplicaRegistry` gauges) — the scale-UP signal when
+  sustained above the high watermark, the scale-DOWN signal when
+  sustained below the low watermark;
+* **SLO transitions** (``obs.slo.SloMonitor`` callbacks) — a breach of a
+  fleet rule (tail TTFT, pressure) forces a scale-up decision even if
+  the pressure average looks tame, because the SLO rule carries its own
+  sustain window;
+* **process death** — a replica whose process exited (or that the
+  registry marked down) is replaced, not mourned.
+
+Flap control is layered: watermark crossings must SUSTAIN for a
+configured window before they count, every decision starts a cooldown
+during which no further scaling happens, and scale-down drains the
+victim (SIGTERM → graceful drain → exit, the same path ``serve_lm``
+takes on orchestrated shutdown) so no in-flight request is ever
+SIGKILLed. Decisions are counted in
+``fleet_scale_events_total{direction,reason}`` and the current intent is
+published as the ``fleet_target_replicas`` gauge — the two instruments a
+fleet dashboard needs to explain "why did capacity change".
+
+The supervisor is process-agnostic: ``spawn(role)`` is an injected
+callable returning a handle with ``url``, ``alive()`` and
+``terminate(grace_s)`` (``tools/serve_fleet.py`` adapts its
+``ReplicaProc``; unit tests use fakes and drive ``tick()`` with a fake
+clock — no threads, no sockets).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["FleetSupervisor"]
+
+
+class _Member:
+    __slots__ = ("handle", "role", "replica_id", "draining")
+
+    def __init__(self, handle, role: str, replica_id: str):
+        self.handle = handle
+        self.role = role
+        self.replica_id = replica_id
+        self.draining = False
+
+
+class FleetSupervisor:
+    """Autoscaling replica supervisor over a :class:`ReplicaRegistry`.
+
+    ``spawn(role) -> handle`` blocks until the replica announced its URL
+    (or raises). ``role_for(direction)`` picks which tier elastic
+    capacity is added to / removed from (default ``"mixed"`` — a
+    disaggregated fleet scales its decode tier). ``on_change(members)``
+    fires after every membership change (serve_fleet re-announces ports
+    and re-pushes handoff peer lists from it).
+    """
+
+    def __init__(
+        self,
+        registry,
+        spawn,
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        high_watermark: float = 0.85,
+        low_watermark: float = 0.25,
+        scale_up_sustain_s: float = 1.0,
+        scale_down_sustain_s: float = 10.0,
+        cooldown_s: float = 5.0,
+        drain_grace_s: float = 15.0,
+        role_for=None,
+        on_change=None,
+        clock=time.monotonic,
+    ):
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{min_replicas}..{max_replicas}"
+            )
+        if not 0.0 <= low_watermark < high_watermark:
+            raise ValueError(
+                f"need 0 <= low < high watermark, got "
+                f"{low_watermark} / {high_watermark}"
+            )
+        self.registry = registry
+        self.spawn = spawn
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.high_watermark = float(high_watermark)
+        self.low_watermark = float(low_watermark)
+        self.scale_up_sustain_s = float(scale_up_sustain_s)
+        self.scale_down_sustain_s = float(scale_down_sustain_s)
+        self.cooldown_s = float(cooldown_s)
+        self.drain_grace_s = float(drain_grace_s)
+        self.role_for = role_for or (lambda direction: "mixed")
+        self.on_change = on_change
+        self.clock = clock
+        self._members: dict[str, _Member] = {}
+        self._lock = threading.Lock()
+        self._above_since: float | None = None
+        self._below_since: float | None = None
+        self._cooldown_until = 0.0
+        self._slo_breach = False
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        r = registry.metrics_registry
+        self._c_events = r.counter(
+            "fleet_scale_events_total",
+            "Supervisor scaling decisions by direction and reason.",
+            labels=("direction", "reason"))
+        self._g_target = r.gauge(
+            "fleet_target_replicas",
+            "Replica count the supervisor currently intends to run.")
+        self._g_target.set(float(self.min_replicas))
+
+    # -- membership --------------------------------------------------------
+
+    @property
+    def members(self) -> list[_Member]:
+        with self._lock:
+            return list(self._members.values())
+
+    def member_count(self) -> int:
+        with self._lock:
+            return sum(1 for m in self._members.values() if not m.draining)
+
+    def _notify_change(self) -> None:
+        if self.on_change is not None:
+            try:
+                self.on_change(self.members)
+            except Exception:  # noqa: BLE001 — observer must not kill policy
+                pass
+
+    def _spawn_one(self, role: str):
+        """Spawn + register one replica; returns the member or None on
+        spawn failure (the policy loop simply tries again next tick)."""
+        try:
+            handle = self.spawn(role)
+        except Exception:  # noqa: BLE001 — a failed boot is not fatal
+            return None
+        replica = self.registry.add(handle.url)
+        member = _Member(handle, role, replica.replica_id)
+        with self._lock:
+            self._members[member.replica_id] = member
+        self._notify_change()
+        return member
+
+    def start(self, initial_replicas: int, roles=None,
+              interval_s: float = 0.5) -> None:
+        """Boot the initial fleet (``roles`` overrides the per-replica
+        role list; default all ``role_for("up")``) and start the policy
+        loop thread."""
+        n = max(self.min_replicas, min(self.max_replicas,
+                                       int(initial_replicas)))
+        roles = list(roles or [])
+        while len(roles) < n:
+            roles.append(self.role_for("up"))
+        for role in roles[:n]:
+            self._spawn_one(role)
+        self._g_target.set(float(n))
+        if self._thread is not None:
+            raise RuntimeError("supervisor already started")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — policy must keep running
+                    pass
+
+        self._thread = threading.Thread(
+            target=loop, name="fleet-supervisor", daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the policy loop and terminate every member (drained by
+        default — the orchestrated-shutdown path)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        for member in self.members:
+            try:
+                member.handle.terminate(
+                    grace_s=self.drain_grace_s if drain else 0.0)
+            except Exception:  # noqa: BLE001
+                pass
+            self.registry.remove(member.replica_id)
+        with self._lock:
+            self._members.clear()
+
+    # -- SLO hook ----------------------------------------------------------
+
+    def attach_slo(self, monitor, rules=("fleet_pressure",
+                                         "fleet_ttft_p99")) -> None:
+        """Register on an ``SloMonitor``: a breach of any named fleet
+        rule forces the next tick's scale-up check (the rule's own
+        sustain window already debounced it)."""
+        names = set(rules)
+
+        def on_transition(rule, status, value):
+            if rule.name in names:
+                self._slo_breach = status == "breach"
+
+        monitor.add_callback(on_transition)
+
+    def notice_slo(self, breached: bool) -> None:
+        """Manual SLO signal (tests / callers without a monitor)."""
+        self._slo_breach = bool(breached)
+
+    # -- policy ------------------------------------------------------------
+
+    def tick(self) -> str | None:
+        """One policy evaluation. Returns the decision taken this tick
+        (``"up"`` / ``"down"`` / ``"replace"``) or None. Safe to call
+        from tests without the background thread."""
+        decision = self._replace_dead()
+        if decision is not None:
+            return decision
+        now = self.clock()
+        pressure = float(self.registry.fleet_pressure())
+        count = self.member_count()
+        self._g_target.set(float(count))
+
+        # min_replicas is a hard floor, not a watermark: if a replacement
+        # spawn failed last tick (the dead member is already gone), back-
+        # fill here — no sustain window, no cooldown gate.
+        if count < self.min_replicas:
+            member = self._spawn_one(self.role_for("up"))
+            if member is not None:
+                self._c_events.labels(direction="replace",
+                                      reason="below_min").inc()
+                self._g_target.set(float(count + 1))
+                return "replace"
+            return None
+
+        # Sustain tracking (watermark crossings must hold, not blip).
+        # Explicit None checks: `since or now` would silently restart a
+        # window whose start time is the falsy 0.0 (monotonic clocks and
+        # fake test clocks both start there).
+        if pressure >= self.high_watermark:
+            if self._above_since is None:
+                self._above_since = now
+        else:
+            self._above_since = None
+        if pressure <= self.low_watermark:
+            if self._below_since is None:
+                self._below_since = now
+        else:
+            self._below_since = None
+
+        if now < self._cooldown_until:
+            return None
+
+        sustained_up = (self._above_since is not None
+                        and now - self._above_since
+                        >= self.scale_up_sustain_s)
+        if (sustained_up or self._slo_breach) and count < self.max_replicas:
+            reason = "slo_breach" if self._slo_breach else "pressure_high"
+            member = self._spawn_one(self.role_for("up"))
+            if member is not None:
+                self._decide("up", reason, count + 1, now)
+                self._slo_breach = False
+                return "up"
+            return None
+
+        sustained_down = (self._below_since is not None
+                          and now - self._below_since
+                          >= self.scale_down_sustain_s
+                          and not self._slo_breach)
+        if sustained_down and count > self.min_replicas:
+            victim = self._pick_victim()
+            if victim is not None:
+                self._drain_member(victim)
+                self._decide("down", "pressure_low", count - 1, now)
+                return "down"
+        return None
+
+    def _decide(self, direction: str, reason: str, target: int,
+                now: float) -> None:
+        self._c_events.labels(direction=direction, reason=reason).inc()
+        self._g_target.set(float(target))
+        self._cooldown_until = now + self.cooldown_s
+        self._above_since = None
+        self._below_since = None
+
+    def _replace_dead(self) -> str | None:
+        """A member whose process exited without the supervisor draining
+        it is dead capacity: drop it from the registry and spawn a
+        replacement of the same role."""
+        for member in self.members:
+            if member.draining or member.handle.alive():
+                continue
+            with self._lock:
+                self._members.pop(member.replica_id, None)
+            self.registry.remove(member.replica_id)
+            self._notify_change()
+            replacement = self._spawn_one(member.role)
+            if replacement is not None:
+                self._c_events.labels(direction="replace",
+                                      reason="replica_died").inc()
+                return "replace"
+            return None
+        return None
+
+    def _pick_victim(self) -> _Member | None:
+        """Scale-down victim: a live member of the scale role with the
+        least routed load (drains fastest, disturbs least)."""
+        role = self.role_for("down")
+        candidates = [m for m in self.members
+                      if not m.draining
+                      and (m.role == role
+                           or all(x.role != role for x in self.members))]
+        if not candidates:
+            return None
+
+        def load(member: _Member) -> float:
+            replica = self.registry.get(member.replica_id)
+            if replica is None:
+                return 0.0
+            return (replica.inflight + replica.last.queue_depth
+                    + replica.last.occupancy)
+
+        return min(candidates, key=load)
+
+    def _drain_member(self, member: _Member) -> None:
+        """SIGTERM → graceful drain → (SIGKILL past the grace window) on
+        a worker thread — terminate() blocks for up to the grace period
+        and the policy loop must keep ticking meanwhile."""
+        member.draining = True
+
+        def drain():
+            try:
+                member.handle.terminate(grace_s=self.drain_grace_s)
+            except Exception:  # noqa: BLE001
+                pass
+            with self._lock:
+                self._members.pop(member.replica_id, None)
+            self.registry.remove(member.replica_id)
+            self._notify_change()
+
+        threading.Thread(target=drain, name="fleet-drain",
+                         daemon=True).start()

@@ -1,0 +1,314 @@
+"""KV-page handoff between disaggregated serving tiers.
+
+The prefill tier runs (possibly chunked) prefill to completion, samples
+the request's first token locally (TTFT is unchanged), then moves the
+slot to a decode replica instead of decoding it: the slot's physical KV
+pages plus its per-slot host registers travel as one serialized
+**handoff bundle**, and the decode tier continues the request from the
+first post-handoff step. Token parity is by construction — every
+sampling key is ``fold_in(PRNGKey(seed), made)`` and the registers
+travel exactly — and neither side compiles anything new (export is an
+eager gather, import an eager scatter + the existing traced page-table
+rebinding).
+
+Wire format (``encode_bundle``/``decode_bundle``)::
+
+    b"DTFH1" | u32 header_len | header JSON | (u64 nbytes | raw)*
+
+The header carries the scalar registers (length, cur_tok, made, budget,
+eos, sampling params, seed, history) plus a per-layer manifest of the
+page arrays (dtype, shape, stream index) — layout-generic, so an int8
+cache's rows+scales serialize exactly like f32 k/v rows. Arrays follow
+as contiguous little-endian payloads in manifest order.
+
+Failure matrix (who recovers, and how — nothing is ever lost silently):
+
+========================  ============================================
+failure                   recovery
+========================  ============================================
+no decode peer up         fall back: prefill replica decodes locally
+POST refused / timeout    retry next peer (bounded), then local decode
+429/503 (pool full,       retry with backoff on another peer, then
+draining, queue full)     local decode
+peer dies pre-accept      same as refused — nothing streamed yet
+peer dies mid-stream      typed ``upstream_died`` answer (the prefill
+                          slot was released at accept; same stance as
+                          the router's never-retry-partial-streams)
+========================  ============================================
+
+:class:`HandoffOutbox` is the prefill-side client: a small worker pool
+that pushes bundles to ``POST /handoff`` on decode peers and relays the
+SSE token/done frames back through scheduler callbacks. The scheduler
+parks the exporting slot (registers + pages intact, decode masked off)
+until the peer ACCEPTS — acceptance is the first SSE frame, exactly the
+commit point the fleet router uses — so every pre-accept failure can
+fall back to local decode with zero token loss.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import struct
+import threading
+import urllib.parse
+
+import numpy as np
+
+__all__ = [
+    "encode_bundle",
+    "decode_bundle",
+    "HandoffOutbox",
+    "HandoffError",
+]
+
+_MAGIC = b"DTFH1"
+
+
+class HandoffError(RuntimeError):
+    """A handoff push that did not reach acceptance on any peer."""
+
+
+# -- wire codec ------------------------------------------------------------
+
+
+def encode_bundle(bundle: dict, *, request_id: str = "") -> bytes:
+    """Serialize an ``engine.export_slot`` bundle (header JSON + raw
+    array stream). ``request_id`` rides along for end-to-end tracing."""
+    pages = bundle["pages"]
+    arrays: list[np.ndarray] = []
+    manifest = []
+    for layer in pages["layers"]:
+        entry = {}
+        for name in sorted(layer):
+            arr = np.ascontiguousarray(layer[name])
+            entry[name] = {
+                "dtype": arr.dtype.str,
+                "shape": list(arr.shape),
+                "index": len(arrays),
+            }
+            arrays.append(arr)
+        manifest.append(entry)
+    header = {
+        k: v for k, v in bundle.items() if k != "pages"
+    }
+    header["request_id"] = str(request_id)
+    header["pages"] = {
+        "n_pages": int(pages["n_pages"]),
+        "page_size": int(pages["page_size"]),
+        "layers": manifest,
+    }
+    head = json.dumps(header).encode()
+    parts = [_MAGIC, struct.pack("<I", len(head)), head]
+    for arr in arrays:
+        parts.append(struct.pack("<Q", arr.nbytes))
+        parts.append(arr.tobytes())
+    return b"".join(parts)
+
+
+def decode_bundle(data: bytes) -> dict:
+    """Inverse of :func:`encode_bundle`; returns the dict shape
+    ``engine.import_slot`` consumes (numpy page arrays reconstructed)."""
+    if data[: len(_MAGIC)] != _MAGIC:
+        raise ValueError("not a handoff bundle (bad magic)")
+    off = len(_MAGIC)
+    (head_len,) = struct.unpack_from("<I", data, off)
+    off += 4
+    header = json.loads(data[off : off + head_len])
+    off += head_len
+    arrays = []
+    while off < len(data):
+        (nbytes,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        arrays.append(data[off : off + nbytes])
+        off += nbytes
+    layers = []
+    for entry in header["pages"]["layers"]:
+        layer = {}
+        for name, spec in entry.items():
+            raw = arrays[spec["index"]]
+            layer[name] = np.frombuffer(
+                raw, dtype=np.dtype(spec["dtype"])
+            ).reshape(spec["shape"])
+        layers.append(layer)
+    bundle = {k: v for k, v in header.items() if k != "pages"}
+    bundle["pages"] = {
+        "n_pages": int(header["pages"]["n_pages"]),
+        "page_size": int(header["pages"]["page_size"]),
+        "layers": layers,
+    }
+    return bundle
+
+
+# -- SSE parsing -----------------------------------------------------------
+
+
+def _iter_sse(resp):
+    """Yield ``(event, payload_dict)`` frames from an SSE response."""
+    event, data_lines = None, []
+    buf = b""
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            return
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            line = line.rstrip(b"\r")
+            if line.startswith(b"event: "):
+                event = line[7:].decode()
+            elif line.startswith(b"data: "):
+                data_lines.append(line[6:])
+            elif not line:
+                if event is not None and data_lines:
+                    yield event, json.loads(b"".join(data_lines))
+                event, data_lines = None, []
+
+
+# -- prefill-side client ---------------------------------------------------
+
+
+class HandoffOutbox:
+    """Worker pool pushing handoff bundles to decode peers.
+
+    ``submit(bundle_bytes, request_id, callbacks)`` enqueues one push;
+    workers try peers round-robin with backoff, up to ``max_attempts``
+    total attempts. Callbacks (``on_accepted()``, ``on_tokens(list)``,
+    ``on_done(payload)``, ``on_failed(detail, accepted)``) fire on the
+    worker thread — the scheduler trampolines the ones that must touch
+    the engine back onto its driver thread via ``at_boundary``.
+    """
+
+    def __init__(
+        self,
+        peers=(),
+        *,
+        max_attempts: int = 3,
+        backoff_s: float = 0.05,
+        connect_timeout_s: float = 2.0,
+        read_timeout_s: float = 120.0,
+        workers: int = 2,
+    ):
+        self._peers: list[str] = [p.rstrip("/") for p in peers]
+        self._rr = 0
+        self._lock = threading.Lock()
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.read_timeout_s = float(read_timeout_s)
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(
+                target=self._run, name=f"handoff-outbox-{i}", daemon=True
+            )
+            for i in range(max(1, int(workers)))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- peer membership (fleet pushes updates) ---------------------------
+
+    def set_peers(self, urls) -> None:
+        with self._lock:
+            self._peers = [u.rstrip("/") for u in urls if u]
+
+    def peers(self) -> list[str]:
+        with self._lock:
+            return list(self._peers)
+
+    def available(self) -> bool:
+        with self._lock:
+            return bool(self._peers)
+
+    def _next_peers(self) -> list[str]:
+        """Peer try-order for one push: round-robin rotated snapshot."""
+        with self._lock:
+            if not self._peers:
+                return []
+            self._rr = (self._rr + 1) % len(self._peers)
+            return self._peers[self._rr:] + self._peers[: self._rr]
+
+    # -- push lifecycle ----------------------------------------------------
+
+    def submit(self, payload: bytes, request_id: str, callbacks) -> None:
+        self._q.put((payload, request_id, callbacks))
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                job = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            payload, request_id, cb = job
+            try:
+                self._push(payload, request_id, cb)
+            except Exception as exc:  # noqa: BLE001 — worker must not die
+                try:
+                    cb.on_failed(repr(exc), False)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _push(self, payload: bytes, request_id: str, cb) -> None:
+        last = "no decode peer configured"
+        attempts = 0
+        for peer in self._next_peers() * self.max_attempts:
+            if attempts >= self.max_attempts:
+                break
+            attempts += 1
+            parsed = urllib.parse.urlsplit(peer)
+            conn = http.client.HTTPConnection(
+                parsed.hostname, parsed.port,
+                timeout=self.connect_timeout_s)
+            try:
+                conn.request(
+                    "POST", "/handoff", body=payload,
+                    headers={"Content-Type": "application/octet-stream"})
+                conn.sock.settimeout(self.read_timeout_s)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    last = (f"{peer}: HTTP {resp.status} "
+                            f"{resp.read(256)[:256]!r}")
+                    self._stop.wait(self.backoff_s * attempts)
+                    continue
+                ctype = resp.getheader("Content-Type", "")
+                if not ctype.startswith("text/event-stream"):
+                    last = f"{peer}: unexpected Content-Type {ctype!r}"
+                    continue
+                accepted = False
+                for event, obj in _iter_sse(resp):
+                    if not accepted:
+                        # First frame = the peer imported the pages and
+                        # is decoding: the exporter may release its slot.
+                        accepted = True
+                        cb.on_accepted(peer)
+                    if event == "token":
+                        cb.on_tokens(obj.get("tokens", []))
+                    elif event == "done":
+                        if "error" in obj:
+                            cb.on_failed(
+                                f"{peer}: {obj['error']}", True)
+                        else:
+                            cb.on_done(obj)
+                        return
+                    elif event == "error":
+                        cb.on_failed(f"{peer}: {obj}", True)
+                        return
+                if accepted:
+                    # Stream cut mid-decode: the pages died with the
+                    # peer — typed error, never silently dropped.
+                    cb.on_failed(f"{peer}: stream ended early", True)
+                    return
+                last = f"{peer}: empty stream before accept"
+            except (OSError, http.client.HTTPException) as exc:
+                last = f"{peer}: {exc!r}"
+                self._stop.wait(self.backoff_s * attempts)
+            finally:
+                conn.close()
+        cb.on_failed(last, False)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)

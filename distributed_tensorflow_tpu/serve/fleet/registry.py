@@ -71,6 +71,10 @@ class ProbeResult:
     devices: int = 1         # devices it spans — a tp-wide replica is ONE
     #                          replica, not tp independent ones
     weight_dtype: str = ""   # 'native'/'int8'/'int4' weight quantization
+    # Disaggregation tier ('prefill'/'decode'/'mixed') from the healthz
+    # body — the router dispatches new requests to the prefill tier and
+    # the supervisor balances tier populations on it.
+    role: str = "mixed"
     # Deploy state from the healthz "deploy" section: which checkpoint
     # step is live, which variant the engine is running, and the full
     # set of variants this replica can serve (+ its canary rule) — what
@@ -127,6 +131,7 @@ def http_probe(base_url: str, timeout_s: float = 2.0) -> ProbeResult:
         tp=int(body.get("mesh", {}).get("tp", 1)),
         devices=int(body.get("mesh", {}).get("devices", 1)),
         weight_dtype=str(body.get("weight_dtype", "")),
+        role=str(body.get("role", "mixed") or "mixed"),
     )
     deploy = body.get("deploy", {})
     if isinstance(deploy, dict):
@@ -148,8 +153,19 @@ def http_probe(base_url: str, timeout_s: float = 2.0) -> ProbeResult:
                 result.occupancy = float(s["value"])
             elif s["name"] == "serve_shed_total":
                 result.shed_total = float(s["value"])
-    except Exception:  # noqa: BLE001 — healthz already proved liveness
+    except urllib.error.HTTPError:
+        # An HTTP-level answer proves the process is still alive; the
+        # scrape is best-effort, keep the last-known load figures.
         pass
+    except Exception as exc:  # noqa: BLE001
+        # TRANSPORT failure after a successful /healthz: the replica died
+        # between the two requests of this cycle. Report the whole probe
+        # failed so the registry advances the fail streak exactly ONCE —
+        # returning ok=True here (the old behavior) made the dispatch
+        # path discover the corpse and feed the error streak a second
+        # time in the same cycle, halving the effective down_after.
+        return ProbeResult(
+            ok=False, detail=f"died mid-probe (/metrics): {exc!r}")
     return result
 
 
@@ -229,6 +245,16 @@ class ReplicaRegistry:
             self._replicas[rid] = replica
         return replica
 
+    def remove(self, replica_id: str) -> bool:
+        """Drop a replica from membership (supervisor scale-down / dead
+        replica replacement). Its per-replica gauges stop updating; True
+        iff the id was present."""
+        with self._lock:
+            found = self._replicas.pop(replica_id, None) is not None
+            if found:
+                self._update_gauges_locked()
+        return found
+
     @property
     def replicas(self) -> list[Replica]:
         with self._lock:
@@ -307,14 +333,18 @@ class ReplicaRegistry:
 
     # -- dispatch policy --------------------------------------------------
 
-    def pick(self, exclude=(), variant: str | None = None) -> Replica | None:
+    def pick(self, exclude=(), variant: str | None = None,
+             roles=None) -> Replica | None:
         """Least-loaded UP replica not excluded and not in backoff.
 
         ``variant``: prefer replicas that advertise the named variant in
-        their healthz deploy table. Preference, not a hard filter: if no
-        UP replica carries the variant, fall back to least-loaded overall
-        (a replica without the variant serves its default — degraded
-        attribution beats a 503 while a rollout propagates)."""
+        their healthz deploy table. ``roles``: prefer replicas whose
+        advertised tier is in the given set (the router passes
+        ``("prefill", "mixed")`` for new requests when a prefill tier
+        exists). Both are preferences, not hard filters: if no UP replica
+        matches, fall back to least-loaded overall (a mismatched replica
+        still serves correctly — degraded routing beats a 503 while the
+        fleet reshapes)."""
         now = self.clock()
         with self._lock:
             candidates = [
@@ -324,6 +354,10 @@ class ReplicaRegistry:
             ]
             if not candidates:
                 return None
+            if roles:
+                in_tier = [r for r in candidates if r.last.role in roles]
+                if in_tier:
+                    candidates = in_tier
             if variant:
                 carrying = [r for r in candidates
                             if variant in r.last.variants
@@ -332,6 +366,18 @@ class ReplicaRegistry:
                     candidates = carrying
             return min(candidates, key=lambda r: (r.load_score(),
                                                   r.replica_id))
+
+    def tier_urls(self, role: str) -> list[str]:
+        """Base URLs of UP replicas advertising ``role`` — the handoff
+        peer list the fleet pushes to prefill replicas."""
+        with self._lock:
+            return [r.base_url for r in self._replicas.values()
+                    if r.state == "up" and r.last.role == role]
+
+    def has_tier(self, role: str) -> bool:
+        with self._lock:
+            return any(r.state == "up" and r.last.role == role
+                       for r in self._replicas.values())
 
     # -- fleet signals ----------------------------------------------------
 
@@ -388,6 +434,7 @@ class ReplicaRegistry:
                         "tp": r.last.tp,
                         "devices": r.last.devices,
                         "weight_dtype": r.last.weight_dtype,
+                        "role": r.last.role,
                         "weight_version": r.last.weight_version,
                         "serving_variant": r.last.serving_variant,
                         "variants": list(r.last.variants),

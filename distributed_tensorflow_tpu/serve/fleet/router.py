@@ -238,8 +238,15 @@ class FleetRouter:
         started_at = self.clock()
         tried: set[str] = set()
         last_error = None  # (status, body_bytes, retry_after | None)
+        # Disaggregated tiers: when a prefill tier exists, NEW requests go
+        # to it (prefill or mixed replicas) — decode replicas take their
+        # work as /handoff imports, not fresh prompts. pick() treats the
+        # role set as a preference, so a tier-less fleet is unchanged.
+        roles = (("prefill", "mixed")
+                 if self.registry.has_tier("prefill") else None)
         for attempt in range(self.max_attempts):
-            replica = self.registry.pick(exclude=tried, variant=variant)
+            replica = self.registry.pick(exclude=tried, variant=variant,
+                                         roles=roles)
             if replica is None:
                 break
             tried.add(replica.replica_id)

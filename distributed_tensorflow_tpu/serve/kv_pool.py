@@ -343,6 +343,79 @@ class PagedKVPool:
     def compile_count(self) -> int:
         return 0  # all jitted programs live in the engine
 
+    # -- page handoff (prefill -> decode tier) -----------------------------
+
+    def export_pages(self, slot: int) -> dict:
+        """Gather ``slot``'s bound pages to host numpy as a handoff payload.
+
+        The payload is layout-generic: every cache leaf (k/v rows, and the
+        f32 scale planes of an int8 cache) is gathered at the same physical
+        page indices, so int8 pages travel as rows+scales with no special
+        casing. Pure eager reads — no new jitted program, the slot's pages
+        stay bound and refcounted on this pool (the exporter frees them via
+        the normal ``free(slot)`` path once the handoff is acknowledged).
+        """
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        row = self.page_tables[slot]
+        bound = [int(pid) for pid in row if pid != TRASH_PAGE]
+        idx = np.asarray(bound, np.int32)
+        layers = []
+        for layer in self.layers:
+            layers.append({
+                name: np.asarray(jax.device_get(buf[idx]))
+                for name, buf in layer.items()
+            })
+        return {
+            "n_pages": len(bound),
+            "page_size": self.page_size,
+            "layers": layers,
+        }
+
+    def import_pages(self, slot: int, payload: dict) -> list[int]:
+        """Write a foreign page payload into fresh pages and bind ``slot``.
+
+        All-or-nothing: raises :class:`InsufficientPages` when the free
+        list cannot back the payload (nothing to unwind — the caller
+        retries or falls back to local decode on the prefill replica).
+        Writes are eager ``.at[pids].set`` scatters into the existing pool
+        buffers — the page TABLE stays host numpy and the decode programs
+        rebind it exactly as they do for locally-prefilled slots, so no
+        new jitted program is introduced. Under a sharded pool the update
+        rows are placed with the pool's ``kv_sharding`` first so the
+        scatter preserves the kv-head split.
+        """
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        if payload["page_size"] != self.page_size:
+            raise ValueError(
+                f"payload page_size {payload['page_size']} != pool "
+                f"page_size {self.page_size}"
+            )
+        n = int(payload["n_pages"])
+        if n > self.pages_per_slot:
+            raise ValueError(
+                f"{n} payload pages > pages_per_slot {self.pages_per_slot}"
+            )
+        pages = self.alloc_pages(n)
+        if pages is None:
+            raise InsufficientPages(
+                f"handoff import needs {n} pages, {self.pages_free} free"
+            )
+        idx = np.asarray(pages, np.int32)
+        new_layers = []
+        for layer, src in zip(self.layers, payload["layers"]):
+            new_layer = {}
+            for name, buf in layer.items():
+                rows = np.asarray(src[name], dtype=buf.dtype)
+                if self.kv_sharding is not None:
+                    rows = jax.device_put(rows, self.kv_sharding)
+                new_layer[name] = buf.at[idx].set(rows)
+            new_layers.append(new_layer)
+        self.layers = new_layers
+        self.bind(slot, pages)
+        return pages
+
 
 class PrefixCache:
     """Exact-prefix index over immutable full pages, refcounted + LRU.

@@ -171,6 +171,15 @@ class ServingMetrics:
             "serve_swap_total",
             "Weight hot-swap attempts by outcome (ok | rollback).",
             labels=("outcome",))
+        # Disaggregated-tier handoff outcomes (PR 13). One counter family
+        # covers both sides: the prefill tier emits export / accepted /
+        # fallback / done / failed, the decode tier import /
+        # import_rejected — a fleet-wide scrape shows the full funnel.
+        self._handoff = r.counter(
+            "serve_handoff_total",
+            "KV-page handoff events between serving tiers, by outcome.",
+            labels=("outcome",))
+        self._handoff_outcomes: set = set()
         self._variant_names: set = set()
         # Dtype strings mirrored out of the engine at sync time; ride
         # the snapshot (loadgen's report) since gauges hold floats.
@@ -212,6 +221,14 @@ class ServingMetrics:
 
     def record_shed(self) -> None:
         self._shed.inc()
+
+    def record_handoff(self, outcome: str) -> None:
+        """Count one tier-handoff event (see the counter's help text)."""
+        self._handoff_outcomes.add(str(outcome))
+        self._handoff.labels(outcome=str(outcome)).inc()
+
+    def handoff_count(self, outcome: str) -> int:
+        return int(self._handoff.labels(outcome=str(outcome)).value)
 
     def record_swap(self, outcome: str) -> None:
         """Count one hot-swap attempt (``"ok"`` or ``"rollback"``)."""
@@ -349,6 +366,10 @@ class ServingMetrics:
             "draft_weight_dtype": self._draft_weight_dtype,
             "weight_version": self.weight_version,
             "variant_requests": self.variant_requests(),
+            "handoff": {
+                o: self.handoff_count(o)
+                for o in sorted(self._handoff_outcomes)
+            },
             "swaps": {
                 "ok": self.swap_count("ok"),
                 "rollback": self.swap_count("rollback"),

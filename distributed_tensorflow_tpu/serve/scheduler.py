@@ -148,7 +148,10 @@ class Completion:
 @dataclass(frozen=True)
 class Rejection:
     request_id: str
-    reason: str  # "queue_full" | "deadline" | "invalid" | "shutting_down"
+    # "queue_full" | "deadline" | "invalid" | "shutting_down" |
+    # "insufficient_pages" (decode tier cannot back a handoff import) |
+    # "upstream_died" (decode peer lost after the handoff was accepted)
+    reason: str
     detail: str = ""
 
 
@@ -371,7 +374,13 @@ class Scheduler:
         client_weights=None,
         variants=None,
         variant_quantum: int = 32,
+        role: str = "mixed",
+        handoff=None,
     ):
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be prefill|decode|mixed, got {role!r}"
+            )
         if max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1, got {max_queue_depth}"
@@ -399,6 +408,17 @@ class Scheduler:
         self._draining = False
         self._drain_deadline: float | None = None
         self._inflight: dict[int, _InFlight] = {}
+        # Disaggregated tiers (PR 13). role flows to /healthz -> probes ->
+        # registry so the fleet router can steer fresh prompts at the
+        # prefill tier; ``handoff`` is the prefill-side outbox (duck-typed:
+        # available()/submit()). A parked slot lives in _parked with its
+        # engine.active masked off — registers and pages intact — until
+        # the decode peer ACCEPTS (release) or the push fails pre-accept
+        # (reactivate: local-decode fallback, the request is never lost).
+        self.role = role
+        self.handoff = handoff
+        self._parked: dict[int, _InFlight] = {}
+        self._handoff_inbox: deque = deque()  # decode side: (bundle, pending)
         self._ids = itertools.count()
         self._boundary: deque = deque()  # thread-safe append/popleft
         self._thread: threading.Thread | None = None
@@ -536,6 +556,7 @@ class Scheduler:
         self._run_boundary()
         now = self.clock()
         self._shed_expired(now)
+        self._admit_handoffs(now)
         self._admit(now)
         if self.metrics is not None:
             # Occupancy in the engine's native capacity unit: PAGE
@@ -573,6 +594,7 @@ class Scheduler:
         for slot in np.nonzero(done)[0]:
             self._complete(int(slot))
             completed += 1
+        self._sweep_handoffs()
         return completed
 
     def _shed_expired(self, now: float) -> None:
@@ -713,6 +735,208 @@ class Scheduler:
         if self.metrics is not None:
             self.metrics.record_completed(variant=fl.variant)
 
+    # -- disaggregated tiers: prefill-side handoff (PR 13) -----------------
+
+    def _sweep_handoffs(self) -> None:
+        """End-of-iteration sweep on a prefill-role scheduler: every slot
+        that has produced its first token (TTFT already measured locally)
+        is exported and pushed to the decode tier. Slots still PREFILLING
+        stay — chunked prefill finishes here first; slots whose earlier
+        push fell back keep decoding locally (``handoff_banned``)."""
+        if (self.role != "prefill" or self.handoff is None
+                or not self.handoff.available()):
+            return
+        for slot in list(self._inflight):
+            fl = self._inflight[slot]
+            if fl.handoff_banned:
+                continue
+            if (self.engine.active[slot]
+                    and not self.engine.prefilling[slot]):
+                self._begin_handoff(slot, fl)
+
+    def _begin_handoff(self, slot: int, fl: _InFlight) -> None:
+        from distributed_tensorflow_tpu.serve.fleet.handoff import (
+            encode_bundle,
+        )
+
+        r = fl.pending.request
+        history = [int(t) for t in r.prompt] + [int(t) for t in fl.tokens]
+        try:
+            bundle = self.engine.export_slot(slot, history=history)
+        except RuntimeError:
+            return  # not exportable right now; keep decoding locally
+        payload = encode_bundle(bundle, request_id=r.request_id)
+        # Park: decode stops (active masked off) but registers + pages
+        # stay intact, and the pool still owns the slot — nothing can
+        # re-acquire it until release() or a fallback reactivates it.
+        self.engine.active[slot] = False
+        del self._inflight[slot]
+        self._parked[slot] = fl
+        if self.metrics is not None:
+            self.metrics.record_handoff("export")
+        self.handoff.submit(payload, r.request_id,
+                            _HandoffCallbacks(self, slot, fl))
+
+    def _handoff_accepted(self, slot: int) -> None:
+        """Driver thread (boundary): the decode peer imported the pages —
+        the local copy is now redundant, free the slot."""
+        fl = self._parked.pop(slot, None)
+        if fl is None:
+            return  # already fell back or stop() cleaned up
+        self.engine.release(slot)
+        if self.metrics is not None:
+            self.metrics.record_handoff("accepted")
+
+    def _handoff_fallback(self, slot: int, detail: str = "") -> None:
+        """Driver thread (boundary): no peer accepted before any token
+        streamed — reactivate the parked slot and decode locally. The
+        request loses nothing (registers + pages never moved)."""
+        fl = self._parked.pop(slot, None)
+        if fl is None:
+            return
+        if fl.pending.done():  # stop() shed it while parked
+            self.engine.release(slot)
+            return
+        fl.handoff_banned = True
+        self.engine.active[slot] = True
+        self._inflight[slot] = fl
+        if self.metrics is not None:
+            self.metrics.record_handoff("fallback")
+
+    def _handoff_done(self, fl: _InFlight, payload: dict) -> None:
+        """Outbox worker thread: decode tier finished the request —
+        assemble the end-to-end completion (local first token + relayed
+        decode-tier tokens)."""
+        if fl.pending.done():
+            return
+        r = fl.pending.request
+        fl.pending.finish(
+            Completion(
+                request_id=r.request_id,
+                tokens=tuple(fl.tokens),
+                ttft_s=fl.ttft_s,
+                latency_s=self.clock() - fl.pending.submitted_at,
+                finish_reason=str(payload.get("finish_reason", "length")),
+                variant=fl.variant,
+                weight_version=fl.weight_version,
+            )
+        )
+        if self.metrics is not None:
+            self.metrics.record_handoff("done")
+            self.metrics.record_completed(variant=fl.variant)
+
+    def _handoff_abort(self, fl: _InFlight, detail: str) -> None:
+        """Outbox worker thread: the decode peer died AFTER acceptance —
+        its pages are gone and the local slot was already released, so
+        the request ends with a typed error (the same
+        never-retry-a-partial-stream stance as the fleet router)."""
+        if fl.pending.done():
+            return
+        fl.pending.finish(
+            Rejection(fl.pending.request.request_id, "upstream_died",
+                      detail)
+        )
+        self._count_shed()
+        if self.metrics is not None:
+            self.metrics.record_handoff("failed")
+
+    # -- disaggregated tiers: decode-side import (PR 13) -------------------
+
+    def submit_handoff(self, bundle: dict) -> PendingRequest:
+        """Decode-tier entry (any thread): queue a decoded handoff bundle
+        for import at the next admission boundary. The returned handle is
+        ALWAYS streaming — the prefill side relays its token/done events.
+        Rejections are typed and retryable (``queue_full`` /
+        ``insufficient_pages`` / ``shutting_down``) so the pushing side
+        can try another peer or fall back to local decode."""
+        now = self.clock()
+        history = [int(t) for t in bundle.get("history") or []]
+        made = int(bundle.get("made", 0))
+        prompt = tuple(history[: max(1, len(history) - made)]) or (0,)
+        request = Request(
+            prompt=prompt,
+            max_new_tokens=max(1, int(bundle.get("budget", 1)) - made),
+            temperature=float(bundle.get("temperature", 0.0)),
+            top_k=int(bundle.get("top_k", 0)),
+            top_p=float(bundle.get("top_p", 0.0)),
+            seed=int(bundle.get("seed", 0)),
+            eos_id=(None if bundle.get("eos") is None
+                    else int(bundle["eos"])),
+            request_id=str(bundle.get("request_id")
+                           or f"h{next(self._ids)}"),
+            stream=True,
+        )
+        pending = PendingRequest(request=request, submitted_at=now)
+        pending._stream_q = _queue.Queue()
+        with self._lock:
+            if not self._accepting:
+                pending.finish(
+                    Rejection(request.request_id, "shutting_down",
+                              "scheduler is draining" if self._draining
+                              else "scheduler is stopping")
+                )
+                self._count_shed()
+                return pending
+            depth = (sum(len(q) for q in self._queues.values())
+                     + len(self._handoff_inbox))
+            if depth >= self.max_queue_depth:
+                pending.finish(
+                    Rejection(request.request_id, "queue_full",
+                              f"queue depth {depth} >= "
+                              f"{self.max_queue_depth}")
+                )
+                self._count_shed()
+                return pending
+            self._handoff_inbox.append((bundle, pending))
+        return pending
+
+    def _admit_handoffs(self, now: float) -> None:
+        """Driver thread: import queued handoff bundles into free slots.
+        Imports happen BEFORE fresh admissions — a handed-off request
+        already paid its prefill somewhere and must not starve behind
+        new prompts. Failure is fail-fast and typed: the pushing prefill
+        replica still holds the parked slot and handles retry/fallback."""
+        while True:
+            with self._lock:
+                if not self._handoff_inbox:
+                    return
+                bundle, pending = self._handoff_inbox.popleft()
+            if pending.done():  # stop() shed it while queued
+                continue
+            slot = self.engine.acquire_slot()
+            if slot is None:
+                self._reject_handoff(pending, "queue_full",
+                                     "no free slot on decode tier")
+                continue
+            try:
+                self.engine.import_slot(slot, bundle)
+            except InsufficientPages as exc:
+                self.engine.release(slot)
+                self._reject_handoff(pending, "insufficient_pages",
+                                     str(exc))
+                continue
+            except Exception as exc:  # malformed / mismatched bundle
+                self.engine.release(slot)
+                self._reject_handoff(pending, "invalid", str(exc))
+                continue
+            wv = int(getattr(self.engine, "weight_version", 0))
+            # ttft_s=0.0 (not None): the first token was already served
+            # by the prefill tier — the chunked-TTFT branch in step()
+            # must not re-measure it here.
+            self._inflight[slot] = _InFlight(pending, None, now, 0.0,
+                                             "", wv)
+            if self.metrics is not None:
+                self.metrics.record_handoff("import")
+
+    def _reject_handoff(self, pending: PendingRequest, reason: str,
+                        detail: str) -> None:
+        pending.finish(
+            Rejection(pending.request.request_id, reason, detail)
+        )
+        self._count_shed()
+        if self.metrics is not None:
+            self.metrics.record_handoff("import_rejected")
+
     def run_until_idle(self, max_steps: int | None = None) -> int:
         """Drive ``step()`` until queue and slots are empty; returns total
         completions. ``max_steps`` bounds runaway loops in tests."""
@@ -721,10 +945,15 @@ class Scheduler:
         while True:
             self._run_boundary()
             with self._lock:
-                queued = sum(len(q) for q in self._queues.values())
-            if queued == 0 and not self._inflight:
+                queued = (sum(len(q) for q in self._queues.values())
+                          + len(self._handoff_inbox))
+            if queued == 0 and not self._inflight and not self._parked:
                 return total
             total += self.step()
+            if not self._inflight and self._parked:
+                # Only parked handoffs remain: their outcome arrives from
+                # the outbox worker via boundary ops — yield briefly.
+                time.sleep(0.0005)
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 raise RuntimeError(
@@ -747,8 +976,11 @@ class Scheduler:
                 # swap submitted to a quiet replica still has to apply.
                 self._run_boundary()
                 with self._lock:
-                    idle = not any(len(q) for q in self._queues.values())
+                    idle = (not any(len(q) for q in self._queues.values())
+                            and not self._handoff_inbox)
                 if idle and not self._inflight:
+                    # Parked handoff slots need no engine rounds — their
+                    # boundary ops drain above each poll cycle.
                     self._stop.wait(poll_s)
                     continue
                 self.step()
@@ -783,8 +1015,9 @@ class Scheduler:
     @property
     def idle(self) -> bool:
         with self._lock:
-            queued = sum(len(q) for q in self._queues.values())
-        return queued == 0 and not self._inflight
+            queued = (sum(len(q) for q in self._queues.values())
+                      + len(self._handoff_inbox))
+        return queued == 0 and not self._inflight and not self._parked
 
     def stop(self, timeout: float = 5.0) -> None:
         """Stop accepting, halt the loop, and shed anything unfinished
@@ -803,6 +1036,16 @@ class Scheduler:
         for slot in list(self._inflight):
             del self._inflight[slot]
             self.engine.release(slot)
+        # Handoff state sheds the same way: parked slots free their
+        # pages, queued imports answer typed rejections.
+        leftovers.extend(fl.pending for fl in self._parked.values())
+        for slot in list(self._parked):
+            del self._parked[slot]
+            self.engine.release(slot)
+        with self._lock:
+            inbox = list(self._handoff_inbox)
+            self._handoff_inbox.clear()
+        leftovers.extend(p for _, p in inbox)
         for pending in leftovers:
             if not pending.done():
                 pending.finish(
@@ -856,7 +1099,7 @@ class _InFlight:
     """Host-side accumulation for a request occupying a slot."""
 
     __slots__ = ("pending", "tokens", "started_at", "ttft_s", "variant",
-                 "weight_version")
+                 "weight_version", "handoff_banned")
 
     def __init__(self, pending, first_token, started_at, ttft_s,
                  variant="", weight_version=0):
@@ -870,3 +1113,42 @@ class _InFlight:
         # was started under (attribution survives later hot swaps).
         self.variant = variant
         self.weight_version = int(weight_version)
+        # Set after a failed handoff push: this request finishes on the
+        # local replica (the sweep must not re-export it every round).
+        self.handoff_banned = False
+
+
+class _HandoffCallbacks:
+    """Bridges :class:`~.fleet.handoff.HandoffOutbox` worker events back
+    into the scheduler. Token/terminal events act on the PendingRequest
+    directly (thread-safe by construction — the driver no longer touches
+    a parked request); anything touching the engine trampolines onto the
+    driver thread via ``at_boundary``."""
+
+    __slots__ = ("sched", "slot", "fl")
+
+    def __init__(self, sched: Scheduler, slot: int, fl: _InFlight):
+        self.sched = sched
+        self.slot = slot
+        self.fl = fl
+
+    def on_accepted(self, peer: str) -> None:
+        self.sched.at_boundary(
+            lambda: self.sched._handoff_accepted(self.slot))
+
+    def on_tokens(self, tokens) -> None:
+        if self.fl.pending.done():
+            return
+        toks = [int(t) for t in tokens]
+        self.fl.tokens.extend(toks)
+        self.fl.pending.push_tokens(toks)
+
+    def on_done(self, payload: dict) -> None:
+        self.sched._handoff_done(self.fl, payload)
+
+    def on_failed(self, detail: str, accepted: bool) -> None:
+        if accepted:
+            self.sched._handoff_abort(self.fl, detail)
+        else:
+            self.sched.at_boundary(
+                lambda: self.sched._handoff_fallback(self.slot, detail))

@@ -64,6 +64,15 @@ Endpoints:
   completed/shed/tokens counters.
 * ``GET /metrics.json`` — 200 ``ServingMetrics.snapshot()`` JSON (the
   pre-Prometheus readout, kept for loadgen and humans).
+* ``POST /handoff`` — decode-tier import of a serialized prefill-tier
+  slot (binary bundle body; see ``serve/fleet/handoff.py``). Success is
+  the streaming-/generate SSE shape — the first frame is the pushing
+  side's commit signal; typed rejections (``insufficient_pages``,
+  ``queue_full``, ``shutting_down``) stay plain JSON 429/503 so the
+  pusher retries another peer or falls back to local decode.
+* ``POST /admin/handoff_peers`` — ``{"urls": [...]}`` replaces the
+  prefill replica's decode-peer list (the fleet supervisor pushes
+  membership changes here).
 """
 
 from __future__ import annotations
@@ -81,6 +90,12 @@ _REJECTION_STATUS = {
     "deadline": 503,
     "shutting_down": 503,
     "invalid": 400,
+    # Disaggregated tiers: both are retryable-elsewhere conditions — the
+    # pushing prefill replica tries another decode peer or decodes
+    # locally (insufficient_pages), or surfaces the typed loss of a
+    # decode peer mid-stream (upstream_died).
+    "insufficient_pages": 503,
+    "upstream_died": 503,
 }
 
 
@@ -195,6 +210,10 @@ def make_server(
                     "free_slots": scheduler.engine.free_slots,
                     "queue_depth": scheduler.queue_depth,
                     "draining": bool(getattr(scheduler, "draining", False)),
+                    # Disaggregated-tier role (prefill|decode|mixed):
+                    # probes carry it into the registry so the router
+                    # steers fresh prompts at the prefill tier.
+                    "role": str(getattr(scheduler, "role", "mixed")),
                     # Mesh topology: a tp-wide sharded replica is ONE
                     # replica spanning N devices, not N independent ones —
                     # the router must not multiply its capacity by tp.
@@ -263,6 +282,12 @@ def make_server(
                 self._send(404, {"error": "not_found", "detail": self.path})
 
         def do_POST(self):
+            if self.path == "/handoff":
+                self._handle_handoff()
+                return
+            if self.path == "/admin/handoff_peers":
+                self._handle_handoff_peers()
+                return
             if self.path != "/generate":
                 self._send(404, {"error": "not_found", "detail": self.path})
                 return
@@ -294,6 +319,54 @@ def make_server(
                 })
             else:
                 self._send_rejection(outcome)
+
+        def _handle_handoff(self) -> None:
+            """POST /handoff — decode-tier import of a prefill-tier slot.
+            Body is a binary handoff bundle; the response is the same SSE
+            shape as streaming /generate (the first frame doubles as the
+            ACCEPT signal the pushing side commits on), with synchronous
+            rejections answered as plain typed JSON so the pusher can
+            retry another peer."""
+            from distributed_tensorflow_tpu.serve.fleet.handoff import (
+                decode_bundle,
+            )
+
+            if not hasattr(scheduler, "submit_handoff"):
+                self._send(404, {"error": "not_found",
+                                 "detail": "no handoff support"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                bundle = decode_bundle(self.rfile.read(n))
+            except Exception as exc:  # noqa: BLE001 — malformed wire data
+                self._send(400, {"error": "invalid", "detail": str(exc)})
+                return
+            pending = scheduler.submit_handoff(bundle)
+            self._stream_response(pending)
+
+        def _handle_handoff_peers(self) -> None:
+            """POST /admin/handoff_peers {"urls": [...]} — the fleet
+            supervisor pushes the current decode-tier membership to
+            prefill replicas as replicas come and go."""
+            outbox = getattr(scheduler, "handoff", None)
+            if outbox is None:
+                self._send(400, {"error": "invalid",
+                                 "detail": "replica has no handoff outbox "
+                                           "(role is not prefill)"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                urls = body["urls"]
+                if not isinstance(urls, list) or not all(
+                        isinstance(u, str) for u in urls):
+                    raise ValueError("urls must be a list of strings")
+            except (ValueError, TypeError, KeyError,
+                    json.JSONDecodeError) as exc:
+                self._send(400, {"error": "invalid", "detail": str(exc)})
+                return
+            outbox.set_peers(urls)
+            self._send(200, {"ok": True, "peers": outbox.peers()})
 
         def _completion_payload(self, outcome: Completion) -> dict:
             payload = {

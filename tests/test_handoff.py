@@ -1,0 +1,409 @@
+"""Prefill→decode KV-page handoff (ISSUE 13 tentpole): the bundle codec
+round-trips bit-exactly (f32 and int8 rows+scales), an exported slot
+imported into a SECOND engine continues the request token-identically to
+never-moved local decode (greedy and sampled lanes, short and chunked
+prompts) with zero post-warmup recompiles on either tier, import is
+all-or-nothing under page pressure, and the scheduler plumbing delivers
+the failure matrix: loopback prefill→decode parity end to end, local
+fallback when every push fails (no request lost, ``handoff_banned``
+stops the retry loop), and typed ``insufficient_pages`` /
+``queue_full`` rejections on the decode tier."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from distributed_tensorflow_tpu.serve import ServingMetrics
+from distributed_tensorflow_tpu.serve.engine import SlotEngine
+from distributed_tensorflow_tpu.serve.fleet.handoff import (
+    decode_bundle,
+    encode_bundle,
+)
+from distributed_tensorflow_tpu.serve.kv_pool import InsufficientPages
+from distributed_tensorflow_tpu.serve.scheduler import (
+    Completion,
+    Rejection,
+    Request,
+    Scheduler,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.paged, pytest.mark.elastic]
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    num_heads=4,
+    num_layers=2,
+    d_ff=64,
+    max_seq_len=64,
+    compute_dtype=jnp.float32,
+)
+CFG_INT8 = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    num_heads=4,
+    num_layers=2,
+    d_ff=64,
+    max_seq_len=64,
+    compute_dtype=jnp.float32,
+    kv_cache_dtype="int8",
+)
+
+_ENGINE_KW = dict(slots=2, max_len=64, prefill_len=16, page_size=8,
+                  prefill_chunk_tokens=8)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def _collect(engine, slot, toks):
+    t, valid, done = engine.step()
+    for k in range(t.shape[0]):
+        if valid[k, slot]:
+            toks.append(int(t[k, slot]))
+    return bool(done[slot])
+
+
+def _run_local(engine, prompt, kw):
+    """Reference: admit + decode to completion on ONE engine."""
+    slot = engine.acquire_slot()
+    toks = []
+    first, finished = engine.start(slot, list(prompt), **kw)
+    if first is not None:
+        toks.append(first)
+        if finished:
+            engine.release(slot)
+            return toks
+    while engine.prefilling[slot] or engine.active[slot]:
+        if _collect(engine, slot, toks):
+            break
+    engine.release(slot)
+    return toks
+
+
+def _run_handoff(eng_p, eng_d, prompt, kw, *, local_rounds=0):
+    """Prefill on ``eng_p`` (first token + ``local_rounds`` extra decode
+    rounds — the sweep-at-end-of-step schedule), export → wire round-trip
+    → import, decode to completion on ``eng_d``."""
+    slot = eng_p.acquire_slot()
+    toks = []
+    first, finished = eng_p.start(slot, list(prompt), **kw)
+    if first is not None:
+        toks.append(first)
+    while eng_p.prefilling[slot]:
+        _collect(eng_p, slot, toks)
+    for _ in range(local_rounds):
+        if not eng_p.active[slot]:
+            break
+        _collect(eng_p, slot, toks)
+    assert eng_p.active[slot], "request finished before any handoff"
+    bundle = eng_p.export_slot(slot, history=list(prompt) + toks)
+    bundle = decode_bundle(encode_bundle(bundle, request_id="rt"))
+    eng_p.release(slot)  # the ACCEPT commit point
+    slot_d = eng_d.acquire_slot()
+    eng_d.import_slot(slot_d, bundle)
+    while eng_d.active[slot_d]:
+        if _collect(eng_d, slot_d, toks):
+            break
+    eng_d.release(slot_d)
+    return toks
+
+
+def test_bundle_wire_round_trip_preserves_arrays_and_registers(params):
+    eng = SlotEngine(CFG_INT8, params, **_ENGINE_KW)
+    eng.warmup()
+    slot = eng.acquire_slot()
+    # Prompt <= chunk width: single-shot prefill, first token immediate.
+    first, _ = eng.start(slot, list(range(1, 8)), max_new_tokens=4,
+                         temperature=0.7, top_k=5, seed=11)
+    assert first is not None
+    bundle = eng.export_slot(slot, history=list(range(1, 8)) + [first])
+    wire = encode_bundle(bundle, request_id="req-7")
+    assert wire[:5] == b"DTFH1"
+    back = decode_bundle(wire)
+    assert back["request_id"] == "req-7"
+    for key in ("length", "cur_tok", "made", "budget", "eos", "top_k",
+                "seed", "page_size"):
+        assert back[key] == bundle[key], key
+    assert back["temperature"] == pytest.approx(bundle["temperature"])
+    assert back["history"] == list(bundle["history"])
+    assert back["pages"]["n_pages"] == bundle["pages"]["n_pages"]
+    # Every cache leaf — int8 k/v rows AND their f32 scale planes —
+    # survives byte-exactly with dtype and shape intact.
+    for src_layer, dst_layer in zip(bundle["pages"]["layers"],
+                                    back["pages"]["layers"]):
+        assert set(src_layer) == set(dst_layer)
+        for name, arr in src_layer.items():
+            got = dst_layer[name]
+            assert got.dtype == np.asarray(arr).dtype, name
+            np.testing.assert_array_equal(got, np.asarray(arr))
+    assert {a.dtype.kind for layer in back["pages"]["layers"]
+            for a in layer.values()} >= {"i", "f"}  # int8 rows + f32 scales
+    eng.release(slot)
+
+
+@pytest.mark.parametrize("cfg", [CFG, CFG_INT8], ids=["f32", "int8"])
+def test_handoff_token_parity_engine_pair(cfg, params):
+    """Acceptance: export-after-first-token → import → decode is
+    token-identical to local decode for greedy short, greedy chunked
+    (p > prefill_len), and sampled (spec_k=0) lanes — including an extra
+    local decode round before export (the scheduler's sweep timing) —
+    with ZERO post-warmup recompiles on both tiers."""
+    rng = np.random.default_rng(21)
+    eng_p = SlotEngine(cfg, params, **_ENGINE_KW)
+    eng_d = SlotEngine(cfg, params, **_ENGINE_KW)
+    eng_p.warmup()
+    eng_d.warmup()
+    base_p, base_d = eng_p.compile_count(), eng_d.compile_count()
+    cases = [
+        (rng.integers(1, 64, 6).tolist(), dict(max_new_tokens=7), 0),
+        # Long prompt: chunked prefill runs on the PREFILL tier only.
+        (rng.integers(1, 64, 40).tolist(), dict(max_new_tokens=6), 0),
+        (rng.integers(1, 64, 9).tolist(),
+         dict(max_new_tokens=8, temperature=1.0, top_k=4, seed=13), 0),
+        (rng.integers(1, 64, 6).tolist(), dict(max_new_tokens=7), 2),
+    ]
+    for i, (prompt, kw, local_rounds) in enumerate(cases):
+        ref = _run_local(eng_p, prompt, kw)
+        got = _run_handoff(eng_p, eng_d, prompt, kw,
+                           local_rounds=local_rounds)
+        assert got == ref, (
+            f"case {i} (p={len(prompt)}, kw={kw}, "
+            f"local_rounds={local_rounds}): {got} != {ref}"
+        )
+        assert len(got) == kw["max_new_tokens"]
+    assert eng_p.compile_count() == base_p, "prefill tier recompiled"
+    assert eng_d.compile_count() == base_d, "decode tier recompiled"
+
+
+def test_import_insufficient_pages_is_all_or_nothing(params):
+    eng_p = SlotEngine(CFG, params, **_ENGINE_KW)
+    eng_d = SlotEngine(CFG, params, **_ENGINE_KW)
+    eng_p.warmup()
+    eng_d.warmup()
+    slot = eng_p.acquire_slot()
+    prompt = list(range(1, 31))
+    first, _ = eng_p.start(slot, prompt, max_new_tokens=6)
+    while eng_p.prefilling[slot]:
+        eng_p.step()
+    bundle = eng_p.export_slot(slot, history=prompt)
+    need = bundle["pages"]["n_pages"]
+    assert need > 1
+    # Starve the decode pool below the payload size.
+    hostages = eng_d.pool.alloc_pages(eng_d.pool.pages_free - (need - 1))
+    assert hostages is not None
+    free0 = eng_d.pool.pages_free
+    slot_d = eng_d.acquire_slot()
+    with pytest.raises(InsufficientPages):
+        eng_d.import_slot(slot_d, bundle)
+    # Nothing claimed, slot registers untouched, slot reusable.
+    assert eng_d.pool.pages_free == free0
+    assert not eng_d.active[slot_d]
+    eng_d.release(slot_d)
+    for pid in hostages:
+        eng_d.pool.decref(pid)
+    # With pages back, the same bundle imports and decodes to completion.
+    slot_d = eng_d.acquire_slot()
+    eng_d.import_slot(slot_d, bundle)
+    toks = []
+    while eng_d.active[slot_d]:
+        if _collect(eng_d, slot_d, toks):
+            break
+    eng_d.release(slot_d)
+    assert len(toks) == 6 - bundle["made"]
+    eng_p.release(slot)
+
+
+class _FailingOutbox:
+    """Every push fails before ACCEPT — the no-reachable-peer case."""
+
+    def __init__(self):
+        self.submitted = []
+
+    def available(self):
+        return True
+
+    def submit(self, payload, request_id, callbacks):
+        self.submitted.append(request_id)
+        callbacks.on_failed("connection refused", False)
+
+    def stop(self):
+        pass
+
+
+def test_prefill_fallback_decodes_locally_and_bans_reexport(params):
+    """Failure matrix, pre-ACCEPT: the parked slot is reactivated at the
+    next boundary, decodes locally to the SAME tokens, and is never
+    re-exported (handoff_banned) — zero requests lost, one push tried."""
+    eng = SlotEngine(CFG, params, **_ENGINE_KW)
+    eng.warmup()
+    ref = _run_local(eng, [3, 1, 4, 1, 5], dict(max_new_tokens=6))
+    outbox = _FailingOutbox()
+    metrics = ServingMetrics()
+    sched = Scheduler(eng, metrics=metrics, role="prefill", handoff=outbox)
+    pending = sched.submit(
+        Request(prompt=(3, 1, 4, 1, 5), max_new_tokens=6))
+    assert sched.run_until_idle() == 1
+    outcome = pending.result(timeout=5)
+    assert isinstance(outcome, Completion)
+    assert list(outcome.tokens) == ref
+    assert len(outbox.submitted) == 1, "fallback must ban re-export"
+    assert metrics.handoff_count("export") == 1
+    assert metrics.handoff_count("fallback") == 1
+    assert metrics.handoff_count("accepted") == 0
+
+
+def test_drain_with_prefill_role_and_dead_peers_never_strands(params):
+    """begin_drain during an in-flight CHUNKED prefill on a prefill-role
+    replica whose peers all refuse: the request must finish locally
+    (fallback), never be stranded past the deadline, and new submits get
+    typed ``shutting_down``."""
+    eng = SlotEngine(CFG, params, **_ENGINE_KW)
+    eng.warmup()
+    rng = np.random.default_rng(5)
+    prompt = tuple(rng.integers(1, 64, 40).tolist())
+    # No reference run first: it would seed the prefix cache and the
+    # scheduler's admit would adopt past the chunk threshold (no
+    # PREFILLING phase left to drain through).
+    sched = Scheduler(eng, metrics=ServingMetrics(), role="prefill",
+                      handoff=_FailingOutbox())
+    pending = sched.submit(Request(prompt=prompt, max_new_tokens=5))
+    sched.step()  # admit: the long prompt enters PREFILLING
+    assert eng.prefilling_count == 1
+    sched.begin_drain(deadline_s=10.0)
+    late = sched.submit(Request(prompt=(1, 2), max_new_tokens=2))
+    assert late.result(timeout=1).reason == "shutting_down"
+    assert sched.run_until_idle() == 1
+    outcome = pending.result(timeout=5)
+    assert isinstance(outcome, Completion)
+    assert eng.prefilling_count == 0 and eng.active_count == 0
+    ref = _run_local(eng, prompt, dict(max_new_tokens=5))
+    assert list(outcome.tokens) == ref
+
+
+class _LoopbackOutbox:
+    """In-process decode tier: pushes the encoded bundle straight into a
+    decode-role Scheduler and relays its stream back through the
+    callbacks — the full scheduler-to-scheduler path minus HTTP."""
+
+    def __init__(self, decode_sched):
+        self.decode_sched = decode_sched
+        self.pushes = 0
+
+    def available(self):
+        return True
+
+    def submit(self, payload, request_id, callbacks):
+        self.pushes += 1
+
+        def run():
+            bundle = decode_bundle(payload)
+            pending = self.decode_sched.submit_handoff(bundle)
+            callbacks.on_accepted("loopback")
+            outcome = pending.result(timeout=60.0)
+            if isinstance(outcome, Completion):
+                callbacks.on_tokens(list(outcome.tokens))
+                callbacks.on_done({"finish_reason": outcome.finish_reason})
+            else:
+                callbacks.on_failed(outcome.reason, True)
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def stop(self):
+        pass
+
+
+def test_scheduler_to_scheduler_loopback_parity(params):
+    """Two live schedulers (prefill role → decode role) joined by an
+    in-process outbox: completions are token-identical to local serving,
+    greedy and sampled, and the handoff counters tell the whole story."""
+    eng_p = SlotEngine(CFG, params, **_ENGINE_KW)
+    eng_d = SlotEngine(CFG, params, **_ENGINE_KW)
+    eng_p.warmup()
+    eng_d.warmup()
+    reqs = [
+        Request(prompt=(5, 4, 3, 2, 1), max_new_tokens=6),
+        Request(prompt=(9, 8, 7), max_new_tokens=6, temperature=1.0,
+                top_k=4, seed=17),
+    ]
+    refs = [_run_local(eng_p, r.prompt,
+                       dict(max_new_tokens=r.max_new_tokens,
+                            temperature=r.temperature, top_k=r.top_k,
+                            seed=r.seed))
+            for r in reqs]
+    m_p, m_d = ServingMetrics(), ServingMetrics()
+    sched_d = Scheduler(eng_d, metrics=m_d, role="decode")
+    outbox = _LoopbackOutbox(sched_d)
+    sched_p = Scheduler(eng_p, metrics=m_p, role="prefill", handoff=outbox)
+    sched_d.start(poll_s=0.001)
+    sched_p.start(poll_s=0.001)
+    try:
+        pendings = [sched_p.submit(r) for r in reqs]
+        for pend, ref in zip(pendings, refs):
+            outcome = pend.result(timeout=60)
+            assert isinstance(outcome, Completion), outcome
+            assert list(outcome.tokens) == ref
+    finally:
+        sched_p.stop()
+        sched_d.stop()
+    assert outbox.pushes == len(reqs)
+    assert m_p.handoff_count("export") == len(reqs)
+    assert m_p.handoff_count("accepted") == len(reqs)
+    assert m_p.handoff_count("done") == len(reqs)
+    assert m_p.handoff_count("fallback") == 0
+    assert m_d.handoff_count("import") == len(reqs)
+
+
+def test_decode_tier_typed_rejections(params):
+    """Decode-side admission failures are TYPED, never silent: no free
+    slot → queue_full, pool too small for the payload →
+    insufficient_pages; both leave the decode engine clean."""
+    eng_p = SlotEngine(CFG, params, **_ENGINE_KW)
+    eng_p.warmup()
+    slot = eng_p.acquire_slot()
+    prompt = list(range(1, 31))
+    eng_p.start(slot, prompt, max_new_tokens=6)
+    while eng_p.prefilling[slot]:
+        eng_p.step()
+    bundle = eng_p.export_slot(slot, history=prompt)
+
+    eng_d = SlotEngine(CFG, params, **_ENGINE_KW)
+    eng_d.warmup()
+    sched_d = Scheduler(eng_d, metrics=ServingMetrics(), role="decode")
+    # Occupy every slot: the bundle has nowhere to land.
+    s0, s1 = eng_d.acquire_slot(), eng_d.acquire_slot()
+    pend = sched_d.submit_handoff(dict(bundle))
+    sched_d.step()
+    outcome = pend.result(timeout=5)
+    assert isinstance(outcome, Rejection)
+    assert outcome.reason == "queue_full"
+    eng_d.release(s0)
+    eng_d.release(s1)
+    # Starve pages instead: typed insufficient_pages, slot returned.
+    hostages = eng_d.pool.alloc_pages(eng_d.pool.pages_free - 1)
+    pend = sched_d.submit_handoff(dict(bundle))
+    sched_d.step()
+    outcome = pend.result(timeout=5)
+    assert isinstance(outcome, Rejection)
+    assert outcome.reason == "insufficient_pages"
+    for pid in hostages:
+        eng_d.pool.decref(pid)
+    assert eng_d.active_count == 0
+    # And with room, the same bundle is admitted and completes.
+    pend = sched_d.submit_handoff(dict(bundle))
+    assert sched_d.run_until_idle() == 1
+    outcome = pend.result(timeout=5)
+    assert isinstance(outcome, Completion)
+    assert len(outcome.tokens) == 6 - bundle["made"]
+    eng_p.release(slot)

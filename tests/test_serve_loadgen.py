@@ -111,6 +111,58 @@ def test_report_file_emits_one_parseable_jsonl_record(loadgen, capsys, tmp_path)
     assert len(report_path.read_text().splitlines()) == 2
 
 
+@pytest.mark.elastic
+def test_shape_plan_is_deterministic_piecewise_and_complete(loadgen):
+    """build_shape_plan emits exactly num_requests arrivals with
+    monotonic offsets, phases in shape order, and per-phase density
+    proportional to the phase's rate multiplier (burst denser than its
+    baseline)."""
+    plan = loadgen.build_shape_plan("burst", 60, rate=30.0)
+    assert plan == loadgen.build_shape_plan("burst", 60, rate=30.0)
+    assert len(plan) == 60
+    offsets = [t for t, _ in plan]
+    assert offsets == sorted(offsets) and offsets[0] == 0.0
+    phases = [p for _, p in plan]
+    order = [name for name, _ in loadgen.SHAPES["burst"]]
+    first_seen = sorted(set(phases), key=phases.index)
+    assert first_seen == [name for name in order if name in first_seen]
+    counts = {name: phases.count(name) for name in set(phases)}
+    assert counts["burst"] > counts.get("baseline", 0)
+    assert counts["burst"] > counts.get("recovery", 0)
+    for shape in loadgen.SHAPES:
+        assert len(loadgen.build_shape_plan(shape, 17, rate=10.0)) == 17
+
+
+@pytest.mark.elastic
+def test_shape_requires_open_loop_rate(loadgen):
+    with pytest.raises(SystemExit):
+        loadgen.main(["--shape", "burst", "--num_requests", "4", *_SHAPE])
+
+
+@pytest.mark.elastic
+def test_shaped_open_loop_reports_per_phase_percentiles(loadgen, capsys):
+    """--shape burst drives the self-served stack through the piecewise
+    schedule; the report carries per-phase completed/shed/latency
+    percentiles and the global typed-bucket invariant still holds."""
+    n = 12
+    rc = loadgen.main(["--smoke", "--num_requests", str(n),
+                       "--rate", "20", "--shape", "burst", *_SHAPE])
+    report = _last_json(capsys)
+    assert rc == 0
+    assert report["mode"] == "open" and report["shape"] == "burst"
+    assert report["dropped_without_shed"] == 0
+    per = report["per_phase"]
+    assert set(per) <= {"baseline", "burst", "recovery"} and "burst" in per
+    accounted = sum(v["completed"] + v["shed"] + v["errored"]
+                    for v in per.values())
+    assert accounted == n
+    for bucket in per.values():
+        if bucket["completed"]:
+            assert (bucket["ttft_ms"]["p99"] >= bucket["ttft_ms"]["p50"] >= 0)
+            assert (bucket["latency_ms"]["p99"]
+                    >= bucket["latency_ms"]["p50"] > 0)
+
+
 def test_unreachable_url_is_dropped_and_exits_nonzero(loadgen, capsys):
     """Transport failures are NOT typed sheds: they land in
     dropped_without_shed and --smoke must exit 1."""

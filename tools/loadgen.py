@@ -19,6 +19,11 @@ Two modes of driving, two modes of arrival:
   of R req/s REGARDLESS of completions — measures behavior past
   saturation, where admission control must shed rather than build an
   unbounded backlog (the classic closed-loop blind spot).
+  ``--shape diurnal|burst|step`` turns the open loop into a piecewise
+  rate schedule (equal-duration phases at ``rate x multiplier`` — the
+  traffic an autoscaler must track) and splits p50/p95/p99 per phase in
+  the report, so "did TTFT blow up during the burst before the
+  supervisor reacted" is a single JSONL field.
 
 Every request is accounted for exactly once: completed, shed (typed
 rejection / HTTP 4xx-5xx with a structured body), or errored (transport
@@ -77,9 +82,18 @@ class _Accounting:
         # token counts, and every weight version observed per variant —
         # a hot swap mid-run shows up as two versions under one variant.
         self.per_variant = {}
+        # Traffic-shape attribution: outcome + latency samples per
+        # schedule phase ("burst", "trough", ...) when --shape is set.
+        self.per_phase = {}
+
+    def _phase_bucket(self, phase):
+        return self.per_phase.setdefault(phase, {
+            "completed": 0, "shed": 0, "errored": 0, "tokens": 0,
+            "ttft_s": [], "latency_s": [],
+        })
 
     def complete(self, ttft_s, latency_s, n_tokens, gaps=None,
-                 variant=None, weight_version=None):
+                 variant=None, weight_version=None, phase=None):
         """``gaps``: measured inter-token gaps (SSE frame arrivals). When
         absent, the decode-phase mean (latency - ttft) / (n - 1) stands in
         — per-request, so the percentile spread across requests survives."""
@@ -104,6 +118,12 @@ class _Accounting:
                 v["latency_s"].append(latency_s)
                 if weight_version is not None:
                     v["weight_versions"].add(int(weight_version))
+            if phase is not None:
+                b = self._phase_bucket(phase)
+                b["completed"] += 1
+                b["tokens"] += n_tokens
+                b["ttft_s"].append(ttft_s)
+                b["latency_s"].append(latency_s)
 
     def variant_report(self):
         """JSON-ready per-variant split (p50/p95/p99 + token parity)."""
@@ -121,14 +141,36 @@ class _Accounting:
                 for name, v in sorted(self.per_variant.items())
             }
 
-    def reject(self, reason):
+    def phase_report(self):
+        """JSON-ready per-phase split of the shaped run (p50/p95/p99 per
+        schedule phase — where "TTFT during the burst" lives)."""
+        with self.lock:
+            return {
+                name: {
+                    "completed": b["completed"],
+                    "shed": b["shed"],
+                    "errored": b["errored"],
+                    "tokens": b["tokens"],
+                    "ttft_ms": {k: round(x * 1e3, 3) for k, x in
+                                _percentiles(b["ttft_s"]).items()},
+                    "latency_ms": {k: round(x * 1e3, 3) for k, x in
+                                   _percentiles(b["latency_s"]).items()},
+                }
+                for name, b in self.per_phase.items()
+            }
+
+    def reject(self, reason, phase=None):
         with self.lock:
             self.shed += 1
             self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+            if phase is not None:
+                self._phase_bucket(phase)["shed"] += 1
 
-    def error(self):
+    def error(self, phase=None):
         with self.lock:
             self.errored += 1
+            if phase is not None:
+                self._phase_bucket(phase)["errored"] += 1
 
     def attribute(self, headers):
         """Record routing metadata from a response's headers (no-op for
@@ -146,6 +188,66 @@ class _Accounting:
                     self.failovers += max(0, int(attempts) - 1)
                 except ValueError:
                     pass
+
+
+class _PhaseAcct:
+    """View of an ``_Accounting`` that tags every outcome with the
+    schedule phase the request was dispatched in. The submit paths see
+    the same four-method surface; the global totals are untouched."""
+
+    __slots__ = ("acct", "phase")
+
+    def __init__(self, acct, phase):
+        self.acct = acct
+        self.phase = phase
+
+    def complete(self, *args, **kwargs):
+        self.acct.complete(*args, phase=self.phase, **kwargs)
+
+    def reject(self, reason):
+        self.acct.reject(reason, phase=self.phase)
+
+    def error(self):
+        self.acct.error(phase=self.phase)
+
+    def attribute(self, headers):
+        self.acct.attribute(headers)
+
+
+# Traffic shapes: ordered (phase, rate-multiplier) pieces, each holding
+# an EQUAL share of wall time at ``--rate x multiplier``. diurnal is the
+# compressed day (trough → ramp → peak → evening → night) an autoscaler
+# rides up and down; burst is the step-function spike that tests
+# reaction time; step is the minimal two-level regime change.
+SHAPES = {
+    "diurnal": (("trough", 0.3), ("ramp", 0.8), ("peak", 1.6),
+                ("evening", 0.8), ("night", 0.3)),
+    "burst": (("baseline", 0.4), ("burst", 2.4), ("recovery", 0.4)),
+    "step": (("low", 0.5), ("high", 1.5)),
+}
+
+
+def build_shape_plan(shape, num_requests, rate):
+    """Piecewise open-loop arrival plan: ``[(offset_s, phase), ...]`` of
+    exactly ``num_requests`` entries. Phases get equal wall duration;
+    within a phase arrivals are evenly spaced at ``rate x multiplier``,
+    so request counts are proportional to the multiplier. Deterministic
+    — the same flags always produce the same schedule."""
+    pieces = SHAPES[shape]
+    total_mult = sum(m for _, m in pieces)
+    # Phase duration such that the whole plan spends ~num_requests.
+    dur = num_requests / (rate * total_mult)
+    plan = []
+    t0 = 0.0
+    for idx, (phase, mult) in enumerate(pieces):
+        r = rate * mult
+        n = int(round(dur * r))
+        if idx == len(pieces) - 1:
+            n = num_requests - len(plan)  # absorb rounding drift
+        for k in range(max(0, n)):
+            plan.append((t0 + k / r, phase))
+        t0 += dur
+    return plan[:num_requests]
 
 
 def _read_sse(resp, t0, acct):
@@ -352,9 +454,14 @@ def run_load(
     make_payload,
     timeout_s,
     mid_run_hook=None,
+    schedule=None,
 ):
     """Drive ``submit_one(payload)`` for ``num_requests`` requests.
     ``rate`` > 0 switches to open loop at that many req/s.
+    ``schedule`` — a ``[(offset_s, phase), ...]`` plan from
+    :func:`build_shape_plan` — supersedes the flat rate: arrivals follow
+    the plan's offsets and every outcome is additionally tagged with its
+    phase (``acct.per_phase``).
     ``mid_run_hook`` fires exactly once, just before the request at the
     halfway index is dispatched — the swap-under-load lever: the e2e
     test and ``bench_hotswap`` publish a new checkpoint from it, so
@@ -374,7 +481,23 @@ def run_load(
         mid_run_hook()
 
     t_start = time.monotonic()
-    if rate and rate > 0:
+    if schedule:
+        # Shaped open loop: piecewise arrival plan, phase-tagged
+        # accounting. Late completions never delay the next arrival.
+        for i, (offset, phase) in enumerate(schedule):
+            target = t_start + offset
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            maybe_hook(i)
+            th = threading.Thread(
+                target=submit_one,
+                args=(make_payload(i), timeout_s, _PhaseAcct(acct, phase)),
+                daemon=True,
+            )
+            th.start()
+            threads.append(th)
+    elif rate and rate > 0:
         # Open loop: fixed schedule, one thread per in-flight request; late
         # completions never delay the next arrival.
         for i in range(num_requests):
@@ -438,6 +561,12 @@ def main(argv=None):
         "--rate", type=float, default=0.0,
         help="open-loop arrival rate in req/s (0 = closed loop)",
     )
+    parser.add_argument(
+        "--shape", default="", choices=["", *sorted(SHAPES)],
+        help="open-loop traffic shape: piecewise rate schedule "
+        "(equal-duration phases at --rate x per-phase multiplier) with "
+        "per-phase p50/p95/p99 in the report; requires --rate",
+    )
     parser.add_argument("--prompt_len", type=int, default=8)
     parser.add_argument("--max_new_tokens", type=int, default=16)
     parser.add_argument("--temperature", type=float, default=0.0)
@@ -497,6 +626,11 @@ def main(argv=None):
         "> 1; needs that many visible devices)",
     )
     args, _ = parser.parse_known_args(argv)
+
+    if args.shape and not args.rate > 0:
+        parser.error("--shape needs an open loop: pass --rate R")
+    schedule = (build_shape_plan(args.shape, args.num_requests, args.rate)
+                if args.shape else None)
 
     import random
 
@@ -618,6 +752,7 @@ def main(argv=None):
         make_payload=make_payload,
         timeout_s=args.timeout_s,
         mid_run_hook=mid_run_hook,
+        schedule=schedule,
     )
     # Scrape server health BEFORE teardown so the report record is
     # self-describing: was the server SLO-degraded during this run, and did
@@ -667,6 +802,8 @@ def main(argv=None):
             for k, v in _percentiles(acct.intertoken_s).items()
         },
         "mode": "open" if args.rate > 0 else "closed",
+        "shape": args.shape,
+        "per_phase": acct.phase_report(),
         "mesh": mesh_info,
         "slo": slo_status,
         "recompile_events_total": recompiles,

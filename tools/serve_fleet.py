@@ -33,6 +33,22 @@ fleet canary resolve on ``client_id``) to replicas that carry it.
 
 ``launch_fleet()`` / ``ReplicaProc`` are importable — ``bench.py`` and
 the e2e kill-a-replica test drive the same spawning code as the CLI.
+
+Elastic mode (``--supervise``): instead of a static launch list, the
+:class:`serve.fleet.elastic.FleetSupervisor` owns every replica process
+— it replaces dead replicas, scales between ``--min_replicas`` and
+``--max_replicas`` on sustained ``fleet_pressure`` / SLO breaches, and
+drains (never SIGKILLs in-flight work) on scale-down. Every replica the
+supervisor brings up — including replacements, long after startup — is
+re-announced on THIS process's stdout with the same ``serving on
+http://… pid=… role=…`` prefix, so external discovery keeps working.
+
+Disaggregated tiers (``--prefill_replicas N --decode_replicas M``):
+replicas boot role-tagged; the router steers fresh prompts at the
+prefill tier, which runs prefill + first token and then hands each
+slot's KV pages to a decode replica (``POST /handoff``). The launcher
+(and the supervisor, on every membership change) pushes the decode
+tier's URLs to each prefill replica via ``POST /admin/handoff_peers``.
 """
 
 from __future__ import annotations
@@ -60,6 +76,7 @@ class ReplicaProc:
     def __init__(self, proc: subprocess.Popen):
         self.proc = proc
         self.url: str | None = None
+        self.role: str = "mixed"
         self.tail = collections.deque(maxlen=200)
         self._url_ready = threading.Event()
         self._reader = threading.Thread(target=self._read, daemon=True)
@@ -82,6 +99,9 @@ class ReplicaProc:
                 + "\n".join(self.tail)
             )
         return self.url
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
 
     def terminate(self, grace_s: float = 15.0) -> None:
         if self.proc.poll() is None:
@@ -126,6 +146,26 @@ def launch_fleet(
         raise
 
 
+def push_handoff_peers(prefill_urls, decode_urls,
+                       timeout_s: float = 5.0) -> None:
+    """POST the decode tier's membership to every prefill replica's
+    handoff outbox. Best-effort: a replica that is mid-boot or gone gets
+    the next membership push."""
+    import json
+    import urllib.request
+
+    body = json.dumps({"urls": list(decode_urls)}).encode()
+    for url in prefill_urls:
+        try:
+            req = urllib.request.Request(
+                url.rstrip("/") + "/admin/handoff_peers", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            urllib.request.urlopen(req, timeout=timeout_s).read()
+        except Exception:  # noqa: BLE001 — membership pushes are repeated
+            pass
+
+
 def main(argv=None):
     from distributed_tensorflow_tpu import obs
     from distributed_tensorflow_tpu.config import (
@@ -135,6 +175,7 @@ def main(argv=None):
     )
     from distributed_tensorflow_tpu.serve.fleet import (
         FleetRouter,
+        FleetSupervisor,
         ReplicaRegistry,
         make_router_server,
     )
@@ -145,23 +186,109 @@ def main(argv=None):
     fleet_cfg = from_args(FleetConfig, ns)
     if fleet_cfg.num_replicas < 1:
         sys.exit("--num_replicas must be >= 1")
+    tiered = fleet_cfg.prefill_replicas > 0 or fleet_cfg.decode_replicas > 0
+    if tiered and (fleet_cfg.prefill_replicas < 1
+                   or fleet_cfg.decode_replicas < 1):
+        sys.exit("a disaggregated fleet needs --prefill_replicas >= 1 "
+                 "AND --decode_replicas >= 1")
 
-    print(
-        f"serve_fleet: starting {fleet_cfg.num_replicas} replicas "
-        f"({' '.join(replica_argv) or 'default flags'})",
-        flush=True,
-    )
-    replicas = launch_fleet(fleet_cfg.num_replicas, replica_argv)
+    def spawn_replica(role: str) -> ReplicaProc:
+        """Spawn one role-tagged replica and wait for its URL; every
+        (re)announcement reuses serve_lm's ``serving on`` prefix so
+        discovery that tails THIS process keeps working in supervised
+        mode, where replacements appear long after startup."""
+        extra = [] if role == "mixed" else ["--role", role]
+        cmd = [
+            sys.executable, os.path.join(_TOOLS_DIR, "serve_lm.py"),
+            "--port", "0", *extra, *replica_argv,
+        ]
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        replica = ReplicaProc(proc)
+        url = replica.wait_url(180.0)
+        replica.role = role
+        print(f"serving on {url} pid={proc.pid} role={role}", flush=True)
+        return replica
+
+    if tiered:
+        initial_roles = (["prefill"] * fleet_cfg.prefill_replicas
+                         + ["decode"] * fleet_cfg.decode_replicas)
+    else:
+        initial_roles = ["mixed"] * fleet_cfg.num_replicas
+
     registry = ReplicaRegistry(
-        [r.url for r in replicas],
+        [],
         up_after=fleet_cfg.up_after,
         down_after=fleet_cfg.down_after,
     )
+    supervisor = None
+    replicas: list[ReplicaProc] = []
+
+    def on_membership(members) -> None:
+        """Supervised membership changed: keep every prefill replica's
+        decode-peer list current."""
+        if not tiered:
+            return
+        decode_urls = [m.handle.url for m in members
+                       if m.role == "decode" and not m.draining]
+        prefill_urls = [m.handle.url for m in members
+                        if m.role == "prefill" and not m.draining]
+        push_handoff_peers(prefill_urls, decode_urls)
+
+    if fleet_cfg.supervise:
+        print(
+            f"serve_fleet: supervising {len(initial_roles)} replicas "
+            f"(min={fleet_cfg.min_replicas} max={fleet_cfg.max_replicas} "
+            f"watermarks={fleet_cfg.scale_low_watermark}/"
+            f"{fleet_cfg.scale_high_watermark} "
+            f"{' '.join(replica_argv) or 'default flags'})",
+            flush=True,
+        )
+        supervisor = FleetSupervisor(
+            registry,
+            spawn_replica,
+            min_replicas=fleet_cfg.min_replicas,
+            max_replicas=fleet_cfg.max_replicas,
+            high_watermark=fleet_cfg.scale_high_watermark,
+            low_watermark=fleet_cfg.scale_low_watermark,
+            scale_up_sustain_s=fleet_cfg.scale_up_sustain_s,
+            scale_down_sustain_s=fleet_cfg.scale_down_sustain_s,
+            cooldown_s=fleet_cfg.scale_cooldown_s,
+            drain_grace_s=fleet_cfg.drain_grace_s,
+            # Elastic capacity lands in the decode tier (prefill work is
+            # bursty but short; decode holds slots for whole responses).
+            role_for=(lambda direction: "decode") if tiered
+            else (lambda direction: "mixed"),
+            on_change=on_membership,
+        )
+        supervisor.start(len(initial_roles), roles=initial_roles,
+                         interval_s=fleet_cfg.supervisor_tick_s)
+        expected_up = supervisor.member_count()
+    else:
+        print(
+            f"serve_fleet: starting {len(initial_roles)} replicas "
+            f"({' '.join(replica_argv) or 'default flags'})",
+            flush=True,
+        )
+        replicas = [spawn_replica(role) for role in initial_roles]
+        for replica in replicas:
+            registry.add(replica.url)
+        if tiered:
+            push_handoff_peers(
+                [r.url for r in replicas if r.role == "prefill"],
+                [r.url for r in replicas if r.role == "decode"],
+            )
+        expected_up = len(replicas)
+
     router = FleetRouter(registry, max_attempts=fleet_cfg.max_attempts)
     slo_rules = obs.parse_slo_flag(
         fleet_cfg.fleet_slo, defaults=obs.default_fleet_rules)
     slo_monitor = (obs.SloMonitor(registry.metrics_registry, slo_rules)
                    if slo_rules else None)
+    if slo_monitor is not None and supervisor is not None:
+        supervisor.attach_slo(slo_monitor)
     server = make_router_server(
         router, fleet_cfg.router_host, fleet_cfg.router_port,
         slo=slo_monitor)
@@ -169,14 +296,17 @@ def main(argv=None):
     # Let the hysteresis see enough probes to mark replicas up before we
     # announce — the URLs were parsed from live servers, so this is quick.
     deadline = time.monotonic() + 30.0
-    while registry.up_count() < len(replicas) and time.monotonic() < deadline:
+    while registry.up_count() < expected_up and time.monotonic() < deadline:
         time.sleep(fleet_cfg.probe_interval_s)
     if slo_monitor is not None:
         slo_monitor.start(fleet_cfg.fleet_slo_interval_s)
     host, port = server.server_address
+    member_urls = ([m.handle.url for m in supervisor.members]
+                   if supervisor is not None
+                   else [r.url or "?" for r in replicas])
     print(
         f"router on http://{host}:{port}  replicas="
-        f"{','.join(r.url or '?' for r in replicas)} "
+        f"{','.join(member_urls)} "
         f"up={registry.up_count()}",
         flush=True,
     )
@@ -193,6 +323,8 @@ def main(argv=None):
         if slo_monitor is not None:
             slo_monitor.stop()
         registry.stop()
+        if supervisor is not None:
+            supervisor.stop(drain=True)
         for replica in replicas:
             replica.terminate()
         print("serve_fleet: shut down cleanly", flush=True)

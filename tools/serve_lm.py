@@ -190,12 +190,28 @@ def build_stack(serve_cfg, cfg, params, deploy_cfg=None):
         # real swap must not breach the zero-recompile SLO.
         swapper.prewarm()
     engine.warmup()
+    # Disaggregated tiers: a prefill-role replica gets a handoff outbox
+    # (peers may arrive later via POST /admin/handoff_peers) and pushes
+    # every slot to the decode tier at its first token; a decode-role
+    # replica accepts imports on POST /handoff. "mixed" (default) is the
+    # classic single-tier replica — no outbox, nothing changes.
+    role = str(getattr(serve_cfg, "role", "mixed") or "mixed")
+    handoff = None
+    if role == "prefill":
+        from distributed_tensorflow_tpu.serve.fleet.handoff import (
+            HandoffOutbox,
+        )
+
+        handoff = HandoffOutbox(
+            getattr(serve_cfg, "handoff_peer_list", ()))
     scheduler = Scheduler(
         engine,
         max_queue_depth=serve_cfg.max_queue_depth,
         metrics=metrics,
         lane_weights=getattr(serve_cfg, "lane_weight_tuple", (8, 4, 1)),
         variants=variants,
+        role=role,
+        handoff=handoff,
     )
     if swapper is not None:
         swapper.scheduler = scheduler
@@ -331,7 +347,8 @@ def main(argv=None):
         f"serving on http://{host}:{port}  slots={engine.slots} "
         f"max_len={engine.max_len} prefill_len={engine.prefill_len} "
         f"kv={kv_desc} mesh=tp{engine.tp}x{engine.mesh_device_count}dev "
-        f"weights={engine.weight_dtype} compiled={engine.compile_count()}",
+        f"weights={engine.weight_dtype} role={scheduler.role} "
+        f"compiled={engine.compile_count()}",
         flush=True,
     )
 
@@ -422,6 +439,8 @@ def main(argv=None):
         if server.slo_monitor is not None:
             server.slo_monitor.stop()
         scheduler.stop()
+        if getattr(scheduler, "handoff", None) is not None:
+            scheduler.handoff.stop()
         if writer is not None:
             metrics.publish(writer, pub_step[0] + 1)
             writer.close()
