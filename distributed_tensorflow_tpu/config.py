@@ -505,6 +505,29 @@ class ServeConfig:
             "decoding — this only changes latency"
         },
     )
+    spec_branches: int = field(
+        default=1,
+        metadata={
+            "help": "draft-tree branches per speculative verify round "
+            "(requires spec_k > 0): 1 = linear drafts (default), N > 1 = "
+            "a shared draft tree per slot (branch 0 the linear drafter, "
+            "extras pooled from every active slot's history) verified in "
+            "one widened forward under a tree-attention mask. Greedy "
+            "output stays token-identical; sampled lanes stay lossless "
+            "(multi-candidate rejection sampling)"
+        },
+    )
+    kv_dtype: str = field(
+        default="",
+        metadata={
+            "help": "live KV-cache page format: '' = model default, "
+            "'bf16' = compute-dtype rows (explicit native), 'int8' = "
+            "quantize-on-write int8 rows + per-row f32 scales with "
+            "dequant fused on attend (~0.27x KV bytes/token vs f32; "
+            "works under SlotEngine and ShardedSlotEngine — scale "
+            "planes shard on the kv-head axis like the rows)"
+        },
+    )
     prefill_chunk_tokens: int = field(
         default=0,
         metadata={
@@ -619,6 +642,38 @@ class ServeConfig:
                 int(model_cfg.d_model), int(model_cfg.d_ff),
                 tp=max(1, int(self.tp)),
             )
+
+    def validate_kv(self) -> None:
+        """Fail fast on a KV-format / speculation combination the engine
+        would reject anyway — at config-build time, with the flag names in
+        the message."""
+        if self.kv_dtype not in ("", "bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be '', 'bf16' or 'int8', got "
+                f"{self.kv_dtype!r}"
+            )
+        if self.spec_branches < 1:
+            raise ValueError(
+                f"spec_branches must be >= 1, got {self.spec_branches}"
+            )
+        if self.spec_branches > 1 and not self.spec_k:
+            raise ValueError(
+                "spec_branches > 1 requires spec_k > 0 (tree speculation "
+                "widens the verify block; there is nothing to widen "
+                "without drafts)"
+            )
+
+    @property
+    def engine_kv_cache_dtype(self):
+        """Resolve ``kv_dtype`` to ``TransformerConfig.kv_cache_dtype``:
+        ``''`` keeps the model bundle's own setting (no override),
+        ``'bf16'`` forces native compute-dtype rows (``None``), ``'int8'``
+        forces quantize-on-write int8 pages. Returns the sentinel string
+        ``'keep'`` for no-override so callers can distinguish it from an
+        explicit ``None``."""
+        if not self.kv_dtype:
+            return "keep"
+        return "int8" if self.kv_dtype == "int8" else None
 
 
 def validate_tp_mesh(model_cfg, tp: int) -> None:

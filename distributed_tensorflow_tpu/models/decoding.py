@@ -28,7 +28,9 @@ __all__ = [
     "init_draft_params",
     "make_draft_config",
     "propose_ngram_drafts",
+    "propose_ngram_tree",
     "rejection_verify_row",
+    "tree_rejection_verify_row",
     "sample_logits",
     "sample_logits_batched",
 ]
@@ -279,6 +281,164 @@ def propose_ngram_drafts(history, k: int, ngram: int = 2):
                     draft[: cont.size] = cont
                     return draft
     return draft
+
+
+def propose_ngram_tree(history, k: int, branches: int,
+                       extra_histories=(), ngram: int = 2):
+    """Multi-branch prompt-lookup drafting (host-side, numpy): a
+    ``(branches, k)`` draft TREE whose row 0 is exactly
+    :func:`propose_ngram_drafts` and whose remaining rows are DISTINCT
+    alternative continuations of the sequence's final n-gram — first from
+    earlier occurrences in the slot's own history, then from
+    ``extra_histories`` (the OTHER active slots' histories: slots sharing
+    a drafter pool their pattern memory, which is the cross-slot shared
+    part of tree speculation — a peer that already emitted the phrase this
+    slot is entering donates the continuation as a branch).
+
+    Rows that cannot be filled with a fresh candidate repeat row 0
+    (duplicate branches are harmless: greedy accept ties break to the
+    lowest row, and the rejection-sampling verify auto-rejects a root
+    whose probability mass was already consumed). As with the linear
+    drafter, branch quality only affects SPEED, never correctness."""
+    if branches < 1:
+        raise ValueError(f"branches must be >= 1, got {branches}")
+    h = np.asarray(history, np.int32).ravel()
+    out = np.zeros((branches, k), np.int32)
+    out[:] = propose_ngram_drafts(h, k, ngram=ngram)[None]
+    n = int(h.size)
+    if n == 0 or branches == 1:
+        return out
+    cands = []
+    for g in range(min(ngram, n - 1), 0, -1):
+        pat = h[n - g:]
+        # Own history: every earlier occurrence, most recent first.
+        for j in range(n - g - 1, -1, -1):
+            if np.array_equal(h[j : j + g], pat):
+                cont = h[j + g : j + g + k]
+                if cont.size:
+                    cands.append(cont)
+        # Peers: the most recent occurrence per shared history.
+        for eh in extra_histories:
+            e = np.asarray(eh, np.int32).ravel()
+            for j in range(int(e.size) - g, -1, -1):
+                if np.array_equal(e[j : j + g], pat):
+                    cont = e[j + g : j + g + k]
+                    if cont.size:
+                        cands.append(cont)
+                        break
+    seen = {out[0].tobytes()}
+    row = 1
+    for cont in cands:
+        if row >= branches:
+            break
+        cand = np.full(k, cont[-1], np.int32)
+        cand[: cont.size] = cont
+        key = cand.tobytes()
+        if key not in seen:
+            seen.add(key)
+            out[row] = cand
+            row += 1
+    return out
+
+
+def tree_rejection_verify_row(filtered_logits, tree, seed, made):
+    """Lossless rejection-sampling verify for ONE slot's draft TREE — the
+    path extension of :func:`rejection_verify_row` (SpecInfer-style
+    multi-path verification, arXiv:2305.09781).
+
+    ``filtered_logits`` (N, V) f32 with ``N = 1 + B*D``: row 0 is the
+    target distribution after the slot's current token (level 1 — shared
+    by every branch root); row ``1 + b*D + j`` conditions on branch ``b``'s
+    drafts ``0..j`` (so it is the level ``j + 2`` distribution along that
+    branch). ``tree`` (B, D) int32 — row 0 must be the linear drafter's
+    block for the pointwise accepted-per-verify guarantee. ``seed``/
+    ``made``: the key for emission offset ``j`` is
+    ``fold_in(PRNGKey(seed), made + j)`` — same emitted-token-count
+    indexing as the linear verify, so rounds consume disjoint indices.
+
+    Level 1 runs SEQUENTIAL multi-candidate rejection sampling over the B
+    point-mass roots: accept root ``b`` with prob ``p_res[c_b] / z`` where
+    ``p_res`` starts at ``softmax(row 0)`` and each rejection zeroes the
+    rejected token's mass out of both ``p_res`` and the remaining mass
+    ``z`` (duplicate roots auto-reject: their mass is already 0). The
+    per-candidate accept uniform is ``uniform(fold_in(key_0, 3 + b))``;
+    if every root rejects, the level-1 token is a categorical from the
+    final residual (``fold_in(key_0, 2)``). The accepted marginal is
+    exactly ``softmax(row 0)`` — the standard multi-draft result — and
+    levels ``2..D`` continue single-candidate verify ALONG the accepted
+    branch with the linear scheme's sub-keys (accept ``fold_in(key_j, 1)``,
+    residual ``fold_in(key_j, 2)``, bonus at offset D from
+    ``fold_in(key_D, 2)``). Streams differ from plain sampled decode (as
+    with PR 11's linear verify) but every emitted token is marginally an
+    exact draw from the slot's filtered target distribution.
+
+    Returns ``(emitted (D+1,) int32, accepts (,) int32, bsel (,) int32)``:
+    ``emitted[:accepts]`` are accepted path drafts, ``emitted[accepts]``
+    the residual/bonus draw, ``bsel`` the branch whose KV block the engine
+    compacts into the canonical slot timeline (0 when all roots reject —
+    its block is junk above the single emitted token and never attended)."""
+    n, v = filtered_logits.shape
+    b_br, d = tree.shape
+    base = jax.random.PRNGKey(seed)
+    keys = jax.vmap(lambda j: jax.random.fold_in(base, made + j))(
+        jnp.arange(d + 1, dtype=jnp.int32)
+    )
+    k0 = keys[0]
+    p0 = jax.nn.softmax(filtered_logits[0].astype(jnp.float32))
+    u_roots = jax.vmap(
+        lambda i: jax.random.uniform(jax.random.fold_in(k0, 3 + i))
+    )(jnp.arange(b_br, dtype=jnp.int32))
+
+    def try_root(carry, inp):
+        p_res, z, chosen = carry
+        c, u, i = inp
+        acc = (chosen < 0) & (u * z < p_res[c])
+        chosen = jnp.where(acc, i, chosen)
+        rej = chosen < 0  # nothing accepted through this candidate
+        z = jnp.where(rej, z - p_res[c], z)
+        p_res = jnp.where(rej, p_res.at[c].set(0.0), p_res)
+        return (p_res, z, chosen), None
+
+    (p_res, _, chosen), _ = jax.lax.scan(
+        try_root,
+        (p0, jnp.float32(1.0), jnp.int32(-1)),
+        (tree[:, 0], u_roots, jnp.arange(b_br, dtype=jnp.int32)),
+    )
+    all_rej = chosen < 0
+    t_rej = jax.random.categorical(
+        jax.random.fold_in(k0, 2), jnp.log(jnp.maximum(p_res, 1e-38))
+    ).astype(jnp.int32)
+    bsel = jnp.maximum(chosen, 0).astype(jnp.int32)
+    # Levels 2..D: single-candidate verify along the accepted branch.
+    path_rows = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         1 + bsel * d + jnp.arange(d, dtype=jnp.int32)]
+    )
+    filt_path = jnp.take(filtered_logits, path_rows, axis=0)  # (d+1, V)
+    drafts_path = jnp.take(tree, bsel, axis=0)  # (d,)
+    p_path = jax.nn.softmax(filt_path.astype(jnp.float32), axis=-1)
+    u_tail = jax.vmap(
+        lambda kj: jax.random.uniform(jax.random.fold_in(kj, 1))
+    )(keys[1:d])
+    acc_tail = u_tail < p_path[jnp.arange(1, d), drafts_path[1:]]
+    accept = jnp.concatenate([(~all_rej)[None], acc_tail])
+    accepts = jnp.cumprod(accept.astype(jnp.int32)).sum()
+    is_draft = jnp.arange(v)[None, :] == drafts_path[1:, None]
+    resid = jnp.where(
+        is_draft, _NEG_INF, jnp.log(jnp.maximum(p_path[1:d], 1e-38))
+    )
+    alt_keys = jax.vmap(lambda kj: jax.random.fold_in(kj, 2))(keys)
+    res_tail = jax.vmap(jax.random.categorical)(
+        alt_keys[1:d], resid
+    ).astype(jnp.int32)
+    bonus = jax.random.categorical(
+        alt_keys[d], filt_path[d]
+    ).astype(jnp.int32)
+    alt = jnp.concatenate([t_rej[None], res_tail, bonus[None]])
+    drafts_pad = jnp.concatenate([drafts_path, jnp.zeros((1,), jnp.int32)])
+    j = jnp.arange(d + 1)
+    emitted = jnp.where(j < accepts, drafts_pad, alt)
+    return emitted, accepts, bsel
 
 
 def make_draft_config(cfg: TransformerConfig, num_layers: int,

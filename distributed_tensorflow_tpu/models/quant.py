@@ -51,6 +51,7 @@ import jax.numpy as jnp
 __all__ = [
     "QuantDense",
     "dequantize_lm_params",
+    "kv_cache_bytes_per_token",
     "pack_int4",
     "quantize_int4_groupwise",
     "quantize_int8_channelwise",
@@ -355,3 +356,22 @@ def tree_bytes(params) -> int:
             for leaf in jax.tree_util.tree_leaves(params)
         )
     )
+
+
+def kv_cache_bytes_per_token(cfg) -> int:
+    """Analytic KV-cache bytes per token position across all layers, in
+    the format ``cfg.kv_cache_dtype`` selects — the activation analogue of
+    :func:`tree_bytes`. Per layer a position stores k + v rows of
+    ``kv_heads * head_dim`` elements; int8 rows add one f32 scale per
+    (kv head, position) per row kind. The pools' measured
+    ``bytes_per_token`` must equal this exactly (pinned in tests) — the
+    gap between the int8 and native values is the byte diet the
+    ``bench_serving`` ratio gate enforces."""
+    dh = cfg.d_model // cfg.num_heads
+    kv = cfg.kv_heads
+    if getattr(cfg, "kv_cache_dtype", None) == "int8":
+        per_layer = 2 * kv * dh * 1 + 2 * kv * 4  # int8 rows + f32 scales
+    else:
+        itemsize = jnp.dtype(cfg.compute_dtype).itemsize
+        per_layer = 2 * kv * dh * itemsize
+    return int(cfg.num_layers * per_layer)

@@ -221,7 +221,7 @@ def _accepts_rope_tables(attend) -> bool:
 
 
 def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
-                       positions=None):
+                       positions=None, self_mask=None):
     """Pre-norm self-attention + residual, shared by :class:`Block` and the
     MoE block (``parallel/expert_parallel.py``). MUST be called from inside
     an ``@nn.compact`` module body — layers are declared with fixed names
@@ -232,7 +232,16 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
     ``positions`` (B, S) global token positions — only consumed when
     ``cfg.position == 'rope'`` (the q/k head rotation needs them; sequence
     shards pass their global positions, same contract as ``pos_embed``).
-    None defaults to ``arange(S)`` offset by the cache's filled length."""
+    None defaults to ``arange(S)`` offset by the cache's filled length.
+
+    ``self_mask`` (S, S) bool — cached path only: the fed block is a TREE
+    of speculative drafts, not a chain, so cache WRITE order within the
+    block is not causal order. Query ``q`` attends the committed prefix
+    (cache positions below ``len``) plus exactly the in-block entries
+    ``self_mask[q]`` marks True (its tree ancestors, self inclusive);
+    positions at or past ``len + S`` stay masked (stale junk). The callers
+    pass tree-semantic ``positions`` alongside, so rotations/embeddings
+    follow tree DEPTH while cache offsets follow write order."""
     h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x)
     b, s, _ = h.shape
     dh = cfg.d_model // cfg.num_heads
@@ -402,9 +411,32 @@ def attention_sublayer(cfg, x, attend, train: bool = False, cache=None,
             scores = scores * k_scale[:, :, None, None, :]
         q_pos = cache["len"] + jnp.arange(s)  # (s,)
         key_pos = jnp.arange(ks.shape[2])  # (S_max,)
-        allowed = key_pos[None, :] <= q_pos[:, None]  # (s, S_max)
-        if getattr(cfg, "attention_window", None) is not None:
-            allowed &= key_pos[None, :] > q_pos[:, None] - cfg.attention_window
+        if self_mask is not None:
+            # Tree-speculation verify: in-block keys are gated by the
+            # static ancestor mask (write order != causal order inside the
+            # block), the committed prefix is fully visible, and stale
+            # rows past the block stay hidden. attention_window cannot
+            # compose with a tree block (positions are non-monotone in
+            # write order) — the serving engine rejects that pairing at
+            # construction.
+            if getattr(cfg, "attention_window", None) is not None:
+                raise ValueError(
+                    "self_mask (tree attention) is incompatible with "
+                    "attention_window"
+                )
+            in_block = (key_pos[None, :] >= cache["len"]) & (
+                key_pos[None, :] < cache["len"] + s
+            )
+            rel = jnp.clip(key_pos - cache["len"], 0, s - 1)
+            allowed = (key_pos[None, :] < cache["len"]) | (
+                in_block & self_mask[:, rel]
+            )
+        else:
+            allowed = key_pos[None, :] <= q_pos[:, None]  # (s, S_max)
+            if getattr(cfg, "attention_window", None) is not None:
+                allowed &= (
+                    key_pos[None, :] > q_pos[:, None] - cfg.attention_window
+                )
         scores = jnp.where(allowed[None, None, None, :, :], scores, A.NEG_INF)
         weights = jax.nn.softmax(scores, -1)
         if quant == "int8":
@@ -432,16 +464,18 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, attend, train: bool = False, cache=None,
-                 positions=None):
+                 positions=None, self_mask=None):
         """``cache=None`` — training/prefill path. With a cache dict
         ``{'k','v','len'}`` (K/V laid out (B, KV_heads, S_max, dh) —
         num_heads for MHA, num_kv_heads under GQA; ``len`` the filled
         prefix length), runs cached decode and returns
         ``(x, new_cache)``. ``positions`` feeds the RoPE rotation only
-        (see :func:`attention_sublayer`)."""
+        (see :func:`attention_sublayer`); ``self_mask`` is the cached-path
+        tree-attention ancestor mask."""
         cfg = self.cfg
         x, cache = attention_sublayer(
-            cfg, x, attend, train=train, cache=cache, positions=positions
+            cfg, x, attend, train=train, cache=cache, positions=positions,
+            self_mask=self_mask,
         )
 
         h = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(x)
@@ -464,7 +498,8 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, train: bool = False, cache=None):
+    def __call__(self, tokens, positions=None, train: bool = False, cache=None,
+                 self_mask=None):
         cfg = self.cfg
         b, s = tokens.shape
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="tok_embed")(
@@ -515,7 +550,7 @@ class TransformerLM(nn.Module):
                 layer = dict(cache["layers"][i], len=cache["len"])
                 x, layer = Block(cfg, name=f"block_{i}")(
                     x, attend, train=train, cache=layer,
-                    positions=rope_positions,
+                    positions=rope_positions, self_mask=self_mask,
                 )
                 # Preserve every per-layer buffer (k/v plus the int8
                 # cache's k_scale/v_scale); 'len' is shared, not per-layer.

@@ -55,6 +55,21 @@ the compile count must never grow — the ``RecompileSentinel`` contract):
   accepted length, which the overwrite invariant below already makes
   unreadable.
 
+* **tree verify** (``spec_branches > 1``, replaces the linear verify
+  programs) — each slot contributes a ``(spec_branches, spec_k)`` draft
+  TREE (branch 0 the linear drafter's block; extra branches are
+  alternative n-gram continuations pooled across ALL active slots'
+  histories — the batch-wide shared draft pool) and ONE widened forward
+  of ``1 + B*k`` tokens verifies every branch under a static
+  tree-attention ancestor mask. Greedy lanes accept the best branch's
+  longest matching path token-identically (ties to branch 0, so
+  accepted-per-verify dominates the linear baseline); sampled lanes run
+  sequential multi-candidate rejection sampling over the branch roots
+  then the linear verify along the winner
+  (``models/decoding.tree_rejection_verify_row`` — still lossless). The
+  accepted branch's KV block is compacted onto the slot's canonical
+  timeline inside the program before the page scatter.
+
 * **chunked prefill** (``prefill_chunk_tokens > 0``, paged only) — a
   prompt whose post-adoption tail exceeds the chunk width is fed across
   ENGINE ITERATIONS instead of one monolithic forward: full-width
@@ -113,8 +128,10 @@ from distributed_tensorflow_tpu.models.decoding import (
     filter_logits_batched,
     init_cache,
     propose_ngram_drafts,
+    propose_ngram_tree,
     rejection_verify_row,
     sample_logits_batched,
+    tree_rejection_verify_row,
 )
 from distributed_tensorflow_tpu.models.transformer import TransformerLM
 from distributed_tensorflow_tpu.serve.kv_pool import (
@@ -156,6 +173,7 @@ class SlotEngine:
         kv_pages: int = 0,
         prefix_cache: bool = True,
         spec_k: int = 0,
+        spec_branches: int = 1,
         prefill_buckets: tuple = (),
         prefill_chunk_tokens: int = 0,
         draft_params=None,
@@ -182,6 +200,28 @@ class SlotEngine:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if spec_k and not page_size:
             raise ValueError("spec_k > 0 requires the paged KV layout")
+        spec_branches = int(spec_branches)
+        if spec_branches < 1:
+            raise ValueError(
+                f"spec_branches must be >= 1, got {spec_branches}"
+            )
+        if spec_branches > 1:
+            if not spec_k:
+                raise ValueError("spec_branches > 1 requires spec_k > 0")
+            if getattr(cfg, "attention_window", None) is not None:
+                # Tree verify feeds a non-chain block: in-block positions
+                # are non-monotone in cache-write order, which the sliding
+                # window's relative-offset mask cannot express.
+                raise ValueError(
+                    "spec_branches > 1 (tree speculation) is incompatible "
+                    "with attention_window"
+                )
+            if 1 + spec_branches * spec_k > max_len - 1:
+                raise ValueError(
+                    f"tree verify width 1 + {spec_branches}*{spec_k} "
+                    f"exceeds max_len - 1 ({max_len - 1}); shrink "
+                    "spec_branches/spec_k"
+                )
         self.cfg = cfg
         # Place params through the same path swap candidates stage through
         # (``_place_params``): a checkpoint bundle arrives as host numpy,
@@ -199,6 +239,15 @@ class SlotEngine:
         self.page_size = int(page_size)
         self.paged = self.page_size > 0
         self.spec_k = int(spec_k)
+        self.spec_branches = spec_branches
+        # Positions a verify round writes above each slot's length: the
+        # whole fed block. _decode_round's end-of-window fallback guard
+        # uses this (tree blocks are wider than linear ones).
+        self._spec_write = (
+            1 + spec_branches * self.spec_k
+            if spec_branches > 1
+            else self.spec_k + 1
+        )
         # Prefill width buckets (paged only): the prefill program is
         # shape-polymorphic in its tokens width, so a FIXED set of widths
         # is just a fixed set of compiled programs — warmup compiles every
@@ -328,10 +377,15 @@ class SlotEngine:
             "spec_drafts_proposed_model": 0,
             "spec_rounds": 0,
             "spec_rounds_sampled": 0,
+            "spec_verifies": 0,
             "plain_rounds": 0,
             "prefill_chunks": 0,
             "prefill_tokens_last_iter": 0,
         }
+        # Per-slot accepted-draft counts, one sample per (slot, verify
+        # round) — loadgen/metrics read accepted-per-verify p50/p99 off
+        # this bounded window.
+        self.accept_samples: deque[int] = deque(maxlen=4096)
         self._force_plain = False  # warmup hook: compile the non-spec path
 
         model, k_sync = self.model, self.steps_per_sync
@@ -650,6 +704,153 @@ class SlotEngine:
 
             return spec_fn
 
+        def make_tree_spec(rs: bool):
+            B, D = self.spec_branches, self.spec_k
+            N = 1 + B * D
+            S = D + 1
+            # Static tree topology. Node (b, j) — branch b's depth-(j+1)
+            # draft — is FED (and cache-written) at flat index 1 + b*D + j,
+            # but its SEMANTIC position is length + 1 + j: write order is
+            # branch-major while causal order is per-branch. The ancestor
+            # mask, depth vector and parent table below encode that once,
+            # as compile-time constants.
+            anc = np.zeros((N, N), bool)
+            anc[0, 0] = True
+            par = np.zeros((B, D), np.int32)
+            for b in range(B):
+                for j in range(D):
+                    r = 1 + b * D + j
+                    anc[r, 0] = True
+                    anc[r, 1 + b * D : r + 1] = True
+                    par[b, j] = 0 if j == 0 else 1 + b * D + (j - 1)
+            self_mask = jnp.asarray(anc)
+            depth = jnp.asarray(
+                np.concatenate([[0], 1 + np.tile(np.arange(D), B)]),
+                jnp.int32,
+            )
+            par = jnp.asarray(par)
+
+            def tree_fn(
+                pool_layers, params, ptabs, active, lengths, tok, drafts,
+                temp, top_k, top_p, seed, made, budget, eos,
+            ):
+                """One shared-draft TREE verify round. Feeds
+                ``[cur_tok, branch_0 d_0..d_{D-1}, ..., branch_{B-1} ...]``
+                (N = 1 + B*D tokens) per slot in ONE widened forward under
+                the static ancestor ``self_mask`` — every branch verifies
+                against the same committed prefix in the same program
+                (SpecInfer-style tree attention), with semantic positions
+                following tree depth rather than write order.
+
+                Greedy lanes accept, per branch, the longest prefix of
+                drafts matching the target's greedy outputs at their PARENT
+                rows, then take the best branch (``argmax`` — first-max
+                ties resolve to branch 0, the linear drafter's block, so
+                accepted-per-verify dominates the linear baseline pointwise
+                on the same trajectory and the emitted stream stays
+                token-identical to plain greedy decode). Sampled lanes run
+                ``tree_rejection_verify_row``: sequential multi-candidate
+                rejection sampling over the B roots, then the PR 11 linear
+                verify along the accepted branch — lossless per token.
+
+                The accepted branch's KV block is COMPACTED in-program onto
+                the canonical slot timeline (rows ``length+1+bsel*D..`` move
+                to ``length+1``) before the page scatter; everything at or
+                above ``length + 1 + D`` is stale junk the write-before-
+                attend invariant keeps unreadable. Outputs match the linear
+                verify's layout exactly (emitted streams are (S, slots)
+                with S = D + 1), so round bookkeeping is shared."""
+
+                def one(row, length, t, d, tm, tk, tp_, sd, md):
+                    cache = gather_cache(pool_layers, row, length)
+                    x = jnp.concatenate([t[None], d.reshape(-1)])[None]
+                    positions = (length + depth)[None]
+                    logits, cache = model.apply(
+                        {"params": params}, x, cache=cache,
+                        positions=positions, self_mask=self_mask,
+                    )
+                    lg = logits[0]  # (N, V)
+                    targets = jnp.argmax(lg, -1).astype(jnp.int32)
+                    # Greedy: per-branch leading-match runs against each
+                    # node's PARENT row target, best branch wins.
+                    match = d == jnp.take(targets, par)  # (B, D)
+                    lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
+                    acc_b = lead.sum(axis=1)  # (B,)
+                    bsel_g = jnp.argmax(acc_b).astype(jnp.int32)
+                    rows_g = jnp.concatenate(
+                        [jnp.zeros((1,), jnp.int32),
+                         1 + bsel_g * D + jnp.arange(D, dtype=jnp.int32)]
+                    )
+                    E_g = jnp.take(targets, rows_g)  # (S,)
+                    a_g = acc_b[bsel_g]
+                    if rs:
+                        filt = filter_logits_batched(
+                            lg,
+                            jnp.full((N,), tm),
+                            jnp.full((N,), tk, jnp.int32),
+                            jnp.full((N,), tp_),
+                        )
+                        E_s, a_s, bsel_s = tree_rejection_verify_row(
+                            filt, d, sd, md
+                        )
+                        is_s = tm > 0.0
+                        E = jnp.where(is_s, E_s, E_g)
+                        a = jnp.where(is_s, a_s, a_g)
+                        bsel = jnp.where(is_s, bsel_s, bsel_g)
+                    else:
+                        E, a, bsel = E_g, a_g, bsel_g
+
+                    def compact(leaf):
+                        # leaf (1, kv, S_max[, dh]); move the selected
+                        # branch's D rows to the canonical offsets right
+                        # after cur_tok's row (bsel = 0 is the identity).
+                        starts = (0, 0, length + 1 + bsel * D)
+                        starts += (0,) * (leaf.ndim - 3)
+                        sizes = (leaf.shape[0], leaf.shape[1], D)
+                        sizes += leaf.shape[3:]
+                        blk = jax.lax.dynamic_slice(leaf, starts, sizes)
+                        dst = (0, 0, length + 1) + (0,) * (leaf.ndim - 3)
+                        return jax.lax.dynamic_update_slice(leaf, blk, dst)
+
+                    pages = [
+                        {k: split_pages(compact(v)[0]) for k, v in l.items()}
+                        for l in cache["layers"]
+                    ]
+                    return pages, E, a, bsel
+
+                pages, E, a, _bsel = jax.vmap(one)(
+                    ptabs, lengths, tok, drafts, temp, top_k, top_p, seed,
+                    made,
+                )
+                dest = jnp.where(active[:, None], ptabs, TRASH_PAGE)
+                new_pool = [
+                    {k: pl[k].at[dest].set(pages[li][k]) for k in pl}
+                    for li, pl in enumerate(pool_layers)
+                ]
+                # Budget / eos truncation — verbatim the linear scheme.
+                n0 = a + 1
+                n1 = jnp.minimum(n0, budget - made)
+                idx = jnp.arange(S)[None, :]
+                eos_in = (E == eos[:, None]) & (idx < n1[:, None])
+                any_eos = eos_in.any(axis=1)
+                first_eos = jnp.argmax(eos_in, axis=1)
+                n_final = jnp.where(any_eos, first_eos + 1, n1)
+                n_final = jnp.where(active, n_final, 0)
+                new_lengths = lengths + n_final
+                new_made = made + n_final
+                rows = jnp.arange(E.shape[0])
+                last = jnp.clip(n_final - 1, 0, S - 1)
+                new_tok = jnp.where(active, E[rows, last], tok)
+                finished = active & ((new_made >= budget) | any_eos)
+                valid = (idx < n_final[:, None]) & active[:, None]
+                accepted = jnp.where(active, jnp.minimum(a, n_final - 1), 0)
+                return (
+                    new_pool, active & ~finished, new_lengths, new_tok,
+                    new_made, E.T, valid.T, accepted,
+                )
+
+            return tree_fn
+
         # Compiled program set, host-selected per call. Two sampling
         # variants of prefill and step: per-row top-k/top-p needs two
         # full-vocab XLA sorts per micro-step (per-row cutoffs defeat
@@ -672,9 +873,13 @@ class SlotEngine:
         self._step_sampled = self._jit_program(
             make_step(True), "step", step_donate
         )
+        # Tree mode (spec_branches > 1) REPLACES the linear verify
+        # programs — a round is either linear or tree for an engine's
+        # whole lifetime, so the compiled set stays fixed either way.
+        tree_mode = self.spec_k > 0 and self.spec_branches > 1
         self._spec = (
             self._jit_program(make_spec(rs=False), "spec", (0,))
-            if self.spec_k
+            if self.spec_k and not tree_mode
             else None
         )
         # The rejection-sampling variant serves rounds with ANY sampled
@@ -682,7 +887,17 @@ class SlotEngine:
         # keeps all-greedy rounds free of the filter's full-vocab sorts.
         self._spec_rs = (
             self._jit_program(make_spec(rs=True), "spec", (0,))
-            if self.spec_k
+            if self.spec_k and not tree_mode
+            else None
+        )
+        self._tree = (
+            self._jit_program(make_tree_spec(rs=False), "tree", (0,))
+            if tree_mode
+            else None
+        )
+        self._tree_rs = (
+            self._jit_program(make_tree_spec(rs=True), "tree", (0,))
+            if tree_mode
             else None
         )
         self._draft = (
@@ -706,7 +921,7 @@ class SlotEngine:
         """Compile hook: the base engine jits on the default device; the
         sharded engine overrides this to jit the SAME program under its
         mesh with in/out shardings. ``kind`` names the fixed argument
-        layout (``prefill``/``step``/``spec``/``draft``)."""
+        layout (``prefill``/``step``/``spec``/``tree``/``draft``)."""
         return jax.jit(fn, donate_argnums=donate)
 
     # -- slot lifecycle ---------------------------------------------------
@@ -757,6 +972,34 @@ class SlotEngine:
         prop = self.stats[f"spec_drafts_proposed_{drafter}"]
         acc = self.stats[f"spec_drafts_accepted_{drafter}"]
         return acc / prop if prop else 0.0
+
+    @property
+    def spec_accept_per_verify(self) -> float:
+        """Mean accepted drafts per (slot, verify-round) — the quantity
+        tree speculation exists to raise: a tree round costs one widened
+        forward per slot exactly like a linear round costs one narrow one,
+        so accepted-per-verify is the apples-to-apples speedup axis."""
+        ver = self.stats["spec_verifies"]
+        return self.stats["spec_drafts_accepted"] / ver if ver else 0.0
+
+    @property
+    def kv_dtype(self) -> str:
+        """Live KV-cache element format: ``'int8'`` when the pool pages
+        are quantize-on-write int8 rows + f32 scales
+        (``cfg.kv_cache_dtype == 'int8'``), else ``'bf16'`` — the
+        compute-dtype passthrough (f32 bytes under the CPU-smoke f32
+        compute dtype; the label names the serving mode, not the literal
+        storage width). Travels in handoff bundle headers and /healthz so
+        tiers/routers can tell formats apart."""
+        quant = getattr(self.cfg, "kv_cache_dtype", None)
+        return "int8" if quant == "int8" else "bf16"
+
+    @property
+    def kv_bytes_per_token(self) -> float:
+        """KV-cache bytes one token position costs across all layers in
+        the live pool format (int8 rows carry their f32 scale overhead) —
+        the byte-diet gauge ``bench_serving`` ratios int8 against bf16."""
+        return self.pool.bytes_per_token
 
     def acquire_slot(self) -> int | None:
         return self.pool.alloc()
@@ -1107,11 +1350,12 @@ class SlotEngine:
         if (
             self.spec_k
             and not self._force_plain
-            # Verify writes S positions starting at each slot's length; a
-            # slot within spec_k+1 of max_len would clamp the write — fall
-            # back to plain rounds for that (rare, end-of-window) round.
+            # Verify writes the whole fed block above each slot's length
+            # (spec_k+1 linear, 1+B*spec_k tree); a slot within that of
+            # max_len would clamp the write — fall back to plain rounds
+            # for that (rare, end-of-window) round.
             and bool(
-                (self.lengths[self.active] + self.spec_k + 1
+                (self.lengths[self.active] + self._spec_write
                  <= self.max_len).all()
             )
         ):
@@ -1138,8 +1382,12 @@ class SlotEngine:
     def _spec_round(
         self, any_sampled: bool = False
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        drafts = self._propose_drafts()
-        spec = self._spec_rs if any_sampled else self._spec
+        if self.spec_branches > 1:
+            drafts = self._propose_tree_drafts()
+            spec = self._tree_rs if any_sampled else self._tree
+        else:
+            drafts = self._propose_drafts()
+            spec = self._spec_rs if any_sampled else self._spec
         out = spec(
             self.pool.layers, self.params, self.pool.page_tables,
             self.active, self.lengths, self.cur_tok, drafts, self.temp,
@@ -1147,9 +1395,17 @@ class SlotEngine:
             self.eos,
         )
         layers, active, lengths, tok, made, toks, valid, accepted = out
-        proposed = int(self.active.sum()) * self.spec_k
-        accepted_n = int(np.asarray(accepted).sum())
+        n_act = int(self.active.sum())
+        # "Proposed" counts the acceptable path budget (spec_k per slot)
+        # in BOTH modes, so accept-rate stays comparable between linear
+        # and tree rounds; the tree's extra branches only buy a better
+        # chance of a long path, never more accepted tokens per verify.
+        proposed = n_act * self.spec_k
+        acc_arr = np.asarray(accepted)
+        accepted_n = int(acc_arr.sum())
+        self.accept_samples.extend(int(x) for x in acc_arr[self.active])
         self.stats["spec_rounds"] += 1
+        self.stats["spec_verifies"] += n_act
         if any_sampled:
             self.stats["spec_rounds_sampled"] += 1
         self.stats["spec_drafts_proposed"] += proposed
@@ -1187,6 +1443,31 @@ class SlotEngine:
                 self.history[s, : int(self.hist_len[s])], self.spec_k
             )
         return drafts
+
+    def _propose_tree_drafts(self) -> np.ndarray:
+        """(slots, spec_branches, spec_k) draft tree per slot. Branch 0 is
+        EXACTLY :meth:`_propose_drafts`'s row (the linear drafter — learned
+        or n-gram — which is what makes the tree's accepted-per-verify
+        dominate the linear baseline pointwise); branches 1.. come from
+        ``propose_ngram_tree`` over the slot's own history PLUS every other
+        active slot's history — the batch-wide shared draft pool. Slots
+        without enough distinct candidates repeat a filled branch, which
+        the verify treats as a duplicate (harmless)."""
+        B, D = self.spec_branches, self.spec_k
+        tree = np.zeros((self.slots, B, D), np.int32)
+        tree[:, 0, :] = self._propose_drafts()
+        if B > 1:
+            act = np.nonzero(self.active)[0]
+            hists = {
+                s: self.history[s, : int(self.hist_len[s])] for s in act
+            }
+            for s in act:
+                alt = propose_ngram_tree(
+                    hists[s], D, B,
+                    extra_histories=[hists[o] for o in act if o != s],
+                )
+                tree[s, 1:, :] = alt[1:]
+        return tree
 
     def _finish_round(self, layers, active, lengths, tok, made, toks, valid):
         self.pool.layers = layers
@@ -1316,6 +1597,10 @@ class SlotEngine:
             fns.append(self._spec)
         if self._spec_rs is not None:
             fns.append(self._spec_rs)
+        if self._tree is not None:
+            fns.append(self._tree)
+        if self._tree_rs is not None:
+            fns.append(self._tree_rs)
         if self._draft is not None:
             fns.append(self._draft)
         own = sum(
@@ -1484,6 +1769,7 @@ class SlotEngine:
             "seed": int(self.seed[slot]),
             "history": hist,
             "page_size": self.page_size,
+            "kv_dtype": self.kv_dtype,
             "pages": self.pool.export_pages(slot),
         }
 
@@ -1501,6 +1787,19 @@ class SlotEngine:
             raise ValueError(
                 f"handoff page_size {bundle['page_size']} != engine "
                 f"page_size {self.page_size}"
+            )
+        # KV format must match EXACTLY: the pool's import scatters raw
+        # rows into its own leaves by name, so an int8 bundle landing in a
+        # bf16 pool (or vice versa) would silently cast rows without their
+        # scales — garbage KV, not an error. A typed ValueError here takes
+        # the scheduler's existing "invalid" fallback instead (exporter
+        # decodes locally; no request lost, no silent dequant). Absent key
+        # = pre-PR-14 exporter: permissive, formats were implicitly equal.
+        kd = str(bundle.get("kv_dtype", "") or "")
+        if kd and kd != self.kv_dtype:
+            raise ValueError(
+                f"handoff kv_dtype {kd!r} != engine kv_dtype "
+                f"{self.kv_dtype!r}"
             )
         length = int(bundle["length"])
         headroom = int(bundle["budget"]) - int(bundle["made"])
@@ -1660,10 +1959,12 @@ class ShardedSlotEngine(SlotEngine):
             #   -> (pool, active, lengths, tok, made, toks, valid)
             ins = (kvs, psh) + (rep,) * 11
             outs = (kvs,) + (rep,) * 6
-        elif kind == "spec":
+        elif kind in ("spec", "tree"):
             # (pool, params, ptabs, active, lengths, tok, drafts, temp,
             #  top_k, top_p, seed, made, budget, eos) -> (pool, active,
-            #  lengths, tok, made, emitted.T, valid.T, accepted)
+            #  lengths, tok, made, emitted.T, valid.T, accepted). The tree
+            #  verify has the same layout — drafts is (slots, B, D)
+            #  instead of (slots, k), still one replicated host operand.
             ins = (kvs, psh) + (rep,) * 12
             outs = (kvs,) + (rep,) * 7
         else:  # pragma: no cover - new kinds must be wired explicitly

@@ -71,6 +71,9 @@ class ProbeResult:
     devices: int = 1         # devices it spans — a tp-wide replica is ONE
     #                          replica, not tp independent ones
     weight_dtype: str = ""   # 'native'/'int8'/'int4' weight quantization
+    kv_dtype: str = ""       # 'bf16'/'int8' KV ACTIVATION format — tier
+    #                          handoff is only valid between same-format
+    #                          pools, so the router must see it
     # Disaggregation tier ('prefill'/'decode'/'mixed') from the healthz
     # body — the router dispatches new requests to the prefill tier and
     # the supervisor balances tier populations on it.
@@ -131,6 +134,7 @@ def http_probe(base_url: str, timeout_s: float = 2.0) -> ProbeResult:
         tp=int(body.get("mesh", {}).get("tp", 1)),
         devices=int(body.get("mesh", {}).get("devices", 1)),
         weight_dtype=str(body.get("weight_dtype", "")),
+        kv_dtype=str(body.get("kv_dtype", "")),
         role=str(body.get("role", "mixed") or "mixed"),
     )
     deploy = body.get("deploy", {})
@@ -434,6 +438,7 @@ class ReplicaRegistry:
                         "tp": r.last.tp,
                         "devices": r.last.devices,
                         "weight_dtype": r.last.weight_dtype,
+                        "kv_dtype": r.last.kv_dtype,
                         "role": r.last.role,
                         "weight_version": r.last.weight_version,
                         "serving_variant": r.last.serving_variant,

@@ -127,6 +127,12 @@ class SlotKVPool:
     def hbm_bytes_per_slot(self) -> float:
         return self.hbm_bytes / self.slots
 
+    @property
+    def bytes_per_token(self) -> float:
+        """KV bytes one token position costs across all layers in the
+        live cache format (int8 rows include their f32 scale planes)."""
+        return self.hbm_bytes / (self.slots * self.max_len)
+
     def alloc(self) -> int | None:
         """Claim a slot index, or None when the pool is full."""
         if not self._free:
@@ -258,6 +264,16 @@ class PagedKVPool:
     @property
     def hbm_bytes_per_slot(self) -> float:
         return self.hbm_bytes / self.slots
+
+    @property
+    def bytes_per_token(self) -> float:
+        """KV bytes one token position costs across all layers in the
+        live page format (int8 rows include their f32 scale planes) —
+        ``hbm_bytes`` spread over every page's positions. This is the
+        byte-diet ratio's numerator/denominator: at int8 the same HBM
+        backs proportionally more pages, which is the page-capacity gain
+        ``bench_serving`` demonstrates in-run."""
+        return self.hbm_bytes / (self.num_pages * self.page_size)
 
     def pages_needed(self, prompt_len: int, max_new_tokens: int) -> int:
         total = prompt_len + max_new_tokens

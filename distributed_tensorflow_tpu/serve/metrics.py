@@ -155,6 +155,33 @@ class ServingMetrics:
             "leaves count their per-device shard). The quantization "
             "win shows here: int8 trees land near 0.5x of bf16, int4 "
             "near 0.3x at serving shapes.")
+        # KV byte-diet instruments (PR 14): the pool's storage cost per
+        # cacheable token position, and which activation format backs it.
+        # ``serve_kv_dtype`` is an info-style gauge (value 1 on the live
+        # label) because gauges hold floats; the plain string also rides
+        # the snapshot next to weight_dtype.
+        self._kv_bytes_per_token = r.gauge(
+            "serve_kv_bytes_per_token",
+            "KV pool device bytes per cacheable token position. At "
+            "kv_dtype=int8 this is the byte-diet number: int8 rows + "
+            "f32 per-row scales land well under bf16 storage.")
+        self._kv_dtype_info = r.gauge(
+            "serve_kv_dtype",
+            "Live KV activation format (1 on the active dtype label).",
+            labels=("dtype",))
+        # Tree/linear speculation efficiency: accepted tokens per verify
+        # call. The mean is the bench-gated number; p50/p99 come from the
+        # engine's per-round accept reservoir for the loadgen report.
+        self._spec_accept_per_verify = r.gauge(
+            "serve_spec_accept_per_verify",
+            "Cumulative drafted tokens accepted per speculative verify "
+            "call (tree spec raises this over the linear drafter).")
+        self._spec_apv_p50 = r.gauge(
+            "serve_spec_accepted_per_verify_p50",
+            "Median per-slot accepted tokens in one verify round.")
+        self._spec_apv_p99 = r.gauge(
+            "serve_spec_accepted_per_verify_p99",
+            "p99 per-slot accepted tokens in one verify round.")
         # Deploy instruments (PR 12): which checkpoint step is live,
         # traffic attribution per weight variant, and swap outcomes —
         # the three numbers a rollout dashboard needs.
@@ -185,6 +212,7 @@ class ServingMetrics:
         # the snapshot (loadgen's report) since gauges hold floats.
         self._weight_dtype = "native"
         self._draft_weight_dtype = ""
+        self._kv_dtype = ""
         self._peak_lock = threading.Lock()
         self._last_engine_stats: dict = {}
 
@@ -290,6 +318,23 @@ class ServingMetrics:
         if hasattr(engine, "weight_bytes_per_device"):
             self._weight_bytes_per_device.set(
                 float(engine.weight_bytes_per_device))
+        if hasattr(engine, "kv_bytes_per_token"):
+            self._kv_bytes_per_token.set(float(engine.kv_bytes_per_token))
+        kvd = str(getattr(engine, "kv_dtype", "") or "")
+        if kvd and kvd != self._kv_dtype:
+            if self._kv_dtype:
+                self._kv_dtype_info.labels(dtype=self._kv_dtype).set(0.0)
+            self._kv_dtype_info.labels(dtype=kvd).set(1.0)
+            self._kv_dtype = kvd
+        if hasattr(engine, "spec_accept_per_verify"):
+            self._spec_accept_per_verify.set(
+                float(engine.spec_accept_per_verify))
+        samples = sorted(getattr(engine, "accept_samples", ()) or ())
+        if samples:
+            self._spec_apv_p50.set(float(samples[len(samples) // 2]))
+            self._spec_apv_p99.set(
+                float(samples[min(len(samples) - 1,
+                                  (len(samples) * 99) // 100)]))
         self._weight_dtype = tdt
         self._draft_weight_dtype = str(
             getattr(engine, "draft_weight_dtype", ""))
@@ -364,6 +409,11 @@ class ServingMetrics:
             "weight_bytes_per_device": self._weight_bytes_per_device.value,
             "weight_dtype": self._weight_dtype,
             "draft_weight_dtype": self._draft_weight_dtype,
+            "kv_dtype": self._kv_dtype,
+            "kv_bytes_per_token": self._kv_bytes_per_token.value,
+            "spec_accept_per_verify": self._spec_accept_per_verify.value,
+            "spec_accepted_per_verify_p50": self._spec_apv_p50.value,
+            "spec_accepted_per_verify_p99": self._spec_apv_p99.value,
             "weight_version": self.weight_version,
             "variant_requests": self.variant_requests(),
             "handoff": {

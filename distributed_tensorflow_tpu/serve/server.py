@@ -228,6 +228,12 @@ def make_server(
                     "weight_dtype": str(
                         getattr(scheduler.engine, "weight_dtype", "native")
                     ),
+                    # KV ACTIVATION format ('bf16'/'int8') — a prefill tier
+                    # must only hand pages to a decode tier with the same
+                    # format, so probes carry it into the registry.
+                    "kv_dtype": str(
+                        getattr(scheduler.engine, "kv_dtype", "bf16")
+                    ),
                 }
                 if getattr(scheduler.engine, "paged", False):
                     # Page capacity is the real admission gate under the

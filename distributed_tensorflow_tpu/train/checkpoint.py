@@ -1171,6 +1171,12 @@ def load_lm_bundle(path: str, fallback_shapes: dict | None = None):
         weight_dtype=(shape_meta.get("weight_dtype")
                       or fb.get("weight_dtype") or None),
         quant_group_size=dim("quant_group_size", 0),
+        # KV ACTIVATION format (orthogonal to weight_dtype): a bundle
+        # exported with tools/quantize_lm.py --kv_dtype int8 serves
+        # quantize-on-write int8 KV pages by default; --kv_dtype/
+        # --kv_cache_dtype at serve time still override.
+        kv_cache_dtype=(shape_meta.get("kv_cache_dtype")
+                        or fb.get("kv_cache_dtype") or None),
         compute_dtype=jnp.bfloat16
         if jax.default_backend() == "tpu"
         else jnp.float32,

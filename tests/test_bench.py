@@ -41,7 +41,10 @@ def test_bench_emits_one_compact_json_line_and_full_record(tmp_path):
         env=env,
         capture_output=True,
         text=True,
-        timeout=560,
+        # The smoke suite measures ~9.5 min on this box (the PR 14
+        # kv-diet phase added four small-engine warmups); the cap is a
+        # hang guard, not a perf gate.
+        timeout=700,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]

@@ -365,6 +365,66 @@ def test_scheduler_to_scheduler_loopback_parity(params):
     assert m_d.handoff_count("import") == len(reqs)
 
 
+@pytest.mark.kvquant
+@pytest.mark.parametrize(
+    "src_cfg,dst_cfg",
+    [(CFG_INT8, CFG), (CFG, CFG_INT8)],
+    ids=["int8_bundle_to_bf16_pool", "bf16_bundle_to_int8_pool"],
+)
+def test_import_kv_dtype_mismatch_is_typed_both_directions(
+        src_cfg, dst_cfg, params):
+    """ISSUE 14 satellite: the DTFH1 header stamps ``kv_dtype``, and a
+    bundle whose format mismatches the decode tier's pool raises a typed
+    ValueError BEFORE touching the pool — no crash, no silent dequant —
+    in both directions (int8→bf16 and bf16→int8)."""
+    eng_p = SlotEngine(src_cfg, params, **_ENGINE_KW)
+    eng_d = SlotEngine(dst_cfg, params, **_ENGINE_KW)
+    eng_p.warmup()
+    eng_d.warmup()
+    slot = eng_p.acquire_slot()
+    prompt = [2, 7, 1, 8, 3]
+    eng_p.start(slot, prompt, max_new_tokens=6)
+    bundle = eng_p.export_slot(slot, history=prompt)
+    assert bundle["kv_dtype"] == eng_p.kv_dtype
+    # The format survives the wire: it is part of the DTFH1 header.
+    bundle = decode_bundle(encode_bundle(bundle, request_id="mm"))
+    assert bundle["kv_dtype"] == eng_p.kv_dtype
+    slot_d = eng_d.acquire_slot()
+    free0 = eng_d.pool.pages_free
+    with pytest.raises(ValueError, match="kv_dtype"):
+        eng_d.import_slot(slot_d, bundle)
+    # Nothing claimed, decode slot reusable; the exporter still owns the
+    # request and falls back to local decode.
+    assert eng_d.pool.pages_free == free0
+    assert not eng_d.active[slot_d]
+    eng_d.release(slot_d)
+    eng_p.release(slot)
+
+
+@pytest.mark.kvquant
+def test_scheduler_rejects_kv_dtype_mismatch_as_invalid(params):
+    """The scheduler path for the same mismatch: a typed ``invalid``
+    rejection (the exporter-side fallback trigger), decode engine left
+    clean."""
+    eng_p = SlotEngine(CFG_INT8, params, **_ENGINE_KW)
+    eng_p.warmup()
+    slot = eng_p.acquire_slot()
+    prompt = [4, 4, 2, 9]
+    eng_p.start(slot, prompt, max_new_tokens=5)
+    bundle = eng_p.export_slot(slot, history=prompt)
+    eng_d = SlotEngine(CFG, params, **_ENGINE_KW)
+    eng_d.warmup()
+    sched_d = Scheduler(eng_d, metrics=ServingMetrics(), role="decode")
+    pend = sched_d.submit_handoff(dict(bundle))
+    sched_d.step()
+    outcome = pend.result(timeout=5)
+    assert isinstance(outcome, Rejection)
+    assert outcome.reason == "invalid"
+    assert "kv_dtype" in (outcome.detail or "")
+    assert eng_d.active_count == 0
+    eng_p.release(slot)
+
+
 def test_decode_tier_typed_rejections(params):
     """Decode-side admission failures are TYPED, never silent: no free
     slot → queue_full, pool too small for the payload →

@@ -55,11 +55,14 @@ def params():
     )["params"]
 
 
-def _drive(engine, requests):
+def _drive(engine, requests, warm=True):
     """Closed-loop driver: feed ``requests`` (prompt, kwargs) through the
     engine keeping every slot busy; returns per-request token lists and
-    asserts the compile count never moves after warmup."""
-    engine.warmup()
+    asserts the compile count never moves after warmup. ``warm=False``
+    skips the warmup call (second pass on an already-warm engine — warmup
+    clears the prefix cache, which cache-reuse tests must keep)."""
+    if warm:
+        engine.warmup()
     base = engine.compile_count()
     outs = {}
     pending = list(range(len(requests)))
@@ -73,7 +76,9 @@ def _drive(engine, requests):
             prompt, kwargs = requests[i]
             first, finished = engine.start(slot, prompt, **kwargs)
             pending.pop(0)
-            outs[i] = [first]
+            # Chunked prefill returns first=None (the first token arrives
+            # from a later step()).
+            outs[i] = [] if first is None else [first]
             if finished:
                 engine.release(slot)
             else:
@@ -330,6 +335,96 @@ def test_engine_start_raises_insufficient_pages_directly(params):
         engine.step()
     engine.release(s2)
     assert engine.pool.pages_free == engine.pool.num_pages - 1
+
+
+# int8-KV rows of the churn matrix (ISSUE 14 satellite): same contract as
+# the bf16 matrix above, baselined against int8 MONOLITHIC (int8 changes
+# numerics vs bf16 by design; it must not change them across layouts).
+_INT8_LAYOUTS = {
+    "monolithic": dict(page_size=0),
+    "paged+prefix": dict(page_size=8, prefix_cache=True),
+    "paged+prefix+spec": dict(page_size=8, prefix_cache=True, spec_k=4),
+    "paged+prefix+tree": dict(page_size=8, prefix_cache=True, spec_k=4,
+                              spec_branches=2),
+    "paged+prefix+chunked": dict(page_size=8, prefix_cache=True,
+                                 prefill_chunk_tokens=8),
+}
+
+
+@pytest.mark.spec
+@pytest.mark.kvquant
+def test_churn_parity_int8_kv_layouts(params):
+    """Quantize-on-write int8 KV as the LIVE decode format: greedy tokens
+    byte-identical across {monolithic, paged+prefix, +spec, +tree,
+    +chunked} at kv_dtype=int8, zero recompiles in each."""
+    from dataclasses import replace
+
+    cfg8 = replace(CFG, kv_cache_dtype="int8")
+    requests = _churn_requests()
+    results = {}
+    for name, kw in _INT8_LAYOUTS.items():
+        engine = SlotEngine(
+            cfg8, params, slots=4, max_len=48, prefill_len=26, **kw
+        )
+        assert engine.kv_dtype == "int8"
+        results[name] = _drive(engine, requests)
+        if engine.paged:
+            if engine.prefix is not None:
+                engine.prefix.clear()
+            assert engine.pool.pages_free == engine.pool.num_pages - 1, (
+                f"{name}: leaked pages after drain"
+            )
+    baseline = results["monolithic"]
+    for name, got in results.items():
+        for i in range(len(requests)):
+            assert got[i] == baseline[i], (
+                f"int8 {name} diverged from int8 monolithic on request "
+                f"{i}: {got[i]} != {baseline[i]}"
+            )
+
+
+@pytest.mark.kvquant
+def test_prefix_adoption_int8_token_identical(params):
+    """Adopted int8 pages decode token-identically to fresh-prefill int8
+    pages: a second pass of the same workload (warm prefix cache, pages
+    adopted) must reproduce the cold pass exactly."""
+    from dataclasses import replace
+
+    cfg8 = replace(CFG, kv_cache_dtype="int8")
+    requests = _churn_requests()
+    engine = SlotEngine(cfg8, params, slots=4, max_len=48, prefill_len=26,
+                        page_size=8, prefix_cache=True, spec_k=3)
+    cold = _drive(engine, requests)
+    matched_cold = engine.prefix.tokens_matched
+    warm = _drive(engine, requests, warm=False)
+    assert engine.prefix.tokens_matched > matched_cold  # pages adopted
+    for i in range(len(requests)):
+        assert warm[i] == cold[i], (
+            f"adopted int8 pages diverged on request {i}"
+        )
+    engine.prefix.clear()
+    assert engine.pool.pages_free == engine.pool.num_pages - 1
+
+
+@pytest.mark.kvquant
+def test_kv_bytes_per_token_accounting(params):
+    """The pool's measured bytes/token equals the analytic helper in both
+    formats, and int8 lands under the 0.55x byte-diet ceiling."""
+    from dataclasses import replace
+
+    from distributed_tensorflow_tpu.models.quant import (
+        kv_cache_bytes_per_token,
+    )
+
+    cfg8 = replace(CFG, kv_cache_dtype="int8")
+    kw = dict(slots=2, max_len=48, prefill_len=24)
+    for page_size in (0, 8):
+        hi = SlotEngine(CFG, params, page_size=page_size, **kw)
+        lo = SlotEngine(cfg8, params, page_size=page_size, **kw)
+        assert hi.kv_dtype == "bf16" and lo.kv_dtype == "int8"
+        assert hi.kv_bytes_per_token == kv_cache_bytes_per_token(CFG)
+        assert lo.kv_bytes_per_token == kv_cache_bytes_per_token(cfg8)
+        assert lo.kv_bytes_per_token / hi.kv_bytes_per_token <= 0.55
 
 
 @pytest.mark.spec

@@ -1,0 +1,288 @@
+"""Cross-slot shared-draft-tree speculation (ISSUE 14 tentpole).
+
+The contract: tree speculation may change how MANY tokens a verify round
+accepts, never WHICH tokens a request emits. Greedy lanes accept the
+longest matching root-to-leaf path and must stay byte-identical to plain
+decode (branch 0 of every tree IS the linear n-gram draft, so the
+accepted-per-verify of the tree engine is pointwise >= the linear
+engine's on identical greedy trajectories). Sampled lanes verify the
+chosen path with the PR 11 rejection-sampling identity extended to
+multiple point-mass roots — lossless, but a DIFFERENT stream than linear
+spec (the multi-draft literature's standard caveat), so sampled cases
+assert distribution-level sanity, not token equality.
+
+Unit coverage below: n-gram tree proposal (cross-slot branch donation),
+the tree rejection-verify row (accept / all-reject / duplicate roots),
+engine-level greedy parity incl. int8 KV and tp=2 sharding, and the
+accept-per-verify floor.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.decoding import (
+    propose_ngram_drafts,
+    propose_ngram_tree,
+    tree_rejection_verify_row,
+)
+from distributed_tensorflow_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from distributed_tensorflow_tpu.serve.engine import (
+    ShardedSlotEngine,
+    SlotEngine,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.spec, pytest.mark.spectree]
+
+CFG = TransformerConfig(
+    vocab_size=64,
+    d_model=32,
+    num_heads=4,
+    num_layers=2,
+    d_ff=64,
+    max_seq_len=48,
+    compute_dtype=jnp.float32,
+)
+
+ENGINE_KW = dict(slots=4, max_len=48, prefill_len=26, page_size=8)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def _drive(engine, requests):
+    """Closed-loop driver (test_paged_kv's): returns per-request token
+    lists and asserts zero recompiles after warmup."""
+    engine.warmup()
+    base = engine.compile_count()
+    outs = {}
+    pending = list(range(len(requests)))
+    slot2req = {}
+    while pending or slot2req:
+        while pending:
+            slot = engine.acquire_slot()
+            if slot is None:
+                break
+            i = pending[0]
+            prompt, kwargs = requests[i]
+            first, finished = engine.start(slot, prompt, **kwargs)
+            pending.pop(0)
+            outs[i] = [first]
+            if finished:
+                engine.release(slot)
+            else:
+                slot2req[slot] = i
+        if not slot2req:
+            continue
+        toks, valid, done = engine.step()
+        for k in range(toks.shape[0]):
+            for slot, i in slot2req.items():
+                if valid[k, slot]:
+                    outs[i].append(int(toks[k, slot]))
+        for slot in list(slot2req):
+            if done[slot]:
+                engine.release(slot)
+                del slot2req[slot]
+    assert engine.compile_count() == base, (
+        f"recompiled after warmup: {engine.compile_count()} != {base}"
+    )
+    return outs
+
+
+def _requests():
+    rng = np.random.default_rng(7)
+    fam = rng.integers(1, 64, 12).tolist()
+    prompts = (
+        [fam + rng.integers(1, 64, int(t)).tolist() for t in (2, 4)]
+        + [rng.integers(1, 64, int(n)).tolist() for n in (3, 9, 17)]
+    )
+    budgets = (8, 12, 6, 10, 7)
+    return [(p, {"max_new_tokens": b}) for p, b in zip(prompts, budgets)]
+
+
+# -- proposal units --------------------------------------------------------
+
+
+def test_tree_row0_is_linear_draft():
+    """Branch 0 of the proposed tree must BE the linear n-gram draft —
+    that identity is what makes tree accept pointwise >= linear."""
+    hist = [3, 5, 3, 5, 3, 5, 3]
+    tree = propose_ngram_tree(hist, 4, 3)
+    lin = propose_ngram_drafts(hist, 4)
+    assert tree.shape == (3, 4)
+    assert tree.dtype == np.int32
+    assert list(tree[0]) == list(lin)
+
+
+def test_tree_cross_slot_branch_donation():
+    """A peer slot's history that continues the caller's trailing gram
+    must show up as an alternative branch — the cross-slot sharing the
+    tentpole is named for. Own history has 3 -> 9; the peer's 3 -> 7
+    continuation becomes a donated branch."""
+    own = [1, 2, 3, 9, 1, 2, 3]
+    peer = [5, 3, 7, 8, 6, 5, 3, 7, 8, 6]
+    tree = propose_ngram_tree(own, 3, 3, extra_histories=[peer])
+    assert list(tree[0])[0] == 9  # own-history continuation stays row 0
+    donated = {tuple(row[:1]) for row in np.asarray(tree[1:])}
+    assert (7,) in donated, tree
+
+
+def test_tree_pads_with_row0_when_no_alternatives():
+    """No peer material and no repeated grams in the own history: pad
+    rows repeat row 0 (harmless duplicates — the verify auto-rejects
+    them)."""
+    hist = [4, 6, 5]
+    tree = propose_ngram_tree(hist, 3, 4)
+    for b in range(1, 4):
+        assert list(tree[b]) == list(tree[0])
+
+
+# -- tree rejection-verify units ------------------------------------------
+
+
+def _peaked_logits(n, vocab, tok_rows):
+    """Near-point-mass logits: row i puts ~all mass on tok_rows[i]."""
+    logits = np.full((n, vocab), -30.0, dtype=np.float32)
+    for i, t in enumerate(tok_rows):
+        logits[i, t] = 30.0
+    return jnp.asarray(logits)
+
+
+def test_tree_verify_accepts_matching_branch():
+    """Target distribution concentrated along branch 1's path: the row
+    must select branch 1 and accept its full depth + bonus."""
+    B, D, V = 3, 2, 16
+    tree = np.array([[1, 2], [3, 4], [5, 6]], dtype=np.int32)
+    # Rows are [cur, b0d0, b0d1, b1d0, b1d1, b2d0, b2d1]; make the target
+    # chain cur->3, 3->4, 4->7 so branch 1 accepts fully, bonus = 7.
+    toks = [3, 9, 9, 4, 7, 9, 9]
+    logits = _peaked_logits(1 + B * D, V, toks)
+    emitted, accepts, bsel = tree_rejection_verify_row(
+        logits, jnp.asarray(tree), seed=11, made=0)
+    assert int(bsel) == 1
+    assert int(accepts) == D
+    assert [int(t) for t in emitted] == [3, 4, 7]
+
+
+def test_tree_verify_all_reject_emits_residual_token():
+    """No root matches the target mass: exactly one token is emitted and
+    it comes from the residual (never a drafted root)."""
+    B, D, V = 2, 2, 16
+    tree = np.array([[1, 2], [3, 4]], dtype=np.int32)
+    toks = [8, 0, 0, 0, 0]  # target wants 8; roots are 1 and 3
+    logits = _peaked_logits(1 + B * D, V, toks)
+    emitted, accepts, _ = tree_rejection_verify_row(
+        logits, jnp.asarray(tree), seed=5, made=0)
+    assert int(accepts) == 0
+    assert int(emitted[0]) == 8
+
+
+def test_tree_verify_duplicate_roots_no_double_credit():
+    """Padded duplicate branches share a root token; once its residual
+    mass is consumed the duplicate must auto-reject rather than accept
+    the same mass twice. With mass ONLY on token 1, some branch rooted
+    at 1 accepts — deterministically, never more than depth+bonus."""
+    B, D, V = 3, 1, 8
+    tree = np.array([[1], [1], [1]], dtype=np.int32)
+    logits = _peaked_logits(1 + B * D, V, [1, 2, 2, 2])
+    emitted, accepts, bsel = tree_rejection_verify_row(
+        logits, jnp.asarray(tree), seed=0, made=0)
+    assert int(accepts) == 1
+    assert int(emitted[0]) == 1
+    assert int(emitted[1]) == 2  # bonus from the accepted leaf's row
+    assert 0 <= int(bsel) < B
+
+
+# -- engine-level parity ---------------------------------------------------
+
+
+def test_tree_greedy_parity_and_apv_floor(params):
+    """Greedy tree output is byte-identical to plain decode, and the
+    tree engine's accepted-per-verify is >= the linear engine's on the
+    same workload (branch 0 = linear draft)."""
+    reqs = _requests()
+    out_plain = _drive(SlotEngine(CFG, params, **ENGINE_KW), reqs)
+    lin = SlotEngine(CFG, params, spec_k=4, **ENGINE_KW)
+    out_lin = _drive(lin, reqs)
+    tree = SlotEngine(CFG, params, spec_k=4, spec_branches=3, **ENGINE_KW)
+    out_tree = _drive(tree, reqs)
+    for i in range(len(reqs)):
+        assert out_lin[i] == out_plain[i], f"linear spec diverged on {i}"
+        assert out_tree[i] == out_plain[i], f"tree spec diverged on {i}"
+    assert tree.stats["spec_verifies"] > 0
+    assert lin.stats["spec_verifies"] > 0
+    assert tree.spec_accept_per_verify >= lin.spec_accept_per_verify - 1e-9
+    # The reservoir feeding the p50/p99 report gauges filled.
+    assert len(tree.accept_samples) == tree.stats["spec_verifies"]
+
+
+@pytest.mark.kvquant
+def test_tree_greedy_parity_int8_kv(params):
+    """Tree speculation over quantize-on-write int8 KV pages: still
+    byte-identical to int8 plain decode."""
+    from dataclasses import replace
+
+    cfg8 = replace(CFG, kv_cache_dtype="int8")
+    reqs = _requests()
+    out_plain = _drive(SlotEngine(cfg8, params, **ENGINE_KW), reqs)
+    out_tree = _drive(
+        SlotEngine(cfg8, params, spec_k=4, spec_branches=3, **ENGINE_KW),
+        reqs)
+    for i in range(len(reqs)):
+        assert out_tree[i] == out_plain[i], f"int8 tree diverged on {i}"
+
+
+@pytest.mark.sharded_serve
+def test_tree_greedy_parity_sharded_tp2(params):
+    """tp=2 ShardedSlotEngine in tree mode matches single-device plain
+    decode — the 'tree' jit kind reuses the spec sharding specs."""
+    reqs = _requests()
+    out_plain = _drive(SlotEngine(CFG, params, **ENGINE_KW), reqs)
+    out_sh = _drive(
+        ShardedSlotEngine(CFG, params, tp=2, spec_k=4, spec_branches=3,
+                          **ENGINE_KW),
+        reqs)
+    for i in range(len(reqs)):
+        assert out_sh[i] == out_plain[i], f"sharded tree diverged on {i}"
+
+
+def test_tree_sampled_lanes_budget_and_vocab(params):
+    """Sampled requests through the tree verify: every stream respects
+    its budget, tokens stay in-vocab, and sampled rounds actually ran
+    (the RS identity itself is pinned by the unit tests above)."""
+    reqs = [
+        (p, {"max_new_tokens": kw["max_new_tokens"], "temperature": 1.0,
+             "top_k": 8, "seed": 100 + i})
+        for i, (p, kw) in enumerate(_requests())
+    ]
+    eng = SlotEngine(CFG, params, spec_k=4, spec_branches=3, **ENGINE_KW)
+    outs = _drive(eng, reqs)
+    for i, (_, kw) in enumerate(reqs):
+        assert 1 <= len(outs[i]) <= kw["max_new_tokens"]
+        assert all(0 <= t < CFG.vocab_size for t in outs[i])
+    assert eng.stats["spec_rounds_sampled"] > 0
+
+
+def test_tree_config_validation(params):
+    """spec_branches needs spec_k, rejects attention windows (the tree
+    mask composes with full cached attention only), and the widened
+    verify must fit the engine's step width."""
+    with pytest.raises(ValueError, match="spec_branches"):
+        SlotEngine(CFG, params, spec_k=0, spec_branches=2, **ENGINE_KW)
+    with pytest.raises(ValueError, match="spec_branches"):
+        SlotEngine(CFG, params, spec_k=4, spec_branches=0, **ENGINE_KW)
+    from dataclasses import replace
+
+    cfgw = replace(CFG, attention_window=16)
+    with pytest.raises(ValueError, match="attention_window"):
+        SlotEngine(cfgw, params, spec_k=4, spec_branches=2, **ENGINE_KW)
+    with pytest.raises(ValueError, match="max_len"):
+        SlotEngine(CFG, params, spec_k=16, spec_branches=3, **ENGINE_KW)

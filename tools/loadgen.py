@@ -378,7 +378,11 @@ def _scrape_health(url, server):
     nulls."""
     fastpath = {"prefix_hit_rate": None, "spec_accept_rate": None,
                 "spec_accept_rate_by_drafter": {},
-                "weight_dtype": None, "weight_bytes_per_device": None}
+                "weight_dtype": None, "weight_bytes_per_device": None,
+                "kv_dtype": None, "kv_bytes_per_token": None,
+                "spec_accept_per_verify": None,
+                "spec_accepted_per_verify_p50": None,
+                "spec_accepted_per_verify_p99": None}
     if url:
         import urllib.request
 
@@ -409,6 +413,16 @@ def _scrape_health(url, server):
                         sample["value"])
                 elif sample["name"] == "serve_weight_bytes_per_device":
                     fastpath["weight_bytes_per_device"] = int(sample["value"])
+                elif sample["name"] == "serve_kv_bytes_per_token":
+                    fastpath["kv_bytes_per_token"] = float(sample["value"])
+                elif sample["name"] == "serve_spec_accept_per_verify":
+                    fastpath["spec_accept_per_verify"] = float(sample["value"])
+                elif sample["name"] == "serve_spec_accepted_per_verify_p50":
+                    fastpath["spec_accepted_per_verify_p50"] = float(
+                        sample["value"])
+                elif sample["name"] == "serve_spec_accepted_per_verify_p99":
+                    fastpath["spec_accepted_per_verify_p99"] = float(
+                        sample["value"])
         except Exception:
             pass
         # Quant mode rides /healthz (it is a string — no Prometheus home).
@@ -420,6 +434,7 @@ def _scrape_health(url, server):
             except urllib.error.HTTPError as err:  # 503 is still an answer
                 body = json.loads(err.read())
             fastpath["weight_dtype"] = body.get("weight_dtype")
+            fastpath["kv_dtype"] = body.get("kv_dtype")
         except Exception:
             pass
         return slo, recompiles, fastpath
@@ -442,6 +457,14 @@ def _scrape_health(url, server):
         fastpath["weight_dtype"] = snap.get("weight_dtype")
         wb = snap.get("weight_bytes_per_device")
         fastpath["weight_bytes_per_device"] = int(wb) if wb else None
+        fastpath["kv_dtype"] = snap.get("kv_dtype") or None
+        kb = snap.get("kv_bytes_per_token")
+        fastpath["kv_bytes_per_token"] = float(kb) if kb else None
+        for key in ("spec_accept_per_verify",
+                    "spec_accepted_per_verify_p50",
+                    "spec_accepted_per_verify_p99"):
+            val = snap.get(key)
+            fastpath[key] = float(val) if val is not None else None
     return slo, recompiles, fastpath
 
 
@@ -621,6 +644,16 @@ def main(argv=None):
         help="self-serve speculative drafts per verify round (0 = off)",
     )
     parser.add_argument(
+        "--spec_branches", type=int, default=1,
+        help="self-serve draft-tree branches per slot (>1 turns on the "
+        "cross-slot shared draft tree; 1 = linear drafts)",
+    )
+    parser.add_argument(
+        "--kv_dtype", default="", choices=("", "bf16", "int8"),
+        help="self-serve KV activation format: 'int8' = quantize-on-write "
+        "paged KV (the byte diet); '' keeps the model's native setting",
+    )
+    parser.add_argument(
         "--tp", type=int, default=1,
         help="self-serve tensor-parallel width (ShardedSlotEngine when "
         "> 1; needs that many visible devices)",
@@ -727,6 +760,8 @@ def main(argv=None):
             steps_per_sync=args.steps_per_sync,
             page_size=args.page_size,
             spec_k=args.spec_k,
+            spec_branches=args.spec_branches,
+            kv_dtype=args.kv_dtype,
             tp=args.tp,
         )
         engine, scheduler, metrics, server = build_stack(serve_cfg, cfg, params)
@@ -815,6 +850,13 @@ def main(argv=None):
             fastpath["spec_accept_rate_by_drafter"],
         "weight_dtype": fastpath["weight_dtype"],
         "serve_weight_bytes_per_device": fastpath["weight_bytes_per_device"],
+        "kv_dtype": fastpath["kv_dtype"],
+        "serve_kv_bytes_per_token": fastpath["kv_bytes_per_token"],
+        "serve_spec_accept_per_verify": fastpath["spec_accept_per_verify"],
+        "serve_spec_accepted_per_verify_p50":
+            fastpath["spec_accepted_per_verify_p50"],
+        "serve_spec_accepted_per_verify_p99":
+            fastpath["spec_accepted_per_verify_p99"],
         "t_wall": time.time(),
         "concurrency": args.concurrency,
         "rate": args.rate,

@@ -32,11 +32,17 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 
-def _config_meta(cfg, mode, group_size):
+def _config_meta(cfg, mode, group_size, kv_dtype=""):
     """The full metadata ``config`` dict ``load_lm_bundle`` reads — every
     shape key plus the quant mode, so the loader's init template grows the
-    int kernel_q/scale structure the state dict carries."""
+    int kernel_q/scale structure the state dict carries. ``kv_dtype``
+    (``--kv_dtype int8``) additionally stamps the KV ACTIVATION format:
+    weight quantization changes the stored params, KV quantization changes
+    nothing in the bundle payload — it is a serving-time mode the engine
+    applies quantize-on-write — so it rides as pure metadata and the
+    loader folds it into ``cfg.kv_cache_dtype``."""
     return {
+        **({"kv_cache_dtype": kv_dtype} if kv_dtype else {}),
         "vocab_size": int(cfg.vocab_size),
         "d_model": int(cfg.d_model),
         "num_heads": int(cfg.num_heads),
@@ -53,7 +59,8 @@ def _config_meta(cfg, mode, group_size):
     }
 
 
-def quantize_bundle(src, dst, mode, group_size, hp_dtype_name="bfloat16"):
+def quantize_bundle(src, dst, mode, group_size, hp_dtype_name="bfloat16",
+                    kv_dtype=""):
     """Load ``src``, quantize, write ``dst``. Returns (orig_bytes, new_bytes)
     for the footprint report."""
     import jax.numpy as jnp
@@ -79,7 +86,8 @@ def quantize_bundle(src, dst, mode, group_size, hp_dtype_name="bfloat16"):
     qparams = quantize_lm_params(
         params, mode, group_size=group_size, hp_dtype=hp_dtype)
     metadata = {k: v for k, v in meta.items() if k != "format"}
-    metadata["config"] = _config_meta(cfg, mode, group_size)
+    metadata["config"] = _config_meta(cfg, mode, group_size,
+                                      kv_dtype=kv_dtype)
     metadata["quantized_from"] = os.path.basename(src)
     export_inference_bundle(dst, qparams, metadata=metadata)
     return tree_bytes(params), tree_bytes(qparams)
@@ -102,6 +110,12 @@ def main(argv=None):
         "--hp_dtype", default="bfloat16", choices=("bfloat16", "float32"),
         help="dtype for the high-precision leaves (embeddings/norms/lm_head)")
     parser.add_argument(
+        "--kv_dtype", default="", choices=("", "int8"),
+        help="stamp the KV ACTIVATION format into the bundle metadata: "
+        "'int8' makes serve_lm.py default to quantize-on-write int8 KV "
+        "pages for this bundle (a serving-time mode — no payload change; "
+        "--kv_dtype at serve time still overrides)")
+    parser.add_argument(
         "--draft_model", default="",
         help="optionally also quantize this draft bundle (harder: int4)")
     parser.add_argument("--draft_out", default="",
@@ -113,10 +127,11 @@ def main(argv=None):
 
     gs = args.group_size or (64 if args.mode == "int4" else 0)
     orig, new = quantize_bundle(
-        args.model, args.out, args.mode, gs, args.hp_dtype)
+        args.model, args.out, args.mode, gs, args.hp_dtype,
+        kv_dtype=args.kv_dtype)
     print(f"quantize_lm: {args.model} -> {args.out} mode={args.mode} "
-          f"group_size={gs} bytes {orig} -> {new} "
-          f"({new / max(1, orig):.3f}x)", flush=True)
+          f"group_size={gs} kv_dtype={args.kv_dtype or 'native'} "
+          f"bytes {orig} -> {new} ({new / max(1, orig):.3f}x)", flush=True)
 
     if bool(args.draft_model) != bool(args.draft_out):
         raise SystemExit("--draft_model and --draft_out go together")

@@ -125,6 +125,19 @@ def build_stack(serve_cfg, cfg, params, deploy_cfg=None):
             draft_params = quantize_lm_params(
                 draft_params, "int4", group_size=dgs,
                 hp_dtype=cfg.compute_dtype)
+    # --kv_dtype: the live KV-cache page format. '' keeps whatever the
+    # bundle's model config says (e.g. --kv_cache_dtype below, or a
+    # config that already bakes it in); 'bf16'/'int8' override it — the
+    # same replace() discipline as --quant, so the engine's pool and
+    # every jitted program see one consistent cfg.
+    if hasattr(serve_cfg, "validate_kv"):
+        serve_cfg.validate_kv()
+    kv_override = getattr(serve_cfg, "engine_kv_cache_dtype", "keep")
+    if kv_override != "keep":
+        from dataclasses import replace
+
+        if getattr(cfg, "kv_cache_dtype", None) != kv_override:
+            cfg = replace(cfg, kv_cache_dtype=kv_override)
     # --tp N > 1: the SAME stack on a TP-partitioned model. Validate the
     # mesh against the model BEFORE any engine/jit work so a bad tp fails
     # with the config-level message, and build the sharded engine mode —
@@ -147,6 +160,7 @@ def build_stack(serve_cfg, cfg, params, deploy_cfg=None):
         kv_pages=getattr(serve_cfg, "kv_pages", 0),
         prefix_cache=getattr(serve_cfg, "prefix_cache", True),
         spec_k=getattr(serve_cfg, "spec_k", 0),
+        spec_branches=getattr(serve_cfg, "spec_branches", 1),
         prefill_chunk_tokens=getattr(serve_cfg, "prefill_chunk_tokens", 0),
         draft_params=draft_params,
         draft_cfg=draft_cfg,
@@ -338,7 +352,8 @@ def main(argv=None):
     kv_desc = (
         f"paged(page_size={engine.page_size} pages={engine.pool.num_pages} "
         f"prefix={'on' if engine.prefix is not None else 'off'} "
-        f"spec_k={engine.spec_k} drafter={engine.drafter} "
+        f"spec_k={engine.spec_k} spec_branches={engine.spec_branches} "
+        f"drafter={engine.drafter} kv_dtype={engine.kv_dtype} "
         f"chunk={engine.prefill_chunk_tokens})"
         if engine.paged
         else "monolithic"
