@@ -2134,6 +2134,287 @@ def bench_fleet_elastic() -> list[dict]:
     ]
 
 
+def bench_fleet_chaos() -> list[dict]:
+    """ISSUE 16's acceptance run: the chaos soak. Three CPU replicas
+    behind the real router take three loadgen waves while a scripted
+    ``DTT_FAULT`` storm fires INSIDE two of them, then one replica is
+    SIGKILLed outright:
+
+    * replica 1 boots with ``replica_5xx:6,stream_cut:after=3`` — six
+      injected 503s (router must fail over) plus one mid-stream cut
+      (client must land it in the typed ``stream_aborted`` bucket);
+    * replica 2 boots with ``replica_hang:2,replica_hang:ms=8000`` —
+      two accepted-then-silent connections the router's read watchdog
+      must abandon (feeding the breaker) instead of holding forever;
+    * after the streamed wave, replica 2 is SIGKILLed (no drain) and a
+      buffered wave runs with ``--deadline_ms`` so every request
+      carries a propagated budget through the storm.
+
+    Gates (all hard-asserted in-run, then FLOORS/FRAC_CEILS keep them
+    visible through bench_diff): every request of every wave lands in a
+    typed outcome bucket (``--smoke`` exits nonzero on a silent drop);
+    the storm wave's p99 stays under 3x the post-recovery wave's p99 on
+    the same fleet; every breaker is closed again once the registry
+    settles; and the survivors report ZERO new recompiles across the
+    whole soak — chaos must be absorbed by routing, never by the
+    engines re-tracing."""
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from serve_fleet import launch_fleet
+
+    from distributed_tensorflow_tpu.obs.export import parse_prometheus_text
+    from distributed_tensorflow_tpu.serve.fleet import (
+        FleetRouter,
+        ReplicaRegistry,
+        make_router_server,
+    )
+
+    if SMOKE:
+        shape = ["--vocab_size", "256", "--d_model", "32", "--num_heads",
+                 "4", "--num_layers", "2", "--d_ff", "64", "--seq_len",
+                 "32", "--slots", "2"]
+        load = ["--prompt_len", "6", "--max_new_tokens", "6"]
+        n_stream, n_wave, conc = 18, 20, 3
+        loadgen_timeout = 300
+    else:
+        shape = ["--vocab_size", "512", "--d_model", "256", "--num_heads",
+                 "8", "--num_layers", "4", "--d_ff", "1024", "--seq_len",
+                 "64", "--slots", "4"]
+        load = ["--prompt_len", "12", "--max_new_tokens", "12"]
+        n_stream, n_wave, conc = 24, 32, 4
+        loadgen_timeout = 600
+
+    env_base = dict(os.environ)
+    env_base.pop("XLA_FLAGS", None)
+    env_base.pop("DTT_FAULT", None)  # faults arm per-REPLICA only
+    env_base["JAX_PLATFORMS"] = "cpu"
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+
+    def run_loadgen(target, n, extra):
+        with tempfile.NamedTemporaryFile(mode="r", suffix=".jsonl") as fh:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(tools_dir, "loadgen.py"),
+                 "--targets", target, "--num_requests", str(n),
+                 "--smoke", "--seed", "0", "--timeout_s", "120",
+                 "--report_file", fh.name, *load, *extra],
+                env=env_base, capture_output=True, text=True,
+                timeout=loadgen_timeout,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"loadgen against {target} failed rc={proc.returncode} "
+                    f"(a silent drop fails --smoke): {proc.stderr[-500:]}"
+                )
+            report = json.loads(fh.read().strip().splitlines()[-1])
+            # Typed-outcome accounting must be exact, not just nonzero:
+            # the outcome classes partition the run.
+            outcomes = report["outcomes"]
+            assert sum(outcomes.values()) == report["num_requests"], report
+            assert report["dropped_without_shed"] == 0, report
+            return report
+
+    def replica_recompiles(url) -> float:
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/metrics", timeout=10) as resp:
+            samples = parse_prometheus_text(resp.read().decode())
+        return sum(s["value"] for s in samples
+                   if s["name"] == "recompile_events_total")
+
+    # Counted (not probabilistic) arms: the storm is identical every run
+    # and EXHAUSTS, so the recovery wave measures a genuinely fault-free
+    # fleet. Chaos reaches the replicas via DTT_FAULT alone — no test
+    # hooks, no replica code paths the production binary doesn't have.
+    fault_envs = [
+        None,
+        "replica_5xx:6,stream_cut:after=3",
+        "replica_hang:2,replica_hang:ms=8000",
+    ]
+    replicas = []
+    registry = router_server = None
+    try:
+        for spec in fault_envs:
+            env = dict(env_base)
+            if spec is not None:
+                env["DTT_FAULT"] = spec
+                env["DTT_FAULT_SEED"] = "0"
+            replicas.extend(launch_fleet(1, ["--demo", *shape], env=env))
+
+        registry = ReplicaRegistry(
+            [r.url for r in replicas], up_after=1, down_after=2)
+        router = FleetRouter(
+            registry, max_attempts=3, read_timeout_s=3.0,
+            hedge_after_s=0.0,  # adaptive: p95 of the live window
+            backoff_base_s=0.05, backoff_max_s=0.5)
+        router_server = make_router_server(router, port=0)
+        threading.Thread(
+            target=router_server.serve_forever, daemon=True).start()
+        registry.start(interval_s=0.2)
+        deadline = time.monotonic() + 30
+        while registry.up_count() < 3 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert registry.up_count() == 3, registry.snapshot()
+        host, port = router_server.server_address
+        router_url = f"http://{host}:{port}"
+
+        # Wave 1 (streamed): the injected storm fires — 503 failovers,
+        # one mid-stream cut, two read-watchdog hangs.
+        streamed = run_loadgen(
+            router_url, n_stream,
+            ["--concurrency", str(conc), "--stream"])
+
+        # Recompile baseline AFTER warmup, on the replicas that survive.
+        survivors = replicas[:2]
+        rc_base = [replica_recompiles(r.url) for r in survivors]
+
+        # Hard kill (no drain): the paper-cluster failure the fleet is
+        # supposed to absorb — connect errors until probes + breaker
+        # fence the corpse off.
+        replicas[2].proc.kill()
+
+        storm = run_loadgen(
+            router_url, n_wave,
+            ["--deadline_ms", "60000", "--concurrency", str(conc)])
+
+        # Let the registry settle: the dead replica marked down (its
+        # breaker resets when health takes over) and every surviving
+        # breaker re-closed. A breaker only re-closes through a
+        # SUCCESSFUL half-open trial, and trials ride real requests —
+        # so the settle loop trickles traffic through the router
+        # (same shapes as the waves: no new jit entries).
+        def trickle():
+            payload = json.dumps({
+                "prompt": list(range(1, int(load[1]) + 1)),
+                "max_new_tokens": int(load[3]),
+                "deadline_s": 10.0,
+            }).encode()
+            req = urllib.request.Request(
+                router_url + "/generate", data=payload,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=15) as resp:
+                    resp.read()
+            except Exception:
+                pass  # typed 503s/timeouts are fine; probes do the rest
+
+        deadline = time.monotonic() + 30
+        while ((registry.up_count() != 2 or not registry.breakers_closed())
+               and time.monotonic() < deadline):
+            trickle()
+            time.sleep(0.25)
+
+        # Same flags as the storm wave (budget stamping + concurrency
+        # identical) so the ratio compares faults, not load shapes.
+        recovery = run_loadgen(
+            router_url, n_wave,
+            ["--deadline_ms", "60000", "--concurrency", str(conc)])
+
+        breakers_closed = registry.breakers_closed()
+        assert breakers_closed, registry.snapshot()
+        rc_delta = sum(
+            replica_recompiles(r.url) - base
+            for r, base in zip(survivors, rc_base))
+        assert rc_delta == 0, (
+            f"{rc_delta} recompile(s) on surviving replicas during the "
+            f"chaos soak — chaos must be absorbed by routing, not "
+            f"re-tracing")
+
+        p99_storm = float(storm["latency_ms"]["p99"])
+        p99_rec = float(recovery["latency_ms"]["p99"])
+        # With n_wave samples the p99 is nearly the max draw, so a
+        # single lucky fault-free run would explode the ratio; floor
+        # the baseline at 3x the recovery MEDIAN (a stable stand-in
+        # for "typical fault-free service") to keep the gate about
+        # storm-induced tails, not sampling noise. A hang leaking to
+        # a client (read_timeout_s and up) still busts the ceiling.
+        p50_rec = float(recovery["latency_ms"]["p50"])
+        inflation = p99_storm / max(p99_rec, 3.0 * p50_rec, 1e-9)
+        total_aborted = (streamed["stream_aborted"]
+                         + storm["stream_aborted"]
+                         + recovery["stream_aborted"])
+        fleet = registry.snapshot()
+        shape_note = (
+            f"3 CPU replicas ({shape[3]}d/{shape[7]}L), storm arms "
+            f"[{fault_envs[1]}] + [{fault_envs[2]}] + SIGKILL, waves "
+            f"{n_stream} streamed / {n_wave} deadline / {n_wave} recovery"
+        )
+    finally:
+        if router_server is not None:
+            router_server.shutdown()
+            router_server.server_close()
+        if registry is not None:
+            registry.stop()
+        for replica in replicas:
+            replica.terminate(grace_s=5.0)
+
+    return [
+        {
+            "metric": "fleet_chaos_zero_drops",
+            "value": 1.0,
+            "unit": "bool",
+            "detail": (
+                f"every request of all 3 waves in a typed outcome bucket "
+                f"under {shape_note}; outcome partition == num_requests "
+                f"and --smoke both hard-asserted in-run "
+                f"({total_aborted} typed stream_aborted, storm outcomes "
+                f"{storm['outcomes']}); >= 1.0 ENFORCED (bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "fleet_chaos_breakers_closed",
+            "value": 1.0,
+            "unit": "bool",
+            "detail": (
+                f"all circuit breakers re-closed after the storm settled "
+                f"(open events during the soak are expected and dumped "
+                f"to the flight recorder) under {shape_note}; "
+                f"hard-asserted in-run; >= 1.0 ENFORCED (bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "fleet_chaos_zero_recompiles",
+            "value": 1.0,
+            "unit": "bool",
+            "detail": (
+                f"0 new recompile_events_total on the surviving replicas "
+                f"across the whole soak under {shape_note}; hard-asserted "
+                f"in-run; >= 1.0 ENFORCED (bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "fleet_chaos_p99_inflation",
+            "value": round(p99_storm, 2),
+            "unit": "ms",
+            "frac": round(inflation, 4),
+            "detail": (
+                f"routed p99 latency of the dead-replica storm wave "
+                f"({p99_storm:.1f} ms) over the post-recovery wave "
+                f"(p99 {p99_rec:.1f} ms, p50 {p50_rec:.1f} ms; baseline "
+                f"floored at 3x the median to de-noise the small-sample "
+                f"p99) on the same fleet, {shape_note}; frac is the "
+                f"ratio — the storm may cost failovers and watchdog "
+                f"timeouts but not an unbounded tail; "
+                f"frac <= 3.0 ENFORCED (bench.FRAC_CEILS)"
+            ),
+        },
+        {
+            "metric": "fleet_storm_stream_aborted",
+            "value": float(total_aborted),
+            "unit": "requests",
+            "detail": (
+                f"mid-stream cuts the client landed in the typed "
+                f"stream_aborted bucket (>= 1 token delivered, no done "
+                f"frame) instead of a silent drop, under {shape_note}"
+            ),
+        },
+    ]
+
+
 def bench_hotswap() -> list[dict]:
     """The deploy plane's acceptance run: a live engine adopts a newly
     COMMITTED checkpoint mid-burst with zero dropped requests and zero
@@ -3168,6 +3449,21 @@ FLOORS = {
     "fleet_elastic_zero_drops": 1.0,
     "fleet_elastic_scaleup": 1.0,
     "fleet_handoff_token_parity": 1.0,
+    # The chaos soak's binary acceptance gates (ISSUE 16), reported as
+    # 1.0 only after bench_fleet_chaos hard-asserts them in-run: (a)
+    # under a scripted DTT_FAULT storm (injected 503s, a mid-stream cut,
+    # read-watchdog hangs) plus a SIGKILLed replica, every request of
+    # every wave landed in a typed outcome bucket — the outcome classes
+    # PARTITION each run (sum == num_requests) and --smoke exits nonzero
+    # on any silent drop; (b) every circuit breaker re-closed once the
+    # registry settled — a breaker stuck open after the fault source
+    # died means the half-open probe path broke; (c) the surviving
+    # replicas logged ZERO new recompile events across the soak — chaos
+    # must be absorbed by routing and failover, never by the engines
+    # re-tracing. MISSING (the bench crashed) is a violation too.
+    "fleet_chaos_zero_drops": 1.0,
+    "fleet_chaos_breakers_closed": 1.0,
+    "fleet_chaos_zero_recompiles": 1.0,
     # The deploy plane's two binary acceptance gates, reported as 1.0
     # only after bench_hotswap hard-asserts them in-run: (a) a live
     # engine adopted a newly committed checkpoint mid-burst with zero
@@ -3287,6 +3583,14 @@ FRAC_CEILS = {
     # admission stalled, the router kept dispatching into the booting
     # replica, or scale-up stopped relieving pressure at all.
     "fleet_elastic_ttft_p99_ms": 1.0,
+    # Chaos storm tail bound (frac is a RATIO like serve_intertoken):
+    # the dead-replica storm wave's routed p99 over the post-recovery
+    # wave's on the same fleet. Failovers, breaker fencing and watchdog
+    # timeouts may cost the storm real latency, but a bounded amount —
+    # frac near the ceiling means the router kept dispatching into the
+    # corpse (breaker dead), the watchdog stopped firing (requests
+    # parked on hung sockets), or backoff stopped being budget-aware.
+    "fleet_chaos_p99_inflation": 3.0,
     # Hot-swap stall vs the drain-and-restart alternative: frac = the
     # timed swap's boundary-callback wall time (validate + warm canary +
     # pointer flip, measured with the canary's eager eval pre-warmed as
@@ -3356,6 +3660,12 @@ def main() -> None:
             # (test_bench_fleet_elastic_smoke_meets_gates); the floors
             # bind on full/TPU runs, where it is always in the suite.
             *(() if SMOKE else (bench_fleet_elastic,)),
+            # The chaos soak boots 3 replica subprocesses + 3 loadgen
+            # waves — same budget problem as the elastic bench, same
+            # arrangement: dedicated slow test
+            # (test_bench_fleet_chaos_smoke_meets_gates) covers smoke,
+            # floors bind on full/TPU runs.
+            *(() if SMOKE else (bench_fleet_chaos,)),
             bench_hotswap,
             bench_flash_kernel,
             bench_mnist_real_accuracy,

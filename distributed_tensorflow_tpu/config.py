@@ -800,6 +800,47 @@ class FleetConfig:
         metadata={"help": "scale-down drain window: SIGTERM -> graceful "
                   "drain -> SIGKILL after this many seconds"},
     )
+    # Chaos defenses (PR 16): hedging, circuit breakers, read watchdog.
+    hedge_after_s: float = field(
+        default=-1.0,
+        metadata={"help": "tail-latency hedge delay for buffered "
+                  "dispatches: <0 = disabled, 0 = adaptive (p95 of the "
+                  "router's recent latency window), >0 = fixed seconds"},
+    )
+    read_timeout_s: float = field(
+        default=30.0,
+        metadata={"help": "per-attempt upstream read watchdog: a replica "
+                  "that accepts the connection but never answers is "
+                  "treated as a dispatch failure (feeds its breaker) "
+                  "instead of holding the request forever"},
+    )
+    breaker_window: int = field(
+        default=8,
+        metadata={"help": "dispatch outcomes per replica scored for the "
+                  "circuit breaker (sliding window)"},
+    )
+    breaker_fail_threshold: float = field(
+        default=0.5,
+        metadata={"help": "failure fraction over the window that trips a "
+                  "replica's breaker open"},
+    )
+    breaker_min_samples: int = field(
+        default=4,
+        metadata={"help": "minimum outcomes in the window before the "
+                  "breaker may trip (single blips never open it)"},
+    )
+    breaker_open_s: float = field(
+        default=2.0,
+        metadata={"help": "seconds a tripped breaker stays open before "
+                  "admitting one half-open trial dispatch"},
+    )
+    router_obs_dir: str = field(
+        default="",
+        metadata={"help": "router-side observability dir: breaker-open "
+                  "flight-recorder dumps + the end-of-run "
+                  "fleet_storm_summary.json land here (distinct from "
+                  "--obs_dir, which is forwarded to every replica)"},
+    )
 
 
 @dataclass

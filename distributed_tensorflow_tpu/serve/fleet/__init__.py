@@ -27,6 +27,7 @@ from distributed_tensorflow_tpu.serve.fleet.handoff import (
     encode_bundle,
 )
 from distributed_tensorflow_tpu.serve.fleet.registry import (
+    CircuitBreaker,
     ProbeResult,
     Replica,
     ReplicaRegistry,
@@ -37,6 +38,7 @@ from distributed_tensorflow_tpu.serve.fleet.router import (
 )
 
 __all__ = [
+    "CircuitBreaker",
     "ProbeResult",
     "Replica",
     "ReplicaRegistry",

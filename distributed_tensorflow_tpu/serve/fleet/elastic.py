@@ -40,6 +40,8 @@ from __future__ import annotations
 import threading
 import time
 
+from distributed_tensorflow_tpu.utils import faults
+
 __all__ = ["FleetSupervisor"]
 
 
@@ -144,6 +146,10 @@ class FleetSupervisor:
         """Spawn + register one replica; returns the member or None on
         spawn failure (the policy loop simply tries again next tick)."""
         try:
+            # ``spawn_fail`` chaos site: a boot that dies before the
+            # replica exists (OOM at exec, image pull failure) — the
+            # policy loop must absorb it and try again next tick.
+            faults.maybe_fail("spawn_fail", role)
             handle = self.spawn(role)
         except Exception:  # noqa: BLE001 — a failed boot is not fatal
             return None

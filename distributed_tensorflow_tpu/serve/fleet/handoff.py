@@ -50,11 +50,15 @@ from __future__ import annotations
 import http.client
 import json
 import queue
+import random
 import struct
 import threading
 import urllib.parse
 
 import numpy as np
+
+from distributed_tensorflow_tpu.utils import faults
+from distributed_tensorflow_tpu.utils.retry import next_delay
 
 __all__ = [
     "encode_bundle",
@@ -199,6 +203,7 @@ class HandoffOutbox:
         self.read_timeout_s = float(read_timeout_s)
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
+        self._rng = random.Random(0)
         self._threads = [
             threading.Thread(
                 target=self._run, name=f"handoff-outbox-{i}", daemon=True
@@ -250,6 +255,15 @@ class HandoffOutbox:
                 except Exception:  # noqa: BLE001
                     pass
 
+    def _backoff(self, attempts: int) -> None:
+        """Jittered exponential backoff between peer attempts (shares the
+        ``utils.retry`` curve the router's failover loop uses, replacing
+        the old linear ``backoff_s * attempts``)."""
+        self._stop.wait(next_delay(
+            attempts, base_delay=self.backoff_s,
+            max_delay=max(self.backoff_s * 8, self.backoff_s),
+            jitter=0.25, rng=self._rng))
+
     def _push(self, payload: bytes, request_id: str, cb) -> None:
         last = "no decode peer configured"
         attempts = 0
@@ -257,20 +271,29 @@ class HandoffOutbox:
             if attempts >= self.max_attempts:
                 break
             attempts += 1
+            body = payload
+            if faults.fire("handoff_corrupt"):
+                # Bit-flip inside the DTFH1 magic: the peer's
+                # decode_bundle must reject the bundle as a typed 400 —
+                # garbage pages never get imported.
+                corrupt = bytearray(body)
+                corrupt[2] ^= 0xFF
+                body = bytes(corrupt)
             parsed = urllib.parse.urlsplit(peer)
             conn = http.client.HTTPConnection(
                 parsed.hostname, parsed.port,
                 timeout=self.connect_timeout_s)
             try:
+                faults.maybe_fail("handoff_send_timeout", peer)
                 conn.request(
-                    "POST", "/handoff", body=payload,
+                    "POST", "/handoff", body=body,
                     headers={"Content-Type": "application/octet-stream"})
                 conn.sock.settimeout(self.read_timeout_s)
                 resp = conn.getresponse()
                 if resp.status != 200:
                     last = (f"{peer}: HTTP {resp.status} "
                             f"{resp.read(256)[:256]!r}")
-                    self._stop.wait(self.backoff_s * attempts)
+                    self._backoff(attempts)
                     continue
                 ctype = resp.getheader("Content-Type", "")
                 if not ctype.startswith("text/event-stream"):
@@ -303,7 +326,7 @@ class HandoffOutbox:
                 last = f"{peer}: empty stream before accept"
             except (OSError, http.client.HTTPException) as exc:
                 last = f"{peer}: {exc!r}"
-                self._stop.wait(self.backoff_s * attempts)
+                self._backoff(attempts)
             finally:
                 conn.close()
         cb.on_failed(last, False)

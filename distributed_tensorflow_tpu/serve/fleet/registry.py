@@ -47,11 +47,14 @@ import threading
 import time
 import urllib.error
 import urllib.request
+from collections import deque
 from dataclasses import dataclass, field
 
 from distributed_tensorflow_tpu.obs.export import parse_prometheus_text
+from distributed_tensorflow_tpu.obs.recorder import dump_to_dir, get_recorder
+from distributed_tensorflow_tpu.utils import faults
 
-__all__ = ["ProbeResult", "Replica", "ReplicaRegistry"]
+__all__ = ["CircuitBreaker", "ProbeResult", "Replica", "ReplicaRegistry"]
 
 _STATE_VALUE = {"down": 0.0, "draining": 1.0, "up": 2.0}
 
@@ -90,6 +93,83 @@ class ProbeResult:
     detail: str = ""
 
 
+class CircuitBreaker:
+    """Per-replica breaker over DISPATCH outcomes, deliberately distinct
+    from the probe FSM: health probes say "the process answers /healthz",
+    the breaker says "real traffic is coming back wrong" — a slow-but-200
+    replica (stuck socket, hung engine) passes every probe and still trips
+    the breaker once its dispatches time out or 5xx.
+
+    States: closed (dispatch freely, track a sliding outcome window) →
+    open (no dispatches for ``open_s``) → half_open (admit up to
+    ``half_open_max`` trial dispatches; one success closes, one failure
+    re-opens). All methods assume the owning registry's lock is held."""
+
+    def __init__(self, *, window: int = 8, fail_threshold: float = 0.5,
+                 min_samples: int = 4, open_s: float = 2.0,
+                 half_open_max: int = 1):
+        if not 0.0 < fail_threshold <= 1.0:
+            raise ValueError("fail_threshold must be in (0, 1]")
+        self.state = "closed"
+        self.fail_threshold = fail_threshold
+        self.min_samples = max(1, int(min_samples))
+        self.open_s = open_s
+        self.half_open_max = max(1, int(half_open_max))
+        self.opened_at = 0.0
+        self.trials = 0          # half-open dispatches in flight
+        self.open_total = 0      # lifetime closed/half_open -> open trips
+        self._window: deque[bool] = deque(maxlen=max(1, int(window)))
+
+    def admissible(self, now: float) -> bool:
+        """Would a dispatch be allowed right now? Read-only (``pick`` asks
+        for every candidate; only the chosen one books via ``on_pick``)."""
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            return now - self.opened_at >= self.open_s
+        return self.trials < self.half_open_max
+
+    def on_pick(self, now: float) -> None:
+        """The registry chose this replica: perform the open→half_open
+        cooldown transition and book the trial slot."""
+        if self.state == "open" and now - self.opened_at >= self.open_s:
+            self.state = "half_open"
+            self.trials = 0
+        if self.state == "half_open":
+            self.trials += 1
+
+    def record(self, ok: bool, now: float) -> None:
+        """One dispatch outcome (2xx/4xx = ok; transport error, timeout or
+        5xx = failure)."""
+        if self.state == "half_open":
+            self.trials = max(0, self.trials - 1)
+            if ok:
+                self.state = "closed"
+                self._window.clear()
+            else:
+                self.state = "open"
+                self.opened_at = now
+                self.open_total += 1
+            return
+        if self.state == "open":
+            return  # stragglers from before the trip carry no new signal
+        self._window.append(ok)
+        if len(self._window) >= self.min_samples:
+            failures = sum(1 for v in self._window if not v)
+            if failures / len(self._window) >= self.fail_threshold:
+                self.state = "open"
+                self.opened_at = now
+                self.open_total += 1
+                self._window.clear()
+
+    def reset(self) -> None:
+        """Probe FSM took the replica down: health state owns it now; the
+        breaker restarts clean when the replica returns."""
+        self.state = "closed"
+        self.trials = 0
+        self._window.clear()
+
+
 @dataclass
 class Replica:
     """Router-side view of one serving process. Mutable fields are
@@ -105,6 +185,7 @@ class Replica:
     last: ProbeResult = field(default_factory=lambda: ProbeResult(ok=False))
     dispatched_total: int = 0
     error_total: int = 0
+    breaker: CircuitBreaker = field(default_factory=CircuitBreaker)
 
     def load_score(self) -> float:
         return (self.inflight + self.last.queue_depth
@@ -189,12 +270,19 @@ class ReplicaRegistry:
         up_after: int = 2,
         down_after: int = 2,
         probe_timeout_s: float = 2.0,
+        breaker_window: int = 8,
+        breaker_fail_threshold: float = 0.5,
+        breaker_min_samples: int = 4,
+        breaker_open_s: float = 2.0,
         clock=time.monotonic,
     ):
         if up_after < 1 or down_after < 1:
             raise ValueError("up_after/down_after must be >= 1")
         self.up_after = int(up_after)
         self.down_after = int(down_after)
+        self._breaker_kw = dict(
+            window=breaker_window, fail_threshold=breaker_fail_threshold,
+            min_samples=breaker_min_samples, open_s=breaker_open_s)
         self.clock = clock
         self._probe = probe or (
             lambda url: http_probe(url, timeout_s=probe_timeout_s))
@@ -234,6 +322,14 @@ class ReplicaRegistry:
         self._c_probe_fail = r.counter(
             "fleet_probe_failures_total",
             "Probes that did not reach /healthz.", labels=("replica",))
+        self._g_breaker = r.gauge(
+            "fleet_breaker_state",
+            "Dispatch circuit breaker: 0 closed, 1 open, 2 half_open.",
+            labels=("replica",))
+        self._c_breaker_open = r.counter(
+            "fleet_breaker_open_total",
+            "Breaker trips (closed/half_open -> open) per replica.",
+            labels=("replica",))
         for url in targets:
             self.add(url)
 
@@ -245,7 +341,9 @@ class ReplicaRegistry:
         with self._lock:
             if rid in self._replicas:
                 raise ValueError(f"duplicate replica id {rid!r}")
-            replica = Replica(replica_id=rid, base_url=base_url)
+            replica = Replica(
+                replica_id=rid, base_url=base_url,
+                breaker=CircuitBreaker(**self._breaker_kw))
             self._replicas[rid] = replica
         return replica
 
@@ -281,6 +379,11 @@ class ReplicaRegistry:
                     or replica.state == "draining"):
                 # A draining replica that stops answering is simply gone —
                 # no hysteresis on the way out of a shutdown.
+                if replica.state != "down":
+                    # Health state takes over: a down replica gets no
+                    # dispatches anyway, and when it returns the breaker
+                    # should not remember pre-death traffic.
+                    replica.breaker.reset()
                 replica.state = "down"
             return
         replica.fail_streak = 0
@@ -293,12 +396,25 @@ class ReplicaRegistry:
         if replica.state != "up" and replica.ok_streak >= self.up_after:
             replica.state = "up"
 
+    def _probe_with_faults(self, url: str) -> ProbeResult:
+        """Probe wrapper carrying the chaos sites: ``probe_slow:ms=D``
+        stalls the probe (cheap stand-in for a congested health path) and
+        ``probe_flap`` reports a live replica as unreachable — exactly the
+        evidence a lossy network would feed the hysteresis FSM."""
+        stall = faults.delay_s("probe_slow")
+        if stall:
+            time.sleep(stall)
+        if faults.fire("probe_flap"):
+            return ProbeResult(ok=False, detail="injected probe_flap")
+        return self._probe(url)
+
     def probe_once(self) -> None:
         """Probe every replica once and refresh the fleet gauges. Probes
         run outside the lock (they do I/O); state updates inside."""
         with self._lock:
             targets = [(r, r.base_url) for r in self._replicas.values()]
-        results = [(replica, self._probe(url)) for replica, url in targets]
+        results = [(replica, self._probe_with_faults(url))
+                   for replica, url in targets]
         with self._lock:
             for replica, result in results:
                 self._apply_probe(replica, result)
@@ -325,8 +441,37 @@ class ReplicaRegistry:
             replica.ok_streak = 0
             replica.fail_streak += 1
             if replica.fail_streak >= self.down_after:
+                if replica.state != "down":
+                    replica.breaker.reset()
                 replica.state = "down"
             self._update_gauges_locked()
+
+    def note_result(self, replica: Replica, ok: bool) -> None:
+        """Feed one dispatch outcome to the replica's circuit breaker
+        (router calls this for every attempt: 2xx/4xx ok, transport error /
+        read timeout / 5xx not). On a trip to open, the flight recorder
+        dumps — a breaker opening is exactly the moment the recent event
+        ring is worth keeping."""
+        with self._lock:
+            before = replica.breaker.state
+            replica.breaker.record(ok, self.clock())
+            after = replica.breaker.state
+            if after == "open" and before != "open":
+                self._c_breaker_open.labels(
+                    replica=replica.replica_id).inc()
+            self._update_gauges_locked()
+        if after == "open" and before != "open":
+            get_recorder().record(
+                kind="breaker_open", replica=replica.replica_id,
+                prior_state=before, open_total=replica.breaker.open_total)
+            dump_to_dir(f"breaker_open_{replica.replica_id}")
+
+    def breakers_closed(self) -> bool:
+        """True when every replica's breaker is closed (the post-storm
+        recovery gate)."""
+        with self._lock:
+            return all(r.breaker.state == "closed"
+                       for r in self._replicas.values())
 
     def note_backoff(self, replica: Replica, seconds: float) -> None:
         """Honor a Retry-After: no dispatches to this replica until the
@@ -348,13 +493,16 @@ class ReplicaRegistry:
         exists). Both are preferences, not hard filters: if no UP replica
         matches, fall back to least-loaded overall (a mismatched replica
         still serves correctly — degraded routing beats a 503 while the
-        fleet reshapes)."""
+        fleet reshapes). The circuit breaker is a HARD filter, unlike the
+        preferences: an open breaker means recent real traffic failed
+        there, and the whole point is not sending more."""
         now = self.clock()
         with self._lock:
             candidates = [
                 r for r in self._replicas.values()
                 if r.state == "up" and r.replica_id not in exclude
                 and r.backoff_until <= now
+                and r.breaker.admissible(now)
             ]
             if not candidates:
                 return None
@@ -368,8 +516,19 @@ class ReplicaRegistry:
                             or variant == r.last.serving_variant]
                 if carrying:
                     candidates = carrying
-            return min(candidates, key=lambda r: (r.load_score(),
-                                                  r.replica_id))
+            # A cooled-open (or half-open-with-a-free-slot) breaker NEEDS
+            # its trial to ride a request — on a lightly loaded fleet the
+            # least-loaded tie-break would otherwise never send one and
+            # the breaker would stay open forever. At most one request
+            # per open_s window takes the risk (half_open_max books it).
+            trial_due = [r for r in candidates
+                         if r.breaker.state in ("open", "half_open")]
+            if trial_due:
+                candidates = trial_due
+            chosen = min(candidates, key=lambda r: (r.load_score(),
+                                                    r.replica_id))
+            chosen.breaker.on_pick(now)
+            return chosen
 
     def tier_urls(self, role: str) -> list[str]:
         """Base URLs of UP replicas advertising ``role`` — the handoff
@@ -389,9 +548,12 @@ class ReplicaRegistry:
         up = 0
         demand = 0.0
         capacity = 0
+        breaker_value = {"closed": 0.0, "open": 1.0, "half_open": 2.0}
         for r in self._replicas.values():
             rid = r.replica_id
             self._g_state.labels(replica=rid).set(_STATE_VALUE[r.state])
+            self._g_breaker.labels(replica=rid).set(
+                breaker_value[r.breaker.state])
             self._g_occupancy.labels(replica=rid).set(r.last.occupancy)
             self._g_queue.labels(replica=rid).set(float(r.last.queue_depth))
             self._g_inflight.labels(replica=rid).set(float(r.inflight))
@@ -447,6 +609,8 @@ class ReplicaRegistry:
                         "shed_total": r.last.shed_total,
                         "dispatched_total": r.dispatched_total,
                         "error_total": r.error_total,
+                        "breaker": r.breaker.state,
+                        "breaker_open_total": r.breaker.open_total,
                         "backoff_s": max(0.0,
                                          r.backoff_until - self.clock()),
                         "draining": r.state == "draining",

@@ -1,5 +1,5 @@
-"""Fleet router: health-aware dispatch with bounded failover and an
-unbuffered streaming proxy.
+"""Fleet router: health-aware dispatch with bounded failover, deadline
+propagation, tail-latency hedging, and an unbuffered streaming proxy.
 
 The router is deliberately model-free — it owns sockets and counters,
 never tensors — so one router instance fronts any number of replicas
@@ -9,17 +9,22 @@ exactly this: coordination on a device-less process).
 Dispatch contract per request:
 
 1. ``pick()`` the least-loaded UP replica, excluding ones already tried
-   for THIS request and ones inside a Retry-After backoff window.
+   for THIS request, ones inside a Retry-After backoff window, and ones
+   whose circuit breaker is open (recent real traffic failed there).
 2. Proxy the request. Three outcomes:
 
    * **forwarded** — the replica answered with a non-retryable status
      (200, 400, …): relay status/body verbatim, tagged with
-     ``X-Replica`` / ``X-Attempts`` so loadgen can attribute.
-   * **retryable** — connect/transport error before any response, or a
-     429/503 answer: count a failover, honor any ``Retry-After`` by
-     backing the replica off, and try a DIFFERENT replica, up to
-     ``max_attempts`` total. Transport errors also feed the registry's
-     failure streak (traffic is a probe that costs nothing extra).
+     ``X-Replica`` / ``X-Attempts`` / ``X-Attempt-Trail`` so loadgen
+     can attribute every hop.
+   * **retryable** — connect/transport error before any response, a
+     read timeout (the hang watchdog: a stuck socket becomes breaker
+     evidence, not an inflight leak), or a 429/503 answer: count a
+     failover, honor any ``Retry-After`` by backing the replica off,
+     and try a DIFFERENT replica, up to ``max_attempts`` total, with
+     budget-aware backoff between attempts (``utils.retry``).
+     Transport errors also feed the registry's failure streak and the
+     breaker (traffic is a probe that costs nothing extra).
    * **aborted** — the replica died MID-STREAM after bytes already
      reached the client. Never retried: generation is non-idempotent
      (a different replica would re-sample a different continuation and
@@ -29,7 +34,24 @@ Dispatch contract per request:
 
 3. Budget exhausted → relay the LAST retryable answer (its Retry-After
    included) or a synthesized 503 ``no_upstream`` when nothing was
-   reachable; either way ``fleet_shed_total`` counts a routed shed.
+   reachable; either way ``fleet_shed_total`` counts a routed shed and
+   ``X-Attempt-Trail`` preserves per-attempt attribution.
+
+Deadline propagation: a request carrying ``deadline_s`` gets a
+:class:`~distributed_tensorflow_tpu.utils.retry.Budget` at the router
+edge. Every upstream hop is stamped with ``X-Budget-Ms`` (remaining
+milliseconds — the replica admission queue sheds against it), the
+upstream read timeout is capped at the remaining budget, and an expired
+budget answers a typed 503 ``deadline`` instead of burning failover
+attempts the request cannot finish.
+
+Hedging (non-streamed only — a hedged stream would race two
+non-idempotent generations at the client): when the primary attempt has
+not answered after a p95-derived delay (or a fixed ``hedge_after_s``),
+ONE speculative attempt launches on a different replica; the first
+non-retryable answer wins and the loser's socket is closed without
+feeding error streaks. ``fleet_hedge_total{outcome}`` counts
+launched / winner_primary / winner_hedge.
 
 Streaming is proxied unbuffered: each ``read1()`` chunk from the replica
 is written + flushed to the client immediately, so the router adds no
@@ -43,12 +65,19 @@ from __future__ import annotations
 
 import http.client
 import json
+import math
+import queue
+import random
+import threading
 import time
 import urllib.parse
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from distributed_tensorflow_tpu.obs import export as obs_export
 from distributed_tensorflow_tpu.serve.deploy.variants import variant_lane
+from distributed_tensorflow_tpu.utils import faults
+from distributed_tensorflow_tpu.utils.retry import Budget, next_delay
 
 __all__ = ["FleetRouter", "make_router_server"]
 
@@ -68,10 +97,35 @@ class _Forwarded(Exception):
     """Internal flow control: the client has its answer."""
 
 
+class _Attempt:
+    """One in-flight buffered upstream attempt (hedging needs a handle to
+    cancel the loser without feeding its error streaks)."""
+
+    def __init__(self, replica):
+        self.replica = replica
+        self.conn = None
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        conn = self.conn
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — best-effort socket teardown
+                pass
+
+
 class FleetRouter:
     """Dispatch + proxy over a :class:`ReplicaRegistry`. Stateless per
     request apart from the registry's inflight accounting; safe to call
-    from many HTTP handler threads at once."""
+    from many HTTP handler threads at once.
+
+    ``hedge_after_s``: None disables hedging; > 0 hedges after that fixed
+    delay; 0.0 derives the delay from the router's own p95 full-response
+    latency (needs >= 8 observed completions, floored at ``hedge_min_s``).
+    ``backoff_base_s``: base of the budget-aware exponential backoff slept
+    between failover attempts (0 keeps the historical immediate retry)."""
 
     def __init__(
         self,
@@ -80,6 +134,10 @@ class FleetRouter:
         max_attempts: int = 3,
         connect_timeout_s: float = 2.0,
         read_timeout_s: float = 120.0,
+        hedge_after_s: float | None = None,
+        hedge_min_s: float = 0.05,
+        backoff_base_s: float = 0.0,
+        backoff_max_s: float = 1.0,
         clock=time.monotonic,
     ):
         if max_attempts < 1:
@@ -88,7 +146,14 @@ class FleetRouter:
         self.max_attempts = int(max_attempts)
         self.connect_timeout_s = connect_timeout_s
         self.read_timeout_s = read_timeout_s
+        self.hedge_after_s = hedge_after_s
+        self.hedge_min_s = hedge_min_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
         self.clock = clock
+        self._rng = random.Random(0)
+        self._lat_lock = threading.Lock()
+        self._lat_window: deque[float] = deque(maxlen=64)
         r = registry.metrics_registry
         self._c_dispatch = r.counter(
             "fleet_dispatch_total", "Requests sent to a replica.",
@@ -98,11 +163,20 @@ class FleetRouter:
             "Dispatch attempts retried on a different replica.")
         self._c_shed = r.counter(
             "fleet_shed_total",
-            "Requests the router answered 503 for (budget exhausted or "
-            "no up replica).")
+            "Requests the router answered 503 for (budget exhausted, "
+            "deadline expired, or no up replica).")
         self._c_stream_abort = r.counter(
             "fleet_stream_aborted_total",
             "Streams cut after bytes reached the client (never retried).")
+        self._c_hedge = r.counter(
+            "fleet_hedge_total",
+            "Hedged dispatches by outcome "
+            "(launched / winner_primary / winner_hedge).",
+            labels=("outcome",))
+        self._c_deadline = r.counter(
+            "fleet_deadline_shed_total",
+            "Requests answered a typed deadline 503 at the router "
+            "(budget expired before/without an upstream answer).")
         self._h_ttft = r.histogram(
             "fleet_ttft_seconds",
             "Router-observed time to first token.")
@@ -112,23 +186,50 @@ class FleetRouter:
 
     # -- attempt mechanics -------------------------------------------------
 
-    def _open(self, replica, body: bytes):
+    def _open(self, replica, body: bytes, budget: Budget | None = None):
         """One upstream POST /generate. Returns (conn, resp); raises
-        OSError-family on transport failure before a response exists."""
+        OSError-family on transport failure before a response exists.
+        Stamps ``X-Budget-Ms`` and caps the read timeout at the remaining
+        budget — a hop never waits longer than the request can use."""
+        faults.maybe_fail("route_dispatch", replica.replica_id)
+        headers = {"Content-Type": "application/json"}
+        read_timeout = self.read_timeout_s
+        if budget is not None:
+            remaining = budget.remaining()
+            if math.isfinite(remaining):
+                headers["X-Budget-Ms"] = str(max(0, int(remaining * 1000)))
+                read_timeout = min(read_timeout, max(remaining, 0.05))
         parsed = urllib.parse.urlsplit(replica.base_url)
         conn = http.client.HTTPConnection(
             parsed.hostname, parsed.port, timeout=self.connect_timeout_s)
         try:
-            conn.request("POST", "/generate", body=body,
-                         headers={"Content-Type": "application/json"})
-            conn.sock.settimeout(self.read_timeout_s)
+            conn.request("POST", "/generate", body=body, headers=headers)
+            conn.sock.settimeout(read_timeout)
             return conn, conn.getresponse()
         except Exception:
             conn.close()
             raise
 
+    def _note_latency(self, seconds: float) -> None:
+        self._h_latency.observe(seconds)
+        with self._lat_lock:
+            self._lat_window.append(seconds)
+
+    def _hedge_delay(self) -> float | None:
+        """Seconds to wait before hedging, None = don't hedge."""
+        if self.hedge_after_s is None or self.hedge_after_s < 0:
+            return None
+        if self.hedge_after_s > 0:
+            return self.hedge_after_s
+        with self._lat_lock:
+            lats = sorted(self._lat_window)
+        if len(lats) < 8:
+            return None  # not warm enough to know what "slow" means
+        p95 = lats[min(len(lats) - 1, int(0.95 * len(lats)))]
+        return max(self.hedge_min_s, p95)
+
     def _relay(self, handler, replica, attempt: int, resp,
-               started_at: float, streaming: bool) -> None:
+               started_at: float, streaming: bool, trail: list) -> None:
         """Forward a non-retryable upstream response to the client.
         Raises _Forwarded when the client has been fully answered; lets
         transport exceptions escape BEFORE the first forwarded byte so
@@ -138,6 +239,7 @@ class FleetRouter:
         is_stream = streaming and ctype.startswith("text/event-stream")
         if not is_stream:
             data = resp.read()  # may raise -> still retryable, 0 bytes sent
+            trail.append(f"{replica.replica_id}:{resp.status}")
             handler.send_response(resp.status)
             for name in _HOP_HEADERS:
                 value = resp.getheader(name)
@@ -146,10 +248,11 @@ class FleetRouter:
             handler.send_header("Content-Length", str(len(data)))
             handler.send_header("X-Replica", replica.replica_id)
             handler.send_header("X-Attempts", str(attempt + 1))
+            handler.send_header("X-Attempt-Trail", ",".join(trail))
             handler.end_headers()
             handler.wfile.write(data)
             if resp.status == 200:
-                self._h_latency.observe(self.clock() - started_at)
+                self._note_latency(self.clock() - started_at)
                 try:
                     ttft_ms = json.loads(data).get("ttft_ms")
                     if ttft_ms is not None:
@@ -165,6 +268,7 @@ class FleetRouter:
         if not first:
             raise ConnectionError(
                 f"{replica.replica_id}: empty stream before first token")
+        trail.append(f"{replica.replica_id}:stream")
         handler.send_response(200)
         handler.send_header("Content-Type", ctype)
         handler.send_header("Cache-Control", "no-cache")
@@ -173,6 +277,7 @@ class FleetRouter:
             handler.send_header("X-Variant", variant)
         handler.send_header("X-Replica", replica.replica_id)
         handler.send_header("X-Attempts", str(attempt + 1))
+        handler.send_header("X-Attempt-Trail", ",".join(trail))
         handler.end_headers()
         handler.wfile.write(first)
         handler.wfile.flush()
@@ -189,13 +294,15 @@ class FleetRouter:
             # count, and make sure nobody upstack retries (non-idempotent).
             self._c_stream_abort.inc()
             self.registry.note_error(replica)
+            self.registry.note_result(replica, False)
             try:
                 handler.wfile.flush()
             except OSError:
                 pass
             handler.close_connection = True
             raise _Forwarded()
-        self._h_latency.observe(self.clock() - started_at)
+        self._note_latency(self.clock() - started_at)
+        self.registry.note_result(replica, True)
         raise _Forwarded()
 
     @staticmethod
@@ -207,6 +314,20 @@ class FleetRouter:
             return float(value)
         except ValueError:
             return None
+
+    def _backoff_or_none(self, attempt: int, budget: Budget) -> float | None:
+        """Budget-aware failover backoff: the delay to sleep before retry
+        ``attempt`` (1-based), or None when the remaining budget can't fit
+        it plus a minimal attempt — the deadline-aware retry contract from
+        ``utils.retry`` applied to the dispatch loop."""
+        if self.backoff_base_s <= 0:
+            return 0.0
+        delay = next_delay(attempt, base_delay=self.backoff_base_s,
+                           max_delay=self.backoff_max_s, jitter=0.25,
+                           rng=self._rng)
+        if budget.remaining() < delay + self.connect_timeout_s:
+            return None
+        return delay
 
     # -- variant routing ----------------------------------------------------
 
@@ -228,23 +349,80 @@ class FleetRouter:
             return canary
         return None
 
+    # -- terminal answers ---------------------------------------------------
+
+    def _answer_deadline(self, handler, trail: list, tried_n: int) -> None:
+        self._c_shed.inc()
+        self._c_deadline.inc()
+        data = json.dumps({
+            "error": "deadline",
+            "detail": "request budget expired at the router",
+        }).encode()
+        handler.send_response(503)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.send_header("X-Attempts", str(tried_n))
+        handler.send_header("X-Attempt-Trail", ",".join(trail))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    def _answer_exhausted(self, handler, last_error, trail: list,
+                          tried_n: int) -> None:
+        self._c_shed.inc()
+        if last_error is not None:
+            status, data, retry_after = last_error
+        else:
+            status, data, retry_after = 503, json.dumps({
+                "error": "no_upstream",
+                "detail": "no healthy replica available",
+            }).encode(), None
+        handler.send_response(status)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.send_header("Retry-After",
+                            str(max(1, int(retry_after or 1))))
+        handler.send_header("X-Attempts", str(tried_n))
+        handler.send_header("X-Attempt-Trail", ",".join(trail))
+        handler.end_headers()
+        handler.wfile.write(data)
+
     # -- the dispatch loop -------------------------------------------------
 
     def dispatch(self, handler, body: bytes, *, streaming: bool,
-                 variant: str | None = None) -> None:
+                 variant: str | None = None,
+                 deadline_s: float | None = None) -> None:
         """Route one /generate to the fleet; always answers the client.
         ``variant`` biases ``pick`` toward replicas advertising that
-        variant (explicit client pin or the fleet canary resolve)."""
-        started_at = self.clock()
-        tried: set[str] = set()
-        last_error = None  # (status, body_bytes, retry_after | None)
-        # Disaggregated tiers: when a prefill tier exists, NEW requests go
-        # to it (prefill or mixed replicas) — decode replicas take their
-        # work as /handoff imports, not fresh prompts. pick() treats the
-        # role set as a preference, so a tier-less fleet is unchanged.
+        variant (explicit client pin or the fleet canary resolve);
+        ``deadline_s`` starts the request's end-to-end budget."""
+        budget = Budget(deadline_s, clock=self.clock)
         roles = (("prefill", "mixed")
                  if self.registry.has_tier("prefill") else None)
+        if streaming:
+            self._dispatch_streaming(handler, body, budget=budget,
+                                     variant=variant, roles=roles)
+        else:
+            self._dispatch_buffered(handler, body, budget=budget,
+                                    variant=variant, roles=roles)
+
+    def _dispatch_streaming(self, handler, body: bytes, *, budget: Budget,
+                            variant, roles) -> None:
+        """Sequential failover for streamed requests (hedging a stream
+        would race two non-idempotent generations at the client)."""
+        started_at = self.clock()
+        tried: set[str] = set()
+        trail: list[str] = []
+        last_error = None  # (status, body_bytes, retry_after | None)
         for attempt in range(self.max_attempts):
+            if budget.expired():
+                self._answer_deadline(handler, trail, len(tried))
+                return
+            if attempt > 0:
+                delay = self._backoff_or_none(attempt, budget)
+                if delay is None:
+                    break  # budget can't fit backoff + another attempt
+                if delay > 0:
+                    time.sleep(delay)
             replica = self.registry.pick(exclude=tried, variant=variant,
                                          roles=roles)
             if replica is None:
@@ -257,9 +435,11 @@ class FleetRouter:
             conn = None
             try:
                 try:
-                    conn, resp = self._open(replica, body)
+                    conn, resp = self._open(replica, body, budget)
                 except (OSError, http.client.HTTPException) as exc:
                     self.registry.note_error(replica)
+                    self.registry.note_result(replica, False)
+                    trail.append(f"{replica.replica_id}:connect_error")
                     last_error = (
                         503,
                         json.dumps({"error": "upstream_unreachable",
@@ -272,14 +452,18 @@ class FleetRouter:
                     retry_after = self._retry_after_s(resp)
                     if retry_after is not None:
                         self.registry.note_backoff(replica, retry_after)
+                    self.registry.note_result(replica, resp.status < 500)
+                    trail.append(f"{replica.replica_id}:{resp.status}")
                     last_error = (resp.status, resp.read(), retry_after)
                     continue
                 try:
                     self._relay(handler, replica, attempt, resp,
-                                started_at, streaming)
+                                started_at, True, trail)
                 except (OSError, http.client.HTTPException) as exc:
                     # Died before any byte reached the client: retryable.
                     self.registry.note_error(replica)
+                    self.registry.note_result(replica, False)
+                    trail.append(f"{replica.replica_id}:upstream_died")
                     last_error = (
                         503,
                         json.dumps({"error": "upstream_died",
@@ -294,23 +478,208 @@ class FleetRouter:
                 self.registry.note_done(replica)
                 if conn is not None:
                     conn.close()
-        # Budget exhausted or no pickable replica.
-        self._c_shed.inc()
-        if last_error is not None:
-            status, data, retry_after = last_error
-        else:
-            status, data, retry_after = 503, json.dumps({
-                "error": "no_upstream",
-                "detail": "no healthy replica available",
-            }).encode(), None
-        handler.send_response(status)
-        handler.send_header("Content-Type", "application/json")
+        if budget.expired() and last_error is None:
+            self._answer_deadline(handler, trail, len(tried))
+            return
+        self._answer_exhausted(handler, last_error, trail, len(tried))
+
+    def _buffered_attempt(self, attempt: _Attempt, body: bytes,
+                          budget: Budget) -> dict:
+        """One fully-buffered upstream attempt; never raises. Outcome
+        kinds: answered (non-retryable status), retryable (429/503),
+        transport (connect/read failure or timeout — the hang watchdog),
+        cancelled (a hedge race loser; feeds no streaks)."""
+        replica = attempt.replica
+        try:
+            conn, resp = self._open(replica, body, budget)
+        except (OSError, http.client.HTTPException) as exc:
+            if attempt.cancelled:
+                return {"kind": "cancelled"}
+            self.registry.note_error(replica)
+            self.registry.note_result(replica, False)
+            return {"kind": "transport", "tag": "connect_error",
+                    "error": (503, json.dumps(
+                        {"error": "upstream_unreachable",
+                         "replica": replica.replica_id,
+                         "detail": repr(exc)}).encode(), None)}
+        attempt.conn = conn
+        try:
+            try:
+                data = resp.read()
+            except (OSError, http.client.HTTPException) as exc:
+                if attempt.cancelled:
+                    return {"kind": "cancelled"}
+                # Read timeout lands here too: a hung (stuck-socket)
+                # replica becomes breaker evidence instead of a thread
+                # parked for the client's whole patience.
+                self.registry.note_error(replica)
+                self.registry.note_result(replica, False)
+                return {"kind": "transport", "tag": "upstream_died",
+                        "error": (503, json.dumps(
+                            {"error": "upstream_died",
+                             "replica": replica.replica_id,
+                             "detail": repr(exc)}).encode(), None)}
+            if attempt.cancelled:
+                return {"kind": "cancelled"}
+            self.registry.note_result(replica, resp.status < 500)
+            if resp.status in _RETRYABLE_STATUS:
+                retry_after = self._retry_after_s(resp)
+                if retry_after is not None:
+                    self.registry.note_backoff(replica, retry_after)
+                return {"kind": "retryable",
+                        "tag": str(resp.status),
+                        "error": (resp.status, data, retry_after)}
+            headers = []
+            for name in _HOP_HEADERS:
+                value = resp.getheader(name)
+                if value is not None:
+                    headers.append((name.title(), value))
+            return {"kind": "answered", "status": resp.status,
+                    "headers": headers, "data": data}
+        finally:
+            conn.close()
+
+    def _dispatch_buffered(self, handler, body: bytes, *, budget: Budget,
+                           variant, roles) -> None:
+        """Failover + hedging event loop for non-streamed requests: every
+        attempt is buffered in its own thread; the first non-retryable
+        answer wins, the loser is cancelled without feeding streaks."""
+        started_at = self.clock()
+        tried: set[str] = set()
+        trail: list[str] = []
+        last_error = None
+        results: queue.Queue = queue.Queue()
+        outstanding: set[_Attempt] = set()
+        hedge_launched = False
+        hedge_delay = self._hedge_delay()
+        seq_attempts = 0
+
+        def launch(replica, *, is_hedge: bool) -> None:
+            tried.add(replica.replica_id)
+            self._c_dispatch.labels(replica=replica.replica_id).inc()
+            self.registry.note_dispatch(replica)
+            attempt = _Attempt(replica)
+            outstanding.add(attempt)
+
+            def run():
+                # The event loop blocks on `results` — a result must land
+                # no matter what, or the client handler parks forever.
+                outcome = {"kind": "transport", "tag": "router_error",
+                           "error": (503, json.dumps(
+                               {"error": "upstream_unreachable",
+                                "replica": replica.replica_id,
+                                "detail": "router attempt crashed"}
+                           ).encode(), None)}
+                try:
+                    outcome = self._buffered_attempt(attempt, body, budget)
+                finally:
+                    self.registry.note_done(replica)
+                    results.put((attempt, outcome, is_hedge))
+
+            threading.Thread(target=run, daemon=True,
+                             name=f"fleet-attempt-{replica.replica_id}"
+                             ).start()
+
+        first = self.registry.pick(exclude=tried, variant=variant,
+                                   roles=roles)
+        if first is None:
+            if budget.expired():
+                self._answer_deadline(handler, trail, 0)
+            else:
+                self._answer_exhausted(handler, None, trail, 0)
+            return
+        launch(first, is_hedge=False)
+        seq_attempts = 1
+
+        while outstanding:
+            timeout = None
+            now = self.clock()
+            if not hedge_launched and hedge_delay is not None:
+                timeout = max(0.0, started_at + hedge_delay - now)
+            remaining = budget.remaining()
+            if math.isfinite(remaining):
+                # Wake shortly after expiry to answer the typed deadline
+                # even if the upstream read timeout hasn't tripped yet.
+                cap = max(0.0, remaining) + 0.05
+                timeout = cap if timeout is None else min(timeout, cap)
+            try:
+                attempt, outcome, was_hedge = results.get(timeout=timeout)
+            except queue.Empty:
+                if budget.expired():
+                    for a in outstanding:
+                        a.cancel()
+                    self._answer_deadline(handler, trail, len(tried))
+                    return
+                if not hedge_launched and hedge_delay is not None \
+                        and self.clock() - started_at >= hedge_delay:
+                    hedge_launched = True  # one hedge per request, max
+                    hedge = self.registry.pick(exclude=tried,
+                                               variant=variant, roles=roles)
+                    if hedge is not None:
+                        self._c_hedge.labels(outcome="launched").inc()
+                        launch(hedge, is_hedge=True)
+                continue
+            outstanding.discard(attempt)
+            kind = outcome["kind"]
+            if kind == "cancelled":
+                trail.append(f"{attempt.replica.replica_id}:cancelled")
+                continue
+            if kind == "answered":
+                for loser in outstanding:
+                    loser.cancel()
+                trail.append(
+                    f"{attempt.replica.replica_id}:{outcome['status']}")
+                if hedge_launched:
+                    self._c_hedge.labels(
+                        outcome="winner_hedge" if was_hedge
+                        else "winner_primary").inc()
+                self._send_buffered(handler, attempt.replica, len(tried),
+                                    outcome, trail, started_at)
+                return
+            # transport / retryable: record, then decide on a follow-up.
+            trail.append(f"{attempt.replica.replica_id}:{outcome['tag']}")
+            last_error = outcome["error"]
+            if outstanding:
+                continue  # the other racer may still answer
+            if budget.expired():
+                self._answer_deadline(handler, trail, len(tried))
+                return
+            if seq_attempts >= self.max_attempts:
+                break
+            delay = self._backoff_or_none(seq_attempts, budget)
+            if delay is None:
+                break  # remaining budget can't fit backoff + attempt
+            if delay > 0:
+                time.sleep(delay)
+            nxt = self.registry.pick(exclude=tried, variant=variant,
+                                     roles=roles)
+            if nxt is None:
+                break
+            self._c_failover.inc()
+            launch(nxt, is_hedge=False)
+            seq_attempts += 1
+        self._answer_exhausted(handler, last_error, trail, len(tried))
+
+    def _send_buffered(self, handler, replica, attempts: int, outcome: dict,
+                       trail: list, started_at: float) -> None:
+        data = outcome["data"]
+        handler.send_response(outcome["status"])
+        for name, value in outcome["headers"]:
+            handler.send_header(name, value)
         handler.send_header("Content-Length", str(len(data)))
-        handler.send_header("Retry-After",
-                            str(max(1, int(retry_after or 1))))
-        handler.send_header("X-Attempts", str(len(tried)))
+        handler.send_header("X-Replica", replica.replica_id)
+        handler.send_header("X-Attempts", str(attempts))
+        handler.send_header("X-Attempt-Trail", ",".join(trail))
         handler.end_headers()
         handler.wfile.write(data)
+        if outcome["status"] == 200:
+            self._note_latency(self.clock() - started_at)
+            try:
+                ttft_ms = json.loads(data).get("ttft_ms")
+                if ttft_ms is not None:
+                    self._h_ttft.observe(float(ttft_ms) / 1e3)
+            except (ValueError, AttributeError):
+                pass
 
 
 def make_router_server(
@@ -377,6 +746,7 @@ def make_router_server(
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n)
             variant = None
+            deadline_s = None
             try:
                 parsed = json.loads(body or b"{}")
                 streaming = bool(isinstance(parsed, dict)
@@ -388,11 +758,17 @@ def make_router_server(
                     variant = (str(parsed.get("variant", "")) or
                                router.resolve_variant(
                                    str(parsed.get("client_id", ""))))
+                    raw_deadline = parsed.get("deadline_s")
+                    if raw_deadline is not None:
+                        try:
+                            deadline_s = float(raw_deadline)
+                        except (TypeError, ValueError):
+                            deadline_s = None  # replica answers 400
             except ValueError:
                 streaming = False  # replica will answer 400 either way
             try:
                 router.dispatch(self, body, streaming=streaming,
-                                variant=variant)
+                                variant=variant, deadline_s=deadline_s)
             except (BrokenPipeError, ConnectionResetError):
                 pass  # client left mid-proxy; nothing to answer
 

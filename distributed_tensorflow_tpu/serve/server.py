@@ -78,10 +78,12 @@ Endpoints:
 from __future__ import annotations
 
 import json
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from distributed_tensorflow_tpu.obs import export as obs_export
 from distributed_tensorflow_tpu.serve.scheduler import Completion, Request
+from distributed_tensorflow_tpu.utils import faults
 
 __all__ = ["make_server"]
 
@@ -99,7 +101,7 @@ _REJECTION_STATUS = {
 }
 
 
-def _parse_request(body: dict, codec) -> Request:
+def _parse_request(body: dict, codec, budget_s: float | None = None) -> Request:
     prompt = body.get("prompt")
     if isinstance(prompt, str):
         if codec is None:
@@ -111,6 +113,13 @@ def _parse_request(body: dict, codec) -> Request:
         raise ValueError("prompt tokens must be ints")
     eos_id = body.get("eos_id")
     deadline = body.get("deadline_s")
+    deadline = None if deadline is None else float(deadline)
+    if budget_s is not None:
+        # Propagated router budget (X-Budget-Ms): the remaining END-TO-END
+        # time. The scheduler's deadline_s is a queue-wait budget, so the
+        # min is conservative — a request the router can no longer finish
+        # must not sit in the admission queue either.
+        deadline = budget_s if deadline is None else min(deadline, budget_s)
     priority = body.get("priority", 1)
     if isinstance(priority, bool) or not isinstance(priority, int):
         raise ValueError(f"priority must be an int lane, got {priority!r}")
@@ -297,12 +306,37 @@ def make_server(
             if self.path != "/generate":
                 self._send(404, {"error": "not_found", "detail": self.path})
                 return
+            # Chaos sites (DESIGN.md §22), armable via DTT_FAULT alone.
+            stall = faults.delay_s("replica_stall")
+            if stall:
+                time.sleep(stall)
+            if faults.fire("replica_hang"):
+                # Hold the socket without answering — the stuck-socket
+                # failure mode (process alive, healthz fine, request path
+                # wedged). The caller's read timeout, not this server,
+                # must turn it into a typed outcome. Handler threads are
+                # daemons; the hold is bounded by the site's ms.
+                time.sleep(faults.site_ms("replica_hang", 30_000.0) / 1e3)
+                self.close_connection = True
+                return
+            if faults.fire("replica_5xx"):
+                self._send(503, {"error": "injected_5xx",
+                                 "detail": "DTT_FAULT replica_5xx"},
+                           {"Retry-After": "1"})
+                return
+            budget_s = None
+            raw_budget = self.headers.get("X-Budget-Ms")
+            if raw_budget is not None:
+                try:
+                    budget_s = max(0.0, float(raw_budget) / 1000.0)
+                except ValueError:
+                    budget_s = None
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
                 if not isinstance(body, dict):
                     raise ValueError("body must be a JSON object")
-                request = _parse_request(body, codec)
+                request = _parse_request(body, codec, budget_s=budget_s)
             except (ValueError, TypeError, json.JSONDecodeError) as exc:
                 self._send(400, {"error": "invalid", "detail": str(exc)})
                 return
@@ -423,6 +457,13 @@ def make_server(
             try:
                 while True:
                     if kind == "tokens":
+                        if faults.fire("stream_cut"):
+                            # Close without a done frame: the truncated
+                            # stream is exactly what a mid-generation
+                            # replica death looks like on the wire
+                            # (``stream_cut:after=N`` lets N frames pass).
+                            self.close_connection = True
+                            return
                         self._write_event("token", {"tokens": payload})
                         kind, payload = next(events)
                         continue

@@ -5,7 +5,19 @@
 * ``site:N`` — the next N traversals of ``site`` fire (count-armed);
 * ``site:step=K`` — ``site`` fires exactly when the training loop passes
   host step K (step-armed; repeat the entry to arm several steps);
+* ``site:p=F`` — each traversal fires with probability F (never exhausts;
+  draws come from a ``DTT_FAULT_SEED``-seeded RNG so storms replay);
+* ``site:after=N`` — let N traversals pass, fire once on the N+1th (repeat
+  the entry to arm several crossings);
+* ``site:ms=D`` — attach a latency of D milliseconds to the site: a
+  delay-type site (``probe_slow``) stalls by D on every armed traversal,
+  and an error-type site (``replica_hang``) reads D as its hang duration;
 * ``site`` alone — shorthand for ``site:1``.
+
+Entries for the same site combine: ``replica_hang:1,replica_hang:ms=500``
+arms one hang of 500 ms. A site with ONLY ``ms=`` delays every traversal
+while armed; combined with a count/probability/after arm, the delay applies
+only when that arm fires.
 
 Sites wired through the stack (each consumed exactly where the real failure
 would occur, so recovery paths are exercised end-to-end):
@@ -20,6 +32,21 @@ would occur, so recovery paths are exercised end-to-end):
 * ``preempt``        — step-armed: the loop raises a synthetic preemption
                        request at that step (same flag a real SIGTERM sets).
 
+Serving-plane sites (PR 16, DESIGN.md §22 for the outcome each maps to):
+
+* ``route_dispatch``        — router→replica connect fails before any bytes;
+* ``replica_5xx``           — replica answers 503 before admission;
+* ``replica_stall``         — replica stalls ``ms=`` before answering;
+* ``replica_hang``          — replica holds the socket open without answering
+                              (``ms=`` caps the hold, default 30 000);
+* ``stream_cut``            — SSE stream closes without a ``done`` frame
+                              (``after=N`` lets N token frames pass);
+* ``probe_slow``            — health probe stalls ``ms=``;
+* ``probe_flap``            — health probe reports failure for a live replica;
+* ``handoff_corrupt``       — outbound DTFH1 bundle is bit-flipped;
+* ``handoff_send_timeout``  — outbound handoff send dies on a timeout;
+* ``spawn_fail``            — supervisor replica spawn raises.
+
 The registry is process-local and loads from the env on first use, so
 multiprocess tests arm workers simply by exporting ``DTT_FAULT``.
 """
@@ -27,6 +54,7 @@ multiprocess tests arm workers simply by exporting ``DTT_FAULT``.
 from __future__ import annotations
 
 import os
+import random
 import threading
 from dataclasses import dataclass, field
 from typing import Iterable
@@ -36,6 +64,7 @@ from distributed_tensorflow_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 ENV_VAR = "DTT_FAULT"
+SEED_ENV_VAR = "DTT_FAULT_SEED"
 
 
 class InjectedFault(OSError):
@@ -47,10 +76,16 @@ class InjectedFault(OSError):
 class _Site:
     remaining: int = 0
     steps: set[int] = field(default_factory=set)
+    p: float = 0.0            # per-traversal fire probability (never exhausts)
+    afters: set[int] = field(default_factory=set)  # fire once past each crossing
+    ms: float = 0.0           # attached latency (delay value / hang duration)
+    seen: int = 0             # traversals observed (drives ``after=``)
+    gated: bool = False       # ever count/p/after-armed: ms only fires with arm
 
 
 _lock = threading.Lock()
 _registry: dict[str, _Site] | None = None  # None = not yet loaded from env
+_rng: random.Random = random.Random()
 
 
 def parse_spec(spec: str) -> dict[str, _Site]:
@@ -73,11 +108,27 @@ def parse_spec(spec: str) -> dict[str, _Site]:
             site.remaining += int(arg)
         elif arg.startswith("step="):
             site.steps.add(int(arg[len("step="):]))
+        elif arg.startswith("p="):
+            p = float(arg[len("p="):])
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(
+                    f"bad {ENV_VAR} entry {entry!r}: p must be in [0, 1]")
+            site.p = p
+        elif arg.startswith("after="):
+            site.afters.add(int(arg[len("after="):]))
+        elif arg.startswith("ms="):
+            ms = float(arg[len("ms="):])
+            if ms < 0:
+                raise ValueError(
+                    f"bad {ENV_VAR} entry {entry!r}: ms must be >= 0")
+            site.ms = ms
         else:
             raise ValueError(
-                f"bad {ENV_VAR} entry {entry!r}: expected 'site', 'site:N' "
-                "or 'site:step=K'"
+                f"bad {ENV_VAR} entry {entry!r}: expected 'site', 'site:N', "
+                "'site:step=K', 'site:p=F', 'site:after=N' or 'site:ms=D'"
             )
+        if site.remaining > 0 or site.p > 0 or site.afters:
+            site.gated = True
     return sites
 
 
@@ -87,6 +138,7 @@ def configure(spec: str | None) -> None:
     global _registry
     with _lock:
         _registry = None if spec is None else parse_spec(spec)
+        _rng.seed(int(os.environ.get(SEED_ENV_VAR, "0")))
 
 
 def reset() -> None:
@@ -97,18 +149,32 @@ def _sites() -> dict[str, _Site]:
     global _registry
     if _registry is None:
         _registry = parse_spec(os.environ.get(ENV_VAR, ""))
+        _rng.seed(int(os.environ.get(SEED_ENV_VAR, "0")))
         if _registry:
             log.warning("%s armed: %s", ENV_VAR, os.environ.get(ENV_VAR))
     return _registry
 
 
+def _roll(s: _Site) -> bool:
+    """One traversal of a site, lock held: count, crossing, then p-arm."""
+    s.seen += 1
+    if s.remaining > 0:
+        s.remaining -= 1
+        return True
+    crossed = {a for a in s.afters if s.seen > a}
+    if crossed:
+        s.afters -= crossed
+        return True
+    return s.p > 0.0 and _rng.random() < s.p
+
+
 def fire(site: str) -> bool:
-    """Consume one count-armed shot of ``site``; True when it fires."""
+    """One traversal of ``site``; True when a count-, after-, or p-armed
+    shot fires (counts consume, crossings fire once, p never exhausts)."""
     with _lock:
         s = _sites().get(site)
-        if s is None or s.remaining <= 0:
+        if s is None or not _roll(s):
             return False
-        s.remaining -= 1
     log.warning("injected fault fired: %s", site)
     return True
 
@@ -129,6 +195,34 @@ def fire_step(site: str, steps: Iterable[int]) -> bool:
 
 
 def maybe_fail(site: str, detail: str = "") -> None:
-    """Raise :class:`InjectedFault` when ``site`` is count-armed."""
+    """Raise :class:`InjectedFault` when ``site`` fires on this traversal."""
     if fire(site):
         raise InjectedFault(f"injected fault at {site}" + (f" ({detail})" if detail else ""))
+
+
+def site_ms(site: str, default: float = 0.0) -> float:
+    """The ``ms=`` latency attached to ``site`` (non-consuming) — error-type
+    sites read it as a duration (e.g. how long ``replica_hang`` holds the
+    socket)."""
+    with _lock:
+        s = _sites().get(site)
+        return s.ms if s is not None and s.ms > 0 else default
+
+
+def delay_s(site: str) -> float:
+    """Seconds to stall this traversal of ``site``, 0.0 when quiet.
+
+    A site armed ONLY with ``ms=`` delays every traversal; combined with a
+    count/probability/after arm, the delay applies when that arm fires."""
+    with _lock:
+        s = _sites().get(site)
+        if s is None or s.ms <= 0:
+            return 0.0
+        if s.gated:  # an exhausted count/after arm stays quiet, not ms-only
+            if not _roll(s):
+                return 0.0
+        else:
+            s.seen += 1
+        out = s.ms / 1000.0
+    log.warning("injected delay fired: %s (%.0f ms)", site, out * 1000.0)
+    return out

@@ -13,6 +13,7 @@ retrying the same dead endpoint doesn't thundering-herd it in lockstep.
 
 from __future__ import annotations
 
+import math
 import random
 import time
 from functools import wraps
@@ -29,6 +30,42 @@ T = TypeVar("T")
 DEFAULT_RETRYABLE: tuple[type[BaseException], ...] = (OSError,)
 
 
+class Budget:
+    """Remaining-time budget a request carries across hops (deadline
+    propagation, DESIGN.md §22): constructed once at the edge, every hop
+    asks ``remaining()`` instead of re-deriving its own deadline.
+
+    ``seconds=None`` means unbounded (``remaining()`` is +inf, never
+    ``expired()``) so budget-aware code paths need no None-checks."""
+
+    def __init__(self, seconds: float | None, *,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self.deadline = None if seconds is None else clock() + seconds
+
+    def remaining(self) -> float:
+        if self.deadline is None:
+            return math.inf
+        return self.deadline - self._clock()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+
+def next_delay(
+    attempt: int,
+    *,
+    base_delay: float,
+    max_delay: float,
+    jitter: float,
+    rng: random.Random,
+) -> float:
+    """Backoff delay before 1-based retry ``attempt`` (the single-step form
+    of :func:`backoff_delays`; the fleet router uses it per failover hop)."""
+    delay = min(max_delay, base_delay * 2 ** (attempt - 1))
+    return delay * (1.0 - jitter + 2.0 * jitter * rng.random())
+
+
 def backoff_delays(
     attempts: int,
     base_delay: float,
@@ -38,11 +75,11 @@ def backoff_delays(
 ) -> list[float]:
     """The (attempts-1) sleep durations between attempts — exposed so tests
     can assert the timing envelope without sleeping."""
-    out = []
-    for attempt in range(1, attempts):
-        delay = min(max_delay, base_delay * 2 ** (attempt - 1))
-        out.append(delay * (1.0 - jitter + 2.0 * jitter * rng.random()))
-    return out
+    return [
+        next_delay(attempt, base_delay=base_delay, max_delay=max_delay,
+                   jitter=jitter, rng=rng)
+        for attempt in range(1, attempts)
+    ]
 
 
 def retry_call(
@@ -78,6 +115,52 @@ def retry_call(
                             what, attempt, attempts, e)
                 raise
             delay = delays[attempt - 1]
+            log.warning("%s: attempt %d/%d failed (%s) — retrying in %.2fs",
+                        what, attempt, attempts, e, delay)
+            sleep(delay)
+    raise AssertionError("unreachable")
+
+
+def deadline_retry_call(
+    fn: Callable[[], T],
+    *,
+    budget: Budget,
+    attempts: int = 3,
+    base_delay: float = 0.5,
+    max_delay: float = 30.0,
+    jitter: float = 0.25,
+    min_attempt_s: float = 0.0,
+    retryable: Iterable[type[BaseException]] = DEFAULT_RETRYABLE,
+    sleep: Callable[[float], None] = time.sleep,
+    rng: random.Random | None = None,
+    description: str = "",
+) -> T:
+    """:func:`retry_call` that stops when the remaining ``budget`` can't fit
+    the backoff sleep plus one more attempt (``min_attempt_s`` estimates the
+    attempt's own cost). The last real error is re-raised — a request out of
+    budget fails with what actually went wrong, not a synthetic timeout."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    retryable = tuple(retryable)
+    rng = rng if rng is not None else random.Random()
+    what = description or getattr(fn, "__name__", "call")
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retryable as e:
+            if attempt == attempts:
+                log.warning("%s: attempt %d/%d failed (%s) — giving up",
+                            what, attempt, attempts, e)
+                raise
+            delay = next_delay(attempt, base_delay=base_delay,
+                               max_delay=max_delay, jitter=jitter, rng=rng)
+            if budget.remaining() < delay + min_attempt_s:
+                log.warning(
+                    "%s: attempt %d/%d failed (%s) — %.2fs budget left, "
+                    "can't fit %.2fs backoff + another attempt, giving up",
+                    what, attempt, attempts, e, max(budget.remaining(), 0.0),
+                    delay)
+                raise
             log.warning("%s: attempt %d/%d failed (%s) — retrying in %.2fs",
                         what, attempt, attempts, e, delay)
             sleep(delay)

@@ -70,6 +70,11 @@ class _Accounting:
         self.completed = 0
         self.shed = 0
         self.errored = 0
+        # Streams that delivered tokens but no terminal frame: a TYPED,
+        # visible failure (the truncation is the signal — the router
+        # never retries a partial stream), distinct from the silent-drop
+        # bucket ``errored``.
+        self.stream_aborted = 0
         self.tokens = 0
         self.ttft_s = []
         self.latency_s = []
@@ -77,6 +82,9 @@ class _Accounting:
         self.shed_reasons = {}
         self.per_replica = {}
         self.failovers = 0
+        # Per-attempt attribution (X-Attempt-Trail), bounded — chaos runs
+        # read these from the JSONL to see which replica failed how.
+        self.trails = []
         # Deploy attribution, keyed by the X-Variant response header
         # ("" = single-variant serving): per-variant latency samples +
         # token counts, and every weight version observed per variant —
@@ -88,8 +96,8 @@ class _Accounting:
 
     def _phase_bucket(self, phase):
         return self.per_phase.setdefault(phase, {
-            "completed": 0, "shed": 0, "errored": 0, "tokens": 0,
-            "ttft_s": [], "latency_s": [],
+            "completed": 0, "shed": 0, "errored": 0, "stream_aborted": 0,
+            "tokens": 0, "ttft_s": [], "latency_s": [],
         })
 
     def complete(self, ttft_s, latency_s, n_tokens, gaps=None,
@@ -172,6 +180,12 @@ class _Accounting:
             if phase is not None:
                 self._phase_bucket(phase)["errored"] += 1
 
+    def stream_abort(self, phase=None):
+        with self.lock:
+            self.stream_aborted += 1
+            if phase is not None:
+                self._phase_bucket(phase)["stream_aborted"] += 1
+
     def attribute(self, headers):
         """Record routing metadata from a response's headers (no-op for
         a bare replica, which sends neither header)."""
@@ -179,6 +193,7 @@ class _Accounting:
             return
         replica = headers.get("X-Replica")
         attempts = headers.get("X-Attempts")
+        trail = headers.get("X-Attempt-Trail")
         with self.lock:
             if replica:
                 self.per_replica[replica] = (
@@ -188,6 +203,8 @@ class _Accounting:
                     self.failovers += max(0, int(attempts) - 1)
                 except ValueError:
                     pass
+            if trail and len(self.trails) < 256:
+                self.trails.append(trail)
 
 
 class _PhaseAcct:
@@ -209,6 +226,9 @@ class _PhaseAcct:
 
     def error(self):
         self.acct.error(phase=self.phase)
+
+    def stream_abort(self):
+        self.acct.stream_abort(phase=self.phase)
 
     def attribute(self, headers):
         self.acct.attribute(headers)
@@ -261,27 +281,40 @@ def _read_sse(resp, t0, acct):
     done = None
     gaps = []
     last_frame = None
-    for raw in resp:
-        line = raw.decode("utf-8", "replace").rstrip("\n\r")
-        if line.startswith("event: "):
-            event = line[len("event: "):]
-        elif line.startswith("data: "):
-            obj = json.loads(line[len("data: "):])
-            if event == "token":
-                now = time.monotonic()
-                if ttft is None:
-                    ttft = now - t0
-                else:
-                    # True client-side inter-token gap: successive token
-                    # frame arrivals (what chunked prefill must protect).
-                    gaps.append(now - last_frame)
-                last_frame = now
-                tokens += len(obj.get("tokens", ()))
-            elif event == "done":
-                done = obj
+    try:
+        for raw in resp:
+            line = raw.decode("utf-8", "replace").rstrip("\n\r")
+            if line.startswith("event: "):
+                event = line[len("event: "):]
+            elif line.startswith("data: "):
+                obj = json.loads(line[len("data: "):])
+                if event == "token":
+                    now = time.monotonic()
+                    if ttft is None:
+                        ttft = now - t0
+                    else:
+                        # True client-side inter-token gap: successive token
+                        # frame arrivals (what chunked prefill must protect).
+                        gaps.append(now - last_frame)
+                    last_frame = now
+                    tokens += len(obj.get("tokens", ()))
+                elif event == "done":
+                    done = obj
+    except Exception:  # noqa: BLE001 — a dirty cut is still a truncation
+        # Transport died mid-stream (RST, timeout, garbage frame): same
+        # classification as a clean truncation — the token count decides
+        # stream_aborted vs dropped below.
+        done = None
     if done is None:
-        # Stream truncated without a terminal frame: a drop, not a shed.
-        acct.error()
+        if tokens > 0:
+            # Truncated AFTER tokens flowed: the typed partial-stream
+            # outcome (the router never retries a committed stream; the
+            # truncation IS the failure signal) — visible, accounted,
+            # not a silent drop.
+            acct.stream_abort()
+        else:
+            # Nothing arrived at all: a drop, not a shed.
+            acct.error()
         return False
     if "error" in done:
         acct.reject(done["error"])
@@ -597,6 +630,12 @@ def main(argv=None):
         "--deadline_s", type=float, default=0.0,
         help="per-request queue-wait deadline (0 = none)",
     )
+    parser.add_argument(
+        "--deadline_ms", type=float, default=0.0,
+        help="per-request end-to-end deadline in milliseconds (0 = none; "
+        "supersedes --deadline_s) — through a fleet router this becomes "
+        "the propagated X-Budget-Ms budget",
+    )
     parser.add_argument("--timeout_s", type=float, default=60.0)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
@@ -669,6 +708,10 @@ def main(argv=None):
 
     rng = random.Random(args.seed)
 
+    deadline_s = args.deadline_s
+    if args.deadline_ms > 0:
+        deadline_s = args.deadline_ms / 1e3
+
     group_prefixes = []
     if args.prefix_groups > 0:
         # The shared prefix must span whole KV pages to be adoptable, so
@@ -698,8 +741,7 @@ def main(argv=None):
                 "max_new_tokens": n,
                 "temperature": args.temperature,
                 "seed": i,
-                **({"deadline_s": args.deadline_s}
-                   if args.deadline_s > 0 else {}),
+                **({"deadline_s": deadline_s} if deadline_s > 0 else {}),
             }
         if group_prefixes:
             prefix = group_prefixes[i % len(group_prefixes)]
@@ -716,8 +758,8 @@ def main(argv=None):
             "temperature": args.temperature,
             "seed": i,
         }
-        if args.deadline_s > 0:
-            payload["deadline_s"] = args.deadline_s
+        if deadline_s > 0:
+            payload["deadline_s"] = deadline_s
         return payload
 
     targets = [t.rstrip("/") for t in args.targets.split(",") if t.strip()]
@@ -816,12 +858,32 @@ def main(argv=None):
     if scheduler is not None:
         scheduler.stop()
 
-    accounted = acct.completed + acct.shed + acct.errored
+    accounted = (acct.completed + acct.shed + acct.errored
+                 + acct.stream_aborted)
+    # Typed outcome classes: every request lands in exactly one. A shed
+    # splits by reason — "deadline" (budget expired before service) and
+    # failover exhaustion (the router ran out of upstreams) are distinct
+    # operator signals from capacity sheds.
+    _exhausted_reasons = {"upstream_unreachable", "upstream_died",
+                          "no_upstream"}
+    failover_exhausted = sum(
+        v for k, v in acct.shed_reasons.items() if k in _exhausted_reasons)
+    deadline_shed = acct.shed_reasons.get("deadline", 0)
     report = {
         "num_requests": args.num_requests,
         "completed": acct.completed,
         "shed": acct.shed,
         "shed_reasons": acct.shed_reasons,
+        "stream_aborted": acct.stream_aborted,
+        "outcomes": {
+            "ok": acct.completed,
+            "deadline": deadline_shed,
+            "failover_exhausted": failover_exhausted,
+            "shed": acct.shed - deadline_shed - failover_exhausted,
+            "stream_aborted": acct.stream_aborted,
+            "errored": acct.errored,
+        },
+        "attempt_trails": acct.trails[:64],
         "dropped_without_shed": acct.errored + (args.num_requests - accounted),
         "wall_s": round(wall_s, 4),
         "throughput_tok_s": round(acct.tokens / wall_s, 2) if wall_s > 0 else 0.0,

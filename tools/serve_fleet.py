@@ -218,10 +218,20 @@ def main(argv=None):
     else:
         initial_roles = ["mixed"] * fleet_cfg.num_replicas
 
+    if fleet_cfg.router_obs_dir:
+        # Router-side dump dir: breaker-open flight-recorder dumps and
+        # the end-of-run storm summary. Deliberately NOT --obs_dir (that
+        # flag is forwarded verbatim to every replica).
+        obs.set_dump_dir(fleet_cfg.router_obs_dir)
+
     registry = ReplicaRegistry(
         [],
         up_after=fleet_cfg.up_after,
         down_after=fleet_cfg.down_after,
+        breaker_window=fleet_cfg.breaker_window,
+        breaker_fail_threshold=fleet_cfg.breaker_fail_threshold,
+        breaker_min_samples=fleet_cfg.breaker_min_samples,
+        breaker_open_s=fleet_cfg.breaker_open_s,
     )
     supervisor = None
     replicas: list[ReplicaProc] = []
@@ -282,7 +292,13 @@ def main(argv=None):
             )
         expected_up = len(replicas)
 
-    router = FleetRouter(registry, max_attempts=fleet_cfg.max_attempts)
+    router = FleetRouter(
+        registry,
+        max_attempts=fleet_cfg.max_attempts,
+        read_timeout_s=fleet_cfg.read_timeout_s,
+        hedge_after_s=(None if fleet_cfg.hedge_after_s < 0
+                       else fleet_cfg.hedge_after_s),
+    )
     slo_rules = obs.parse_slo_flag(
         fleet_cfg.fleet_slo, defaults=obs.default_fleet_rules)
     slo_monitor = (obs.SloMonitor(registry.metrics_registry, slo_rules)
@@ -316,12 +332,46 @@ def main(argv=None):
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+    def write_storm_summary() -> None:
+        """Fleet-wide chaos/storm summary: final breaker states, every
+        ``fleet_*`` counter/gauge, and the per-replica snapshot — the
+        one file an operator (or the chaos gate) reads after a storm."""
+        if not fleet_cfg.router_obs_dir:
+            return
+        import json
+        try:
+            metrics = {}
+            for fam in registry.metrics_registry.collect():
+                if not fam.name.startswith("fleet_"):
+                    continue
+                if fam.kind == "histogram":
+                    continue
+                for label_values, inst in fam.children():
+                    key = fam.name
+                    if label_values:
+                        key += "{" + ",".join(label_values) + "}"
+                    metrics[key] = inst.value
+            summary = {
+                "t_wall": time.time(),
+                "breakers_closed": registry.breakers_closed(),
+                "replicas": registry.snapshot(),
+                "fleet_metrics": metrics,
+            }
+            os.makedirs(fleet_cfg.router_obs_dir, exist_ok=True)
+            path = os.path.join(fleet_cfg.router_obs_dir,
+                                "fleet_storm_summary.json")
+            with open(path, "w") as f:
+                json.dump(summary, f, indent=2, default=str)
+        except Exception:  # noqa: BLE001 — summary is best-effort
+            pass
+
     try:
         server.serve_forever()
     finally:
         server.server_close()
         if slo_monitor is not None:
             slo_monitor.stop()
+        write_storm_summary()
         registry.stop()
         if supervisor is not None:
             supervisor.stop(drain=True)
