@@ -603,6 +603,31 @@ class ServeConfig:
             "POST /admin/handoff_peers)"
         },
     )
+    handoff_wire: int = field(
+        default=2,
+        metadata={
+            "help": "handoff wire format a prefill replica SENDS: 2 = "
+            "chunked pipelined DTFH2 stream (default; encode overlaps "
+            "send, per-chunk CRC, optional zlib), 1 = monolithic DTFH1 "
+            "bundle. Receivers always accept both"
+        },
+    )
+    handoff_chunk_pages: int = field(
+        default=4,
+        metadata={
+            "help": "KV pages per DTFH2 chunk frame — the pipelining "
+            "grain: smaller = better encode/send overlap + finer "
+            "receiver scatters, larger = less framing overhead"
+        },
+    )
+    handoff_compress: bool = field(
+        default=True,
+        metadata={
+            "help": "zlib-compress DTFH2 chunk payloads when the "
+            "measured ratio clears the skip-if-incompressible guard "
+            "(stdlib zlib level 1; incompressible chunks ship raw)"
+        },
+    )
 
     @property
     def handoff_peer_list(self) -> tuple:
@@ -794,6 +819,13 @@ class FleetConfig:
     )
     supervisor_tick_s: float = field(
         default=0.5, metadata={"help": "policy loop evaluation period"}
+    )
+    balance_tiers: bool = field(
+        default=False,
+        metadata={"help": "supervised disaggregated fleets only: each "
+                  "scaling decision picks WHICH tier to grow/shrink from "
+                  "the prefill admission-load vs decode page-occupancy "
+                  "split instead of always scaling the fixed role"},
     )
     drain_grace_s: float = field(
         default=15.0,

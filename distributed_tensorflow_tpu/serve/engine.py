@@ -1773,6 +1773,41 @@ class SlotEngine:
             "pages": self.pool.export_pages(slot),
         }
 
+    def export_slot_meta(self, slot: int, *, history=None) -> dict:
+        """The v2 (streaming) flavor of :meth:`export_slot`: identical
+        registers, but the page leaves come from
+        ``pool.snapshot_pages`` — device arrays whose gathers were only
+        DISPATCHED. The driver thread pays microseconds of op dispatch
+        instead of the whole device->host copy; the outbox worker pulls
+        rows to host chunk by chunk while streaming. Same preconditions
+        and the same exporter-keeps-the-slot contract as
+        :meth:`export_slot`."""
+        if not self.paged:
+            raise RuntimeError("slot handoff requires the paged KV layout")
+        if self.prefilling[slot]:
+            raise RuntimeError(f"slot {slot} is mid-chunked-prefill")
+        if not self.active[slot]:
+            raise RuntimeError(f"slot {slot} is not active")
+        if self.spec_k:
+            history = self.history[slot, : int(self.hist_len[slot])]
+        hist = (np.asarray(history, np.int32).ravel().tolist()
+                if history is not None else [])
+        return {
+            "length": int(self.lengths[slot]),
+            "cur_tok": int(self.cur_tok[slot]),
+            "made": int(self.made[slot]),
+            "budget": int(self.budget[slot]),
+            "eos": int(self.eos[slot]),
+            "temperature": float(self.temp[slot]),
+            "top_k": int(self.top_k[slot]),
+            "top_p": float(self.top_p[slot]),
+            "seed": int(self.seed[slot]),
+            "history": hist,
+            "page_size": self.page_size,
+            "kv_dtype": self.kv_dtype,
+            "pages": self.pool.snapshot_pages(slot),
+        }
+
     def import_slot(self, slot: int, bundle: dict) -> None:
         """Adopt an exported slot bundle into a freshly acquired ``slot``.
 
@@ -1781,6 +1816,26 @@ class SlotEngine:
         decode locally) when the pool cannot back the payload. On success
         the slot is active and the next :meth:`step` continues the
         request exactly where the exporter stopped."""
+        self.validate_handoff_header(bundle)
+        self.pool.import_pages(slot, bundle["pages"])
+        self._adopt_handoff_registers(slot, bundle)
+
+    def adopt_imported_slot(self, slot: int, bundle: dict,
+                            page_ids) -> None:
+        """Commit a STAGED (chunk-streamed) import: ``page_ids`` were
+        already allocated and scattered incrementally; bind them to
+        ``slot`` and adopt the bundle's registers. The registers-only
+        counterpart of :meth:`import_slot` — the all-or-nothing contract
+        holds because nothing is bound or activated until this call, and
+        the abort path frees the staged pages without touching a slot."""
+        self.validate_handoff_header(bundle)
+        self.pool.bind(slot, list(page_ids))
+        self._adopt_handoff_registers(slot, bundle)
+
+    def validate_handoff_header(self, bundle: dict) -> None:
+        """Typed pre-import validation (page geometry, KV format, length
+        headroom) — shared by the monolithic and staged import paths, and
+        cheap enough for a receiver to run BEFORE reading page bytes."""
         if not self.paged:
             raise RuntimeError("slot handoff requires the paged KV layout")
         if bundle["page_size"] != self.page_size:
@@ -1808,10 +1863,11 @@ class SlotEngine:
                 f"handoff length {length} + {headroom} remaining > engine "
                 f"max_len {self.max_len}"
             )
-        self.pool.import_pages(slot, bundle["pages"])
+
+    def _adopt_handoff_registers(self, slot: int, bundle: dict) -> None:
         self.active[slot] = True
         self.prefilling[slot] = False
-        self.lengths[slot] = length
+        self.lengths[slot] = int(bundle["length"])
         self.cur_tok[slot] = int(bundle["cur_tok"])
         self.temp[slot] = float(bundle["temperature"])
         self.top_k[slot] = int(bundle["top_k"])

@@ -61,9 +61,17 @@ class FleetSupervisor:
     ``spawn(role) -> handle`` blocks until the replica announced its URL
     (or raises). ``role_for(direction)`` picks which tier elastic
     capacity is added to / removed from (default ``"mixed"`` — a
-    disaggregated fleet scales its decode tier). ``on_change(members)``
-    fires after every membership change (serve_fleet re-announces ports
-    and re-pushes handoff peer lists from it).
+    disaggregated fleet scales its decode tier). With
+    ``balance_tiers=True`` that fixed choice becomes a *policy output*:
+    each scaling decision compares the prefill tier's admission load
+    (inflight + queue depth per slot) against the decode tier's page
+    occupancy (1 - pages_free/pages_total from registry probes) and
+    scales up the hotter tier / down the cooler one, so a prefill-heavy
+    shape grows prefill capacity instead of blindly adding decoders.
+    ``role_for`` stays the fallback whenever either tier has no up
+    member to measure. ``on_change(members)`` fires after every
+    membership change (serve_fleet re-announces ports and re-pushes
+    handoff peer lists from it).
     """
 
     def __init__(
@@ -80,6 +88,7 @@ class FleetSupervisor:
         cooldown_s: float = 5.0,
         drain_grace_s: float = 15.0,
         role_for=None,
+        balance_tiers: bool = False,
         on_change=None,
         clock=time.monotonic,
     ):
@@ -104,6 +113,7 @@ class FleetSupervisor:
         self.cooldown_s = float(cooldown_s)
         self.drain_grace_s = float(drain_grace_s)
         self.role_for = role_for or (lambda direction: "mixed")
+        self.balance_tiers = bool(balance_tiers)
         self.on_change = on_change
         self.clock = clock
         self._members: dict[str, _Member] = {}
@@ -273,7 +283,7 @@ class FleetSupervisor:
                         >= self.scale_up_sustain_s)
         if (sustained_up or self._slo_breach) and count < self.max_replicas:
             reason = "slo_breach" if self._slo_breach else "pressure_high"
-            member = self._spawn_one(self.role_for("up"))
+            member = self._spawn_one(self._balance_role("up"))
             if member is not None:
                 self._decide("up", reason, count + 1, now)
                 self._slo_breach = False
@@ -319,10 +329,61 @@ class FleetSupervisor:
             return None
         return None
 
+    def _tier_pressures(self) -> tuple[float, float] | None:
+        """(prefill, decode) tier pressure from the registry snapshot, or
+        None when either tier has no up member to measure. Prefill
+        pressure is admission load per slot; decode pressure is page
+        occupancy (the real capacity gate on a paged decode tier),
+        falling back to slot occupancy for non-paged replicas."""
+        snap = self.registry.snapshot()
+        pre_load = pre_slots = 0.0
+        dec_used = dec_total = 0.0
+        dec_occ: list[float] = []
+        n_pre = n_dec = 0
+        for rep in snap["replicas"].values():
+            if rep.get("state") != "up":
+                continue
+            role = rep.get("role", "mixed")
+            if role == "prefill":
+                n_pre += 1
+                pre_load += (rep.get("inflight", 0)
+                             + rep.get("queue_depth", 0)
+                             + rep.get("occupancy", 0.0)
+                             * rep.get("slots", 0))
+                pre_slots += rep.get("slots", 0)
+            elif role == "decode":
+                n_dec += 1
+                total = rep.get("pages_total", 0)
+                if total:
+                    dec_used += total - rep.get("pages_free", 0)
+                    dec_total += total
+                else:
+                    dec_occ.append(float(rep.get("occupancy", 0.0)))
+        if not n_pre or not n_dec:
+            return None
+        prefill_p = pre_load / max(1.0, pre_slots)
+        decode_p = (dec_used / dec_total if dec_total
+                    else (sum(dec_occ) / len(dec_occ) if dec_occ else 0.0))
+        return prefill_p, decode_p
+
+    def _balance_role(self, direction: str) -> str:
+        """Which role this scaling decision applies to: the hotter tier
+        on the way up, the cooler one on the way down; the injected
+        ``role_for`` whenever balancing is off or unmeasurable."""
+        if not self.balance_tiers:
+            return self.role_for(direction)
+        tiers = self._tier_pressures()
+        if tiers is None:
+            return self.role_for(direction)
+        prefill_p, decode_p = tiers
+        if direction == "up":
+            return "prefill" if prefill_p > decode_p else "decode"
+        return "prefill" if prefill_p < decode_p else "decode"
+
     def _pick_victim(self) -> _Member | None:
         """Scale-down victim: a live member of the scale role with the
         least routed load (drains fastest, disturbs least)."""
-        role = self.role_for("down")
+        role = self._balance_role("down")
         candidates = [m for m in self.members
                       if not m.draining
                       and (m.role == role

@@ -11,15 +11,42 @@ travel exactly — and neither side compiles anything new (export is an
 eager gather, import an eager scatter + the existing traced page-table
 rebinding).
 
-Wire format (``encode_bundle``/``decode_bundle``)::
+Two wire formats share ``POST /handoff`` (the receiver sniffs the
+magic):
+
+v1, monolithic (``encode_bundle``/``decode_bundle``)::
 
     b"DTFH1" | u32 header_len | header JSON | (u64 nbytes | raw)*
 
-The header carries the scalar registers (length, cur_tok, made, budget,
-eos, sampling params, seed, history) plus a per-layer manifest of the
-page arrays (dtype, shape, stream index) — layout-generic, so an int8
-cache's rows+scales serialize exactly like f32 k/v rows. Arrays follow
-as contiguous little-endian payloads in manifest order.
+v2, chunked + streamed (``iter_frames_v2``/``ChunkAssembler``)::
+
+    b"DTFH2" | u32 header_len | header JSON
+    repeat:  b"CHNK" | u32 payload_len | u32 crc32 | u8 flags | payload
+    finally: b"CMIT" | u32 total_chunks
+
+The v1 header carries the scalar registers (length, cur_tok, made,
+budget, eos, sampling params, seed, history) plus a per-layer manifest
+of the page arrays (dtype, shape, stream index) — layout-generic, so an
+int8 cache's rows+scales serialize exactly like f32 k/v rows. Arrays
+follow as contiguous little-endian payloads in manifest order.
+
+The v2 header carries the same registers plus chunking info
+(``chunk_pages``, ``n_chunks``, ``valid_rows``) and a per-layer
+manifest of PER-PAGE leaf specs (dtype + the shape of one page row).
+Chunk ``k``'s payload is the concatenation, in manifest order, of every
+leaf's raw rows for the page group ``[k*chunk_pages,
+(k+1)*chunk_pages)``. Token rows at index >= ``valid_rows`` (the
+slot's ``length`` register) are decode scratch the importer overwrites
+before reading — the sender zeroes them (recycled pages carry stale
+bytes) and strips the zero tail off the wire; the receiver zero-pads
+back to the manifest size, so elided rows round-trip as zeros. Each
+chunk carries a CRC32 of its (stripped, possibly compressed) wire
+payload; ``flags`` bit0 marks zlib compression (the sender compresses
+only when the measured ratio clears ``compress_min_ratio`` — int8 rows
+of a hot cache are often dense, the elided tail is not, so the guard
+is per-chunk and empirical, never hopeful). The final ``CMIT`` frame
+is the commit point: a receiver that has not seen it must treat the
+transfer as aborted and release anything it staged.
 
 Failure matrix (who recovers, and how — nothing is ever lost silently):
 
@@ -28,6 +55,11 @@ failure                   recovery
 ========================  ============================================
 no decode peer up         fall back: prefill replica decodes locally
 POST refused / timeout    retry next peer (bounded), then local decode
+typed 400 (bad layout,    peer deprioritized for the rest of this push
+kv_dtype mismatch, CRC)   while others remain (a layout mismatch will
+                          refuse again); once ALL peers rejected, the
+                          ban resets — a corrupt-transfer 400 recovers
+                          on a clean re-send to the same peer
 429/503 (pool full,       retry with backoff on another peer, then
 draining, queue full)     local decode
 peer dies pre-accept      same as refused — nothing streamed yet
@@ -43,6 +75,17 @@ parks the exporting slot (registers + pages intact, decode masked off)
 until the peer ACCEPTS — acceptance is the first SSE frame, exactly the
 commit point the fleet router uses — so every pre-accept failure can
 fall back to local decode with zero token loss.
+
+Peer choice is pressure-aware when the fleet pushes probe data along
+with the peer list (``/admin/handoff_peers`` accepts ``{"url": ...,
+"pages_free": ...}`` dicts): peers are tried in descending score order
+
+    score = pages_free/pages_total - 0.5*occupancy - 0.05*queue_depth
+            + 0.25 * throughput_ewma/max_ewma
+
+where the throughput term is a per-peer EWMA of observed wire
+throughput (bytes/s) from this outbox's own pushes. With no probe data
+at all the order degrades to the original rotated round-robin.
 """
 
 from __future__ import annotations
@@ -53,7 +96,9 @@ import queue
 import random
 import struct
 import threading
+import time
 import urllib.parse
+import zlib
 
 import numpy as np
 
@@ -63,18 +108,33 @@ from distributed_tensorflow_tpu.utils.retry import next_delay
 __all__ = [
     "encode_bundle",
     "decode_bundle",
+    "encode_bundle_v2",
+    "decode_bundle_v2",
+    "iter_frames_v2",
+    "ChunkAssembler",
+    "LazyBundle",
     "HandoffOutbox",
     "HandoffError",
+    "HandoffCorrupt",
 ]
 
 _MAGIC = b"DTFH1"
+_MAGIC_V2 = b"DTFH2"
+_CHNK = b"CHNK"
+_CMIT = b"CMIT"
+_FLAG_ZLIB = 0x01
 
 
 class HandoffError(RuntimeError):
     """A handoff push that did not reach acceptance on any peer."""
 
 
-# -- wire codec ------------------------------------------------------------
+class HandoffCorrupt(ValueError):
+    """A v2 frame failed CRC/framing validation — typed reject, the
+    receiver must not import anything from this transfer."""
+
+
+# -- v1 wire codec ---------------------------------------------------------
 
 
 def encode_bundle(bundle: dict, *, request_id: str = "") -> bytes:
@@ -145,6 +205,299 @@ def decode_bundle(data: bytes) -> dict:
     return bundle
 
 
+# -- v2 chunked wire codec -------------------------------------------------
+
+
+def _v2_header(bundle: dict, request_id: str, chunk_pages: int) -> dict:
+    pages = bundle["pages"]
+    n_pages = int(pages["n_pages"])
+    page_size = int(pages["page_size"])
+    chunk_pages = max(1, int(chunk_pages))
+    # Valid-row tail elision: token rows at index >= the slot's `length`
+    # register are decode scratch — recycled pages are never zeroed, but
+    # the importer overwrites row `length` before the first post-handoff
+    # step reads it (attention is masked to `length`). Shipping them
+    # would move stale garbage, so the sender zeroes them and trims the
+    # (now zero) tail off the wire; the receiver zero-fills, which also
+    # scrubs the stale bytes out of the transfer entirely.
+    try:
+        valid_rows = int(bundle["length"])
+    except (KeyError, TypeError, ValueError):
+        valid_rows = n_pages * page_size
+    if not 0 < valid_rows <= n_pages * page_size:
+        valid_rows = n_pages * page_size
+    manifest = []
+    for layer in pages["layers"]:
+        entry = {}
+        for name in sorted(layer):
+            arr = layer[name]
+            entry[name] = {
+                "dtype": np.dtype(arr.dtype).str,
+                # Shape of ONE page row — the chunk payload is sliced by
+                # page count, so the receiver reconstructs each leaf as
+                # (pages_in_chunk, *page_shape).
+                "page_shape": [int(d) for d in arr.shape[1:]],
+            }
+        manifest.append(entry)
+    header = {k: v for k, v in bundle.items() if k != "pages"}
+    header["request_id"] = str(request_id)
+    header["pages"] = {
+        "n_pages": n_pages,
+        "page_size": page_size,
+        "chunk_pages": chunk_pages,
+        "n_chunks": max(1, -(-n_pages // chunk_pages)),
+        "valid_rows": valid_rows,
+        "layers": manifest,
+    }
+    return header
+
+
+def _chunk_payload(layers, start: int, stop: int,
+                   page_size: int = 0, valid_rows: int = -1) -> bytes:
+    """Raw bytes of page rows ``[start, stop)`` across every leaf, in
+    manifest (sorted-name) order. Leaves may be numpy arrays or device
+    arrays — slicing then ``np.asarray`` keeps the device->host copy
+    scoped to this one page group.
+
+    When ``valid_rows >= 0``, token rows at global index >= valid_rows
+    are zeroed before serialization (tail elision — see the module
+    docstring). The pool's layout contract puts the in-page token-row
+    axis at axis 2 of every page leaf — ``(pages, kv_heads, page_size,
+    head_dim)`` for k/v rows, ``(pages, kv_heads, page_size)`` for int8
+    scale planes — so a leaf is elided only when its axis 2 matches
+    ``page_size``; anything else ships untouched."""
+    mask = None
+    if valid_rows >= 0 and page_size > 0:
+        rows = np.arange(start * page_size, stop * page_size,
+                         dtype=np.int64).reshape(stop - start, page_size)
+        if int(rows[-1, -1]) >= valid_rows:
+            mask = rows >= valid_rows
+    parts = []
+    for layer in layers:
+        for name in sorted(layer):
+            arr = np.asarray(layer[name][start:stop])
+            if (mask is not None and arr.ndim >= 3
+                    and arr.shape[2] == page_size):
+                m = mask.reshape(mask.shape[0], 1, page_size,
+                                 *((1,) * (arr.ndim - 3)))
+                arr = np.where(m, np.zeros((), arr.dtype), arr)
+            parts.append(np.ascontiguousarray(arr).tobytes())
+    return b"".join(parts)
+
+
+def iter_frames_v2(
+    bundle: dict,
+    *,
+    request_id: str = "",
+    chunk_pages: int = 4,
+    compress: bool = True,
+    compress_min_ratio: float = 0.9,
+    on_chunk=None,
+):
+    """Yield DTFH2 wire frames for ``bundle`` (header, CHNK*, CMIT).
+
+    Encoding is incremental: each chunk's page rows are gathered,
+    serialized and (maybe) compressed only when the consumer pulls the
+    frame, so a streaming sender overlaps encode with send. ``on_chunk``
+    (if given) is called per chunk with ``(wire_bytes, compressed,
+    encode_seconds)`` for metrics."""
+    header = _v2_header(bundle, request_id, chunk_pages)
+    meta = header["pages"]
+    head = json.dumps(header).encode()
+    yield _MAGIC_V2 + struct.pack("<I", len(head)) + head
+    layers = bundle["pages"]["layers"]
+    n_pages, cp = meta["n_pages"], meta["chunk_pages"]
+    n_chunks = meta["n_chunks"]
+    for k in range(n_chunks):
+        t0 = time.monotonic()
+        start, stop = k * cp, min((k + 1) * cp, n_pages)
+        raw = _chunk_payload(layers, start, stop,
+                             page_size=meta["page_size"],
+                             valid_rows=meta["valid_rows"])
+        # Tail elision on the wire: the zeroed invalid rows (and any
+        # genuinely zero suffix) are stripped; the receiver zero-pads
+        # back to the manifest size. CRC covers the stripped payload,
+        # so a short-but-CRC-valid chunk is a trim, never a truncation
+        # (real truncation breaks framing before it breaks CRC).
+        raw = raw.rstrip(b"\x00")
+        payload, flags = raw, 0
+        if compress and raw:
+            packed = zlib.compress(raw, 1)
+            # Skip-if-incompressible: ship compressed bytes only when
+            # the measured ratio clears the bar — dense int8 rows often
+            # won't, the zero tail of fresh pages will.
+            if len(packed) <= compress_min_ratio * len(raw):
+                payload, flags = packed, _FLAG_ZLIB
+        frame = (_CHNK + struct.pack("<I", len(payload))
+                 + struct.pack("<I", zlib.crc32(payload))
+                 + struct.pack("<B", flags) + payload)
+        if on_chunk is not None:
+            on_chunk(len(frame), bool(flags & _FLAG_ZLIB),
+                     time.monotonic() - t0)
+        yield frame
+    yield _CMIT + struct.pack("<I", n_chunks)
+
+
+def encode_bundle_v2(bundle: dict, *, request_id: str = "",
+                     chunk_pages: int = 4, compress: bool = True,
+                     compress_min_ratio: float = 0.9) -> bytes:
+    """Whole-buffer v2 encoding (tests and non-streaming callers)."""
+    return b"".join(iter_frames_v2(
+        bundle, request_id=request_id, chunk_pages=chunk_pages,
+        compress=compress, compress_min_ratio=compress_min_ratio))
+
+
+class ChunkAssembler:
+    """Incremental DTFH2 receiver: validate + decode one chunk at a time.
+
+    ``feed(payload, flags, crc)`` verifies the CRC over the wire payload
+    (before decompression — corruption is caught before any bytes are
+    trusted), decompresses if flagged, and returns ``(page_start,
+    page_stop, layer_rows)`` where ``layer_rows`` mirrors the bundle's
+    per-layer leaf dicts, each leaf shaped ``(pages_in_chunk,
+    *page_shape)``. ``finish(total_chunks)`` validates the commit frame.
+    Any violation raises :class:`HandoffCorrupt` — the caller must
+    abort, never import."""
+
+    def __init__(self, header: dict):
+        meta = header["pages"]
+        self.header = header
+        self.n_pages = int(meta["n_pages"])
+        self.chunk_pages = int(meta["chunk_pages"])
+        self.n_chunks = int(meta["n_chunks"])
+        self.manifest = meta["layers"]
+        self.fed = 0
+        if self.n_chunks != max(1, -(-self.n_pages // self.chunk_pages)):
+            raise HandoffCorrupt(
+                f"header chunk count {self.n_chunks} inconsistent with "
+                f"{self.n_pages} pages / {self.chunk_pages} per chunk")
+
+    def _leaf_nbytes(self, spec: dict, n_rows: int) -> int:
+        dt = np.dtype(spec["dtype"])
+        count = n_rows
+        for d in spec["page_shape"]:
+            count *= int(d)
+        return count * dt.itemsize
+
+    def feed(self, payload: bytes, flags: int, crc: int):
+        if self.fed >= self.n_chunks:
+            raise HandoffCorrupt("chunk after the final page group")
+        if zlib.crc32(payload) != crc:
+            raise HandoffCorrupt(f"chunk {self.fed}: CRC mismatch")
+        if flags & _FLAG_ZLIB:
+            try:
+                payload = zlib.decompress(payload)
+            except zlib.error as exc:
+                raise HandoffCorrupt(
+                    f"chunk {self.fed}: bad zlib stream ({exc})") from exc
+        start = self.fed * self.chunk_pages
+        stop = min(start + self.chunk_pages, self.n_pages)
+        n_rows = stop - start
+        expected = sum(
+            self._leaf_nbytes(entry[name], n_rows)
+            for entry in self.manifest for name in entry)
+        if len(payload) < expected:
+            # Sender-side tail elision stripped trailing zeros (rows
+            # past the slot's valid length) — reconstruct them. The CRC
+            # already validated the stripped payload, so a short chunk
+            # here is a trim by construction, not a truncation.
+            payload = payload + b"\x00" * (expected - len(payload))
+        layer_rows, off = [], 0
+        for entry in self.manifest:
+            layer = {}
+            for name in sorted(entry):
+                spec = entry[name]
+                nbytes = self._leaf_nbytes(spec, n_rows)
+                raw = payload[off:off + nbytes]
+                if len(raw) != nbytes:
+                    raise HandoffCorrupt(
+                        f"chunk {self.fed}: truncated leaf {name!r}")
+                layer[name] = np.frombuffer(
+                    raw, dtype=np.dtype(spec["dtype"])
+                ).reshape((n_rows, *spec["page_shape"]))
+                off += nbytes
+            layer_rows.append(layer)
+        if off != len(payload):
+            raise HandoffCorrupt(
+                f"chunk {self.fed}: {len(payload) - off} trailing bytes")
+        self.fed += 1
+        return start, stop, layer_rows
+
+    def finish(self, total_chunks: int) -> None:
+        if total_chunks != self.n_chunks or self.fed != self.n_chunks:
+            raise HandoffCorrupt(
+                f"commit for {total_chunks} chunks after {self.fed} fed "
+                f"(expected {self.n_chunks})")
+
+
+def decode_bundle_v2(data: bytes) -> dict:
+    """Whole-buffer inverse of :func:`encode_bundle_v2`: reassemble the
+    ``engine.import_slot`` bundle dict from a byte string of v2 frames."""
+    if data[: len(_MAGIC_V2)] != _MAGIC_V2:
+        raise HandoffCorrupt("not a v2 handoff stream (bad magic)")
+    off = len(_MAGIC_V2)
+    (head_len,) = struct.unpack_from("<I", data, off)
+    off += 4
+    header = json.loads(data[off:off + head_len])
+    off += head_len
+    asm = ChunkAssembler(header)
+    chunks: list[list[dict]] = []
+    committed = False
+    while off < len(data):
+        tag = data[off:off + 4]
+        off += 4
+        if tag == _CHNK:
+            (plen,) = struct.unpack_from("<I", data, off)
+            (crc,) = struct.unpack_from("<I", data, off + 4)
+            (flags,) = struct.unpack_from("<B", data, off + 8)
+            off += 9
+            payload = data[off:off + plen]
+            off += plen
+            if len(payload) != plen:
+                raise HandoffCorrupt("truncated chunk frame")
+            _, _, layer_rows = asm.feed(payload, flags, crc)
+            chunks.append(layer_rows)
+        elif tag == _CMIT:
+            (total,) = struct.unpack_from("<I", data, off)
+            off += 4
+            asm.finish(total)
+            committed = True
+            break
+        else:
+            raise HandoffCorrupt(f"unknown frame tag {tag!r}")
+    if not committed:
+        raise HandoffCorrupt(
+            f"stream ended after {asm.fed}/{asm.n_chunks} chunks "
+            "without a commit frame")
+    layers = []
+    for i in range(len(asm.manifest)):
+        layer = {}
+        for name in sorted(asm.manifest[i]):
+            layer[name] = np.concatenate([c[i][name] for c in chunks])
+        layers.append(layer)
+    bundle = {k: v for k, v in header.items() if k != "pages"}
+    bundle["pages"] = {
+        "n_pages": asm.n_pages,
+        "page_size": int(header["pages"]["page_size"]),
+        "layers": layers,
+    }
+    return bundle
+
+
+class LazyBundle:
+    """A slot export whose page gather is DEFERRED to the outbox worker.
+
+    ``bundle`` looks exactly like ``engine.export_slot``'s dict except
+    the page leaves are device arrays (``pool.snapshot_pages``): the
+    driver thread only dispatched the gathers, the worker pays the
+    device->host copy chunk by chunk while streaming."""
+
+    __slots__ = ("bundle",)
+
+    def __init__(self, bundle: dict):
+        self.bundle = bundle
+
+
 # -- SSE parsing -----------------------------------------------------------
 
 
@@ -176,12 +529,18 @@ def _iter_sse(resp):
 class HandoffOutbox:
     """Worker pool pushing handoff bundles to decode peers.
 
-    ``submit(bundle_bytes, request_id, callbacks)`` enqueues one push;
-    workers try peers round-robin with backoff, up to ``max_attempts``
-    total attempts. Callbacks (``on_accepted()``, ``on_tokens(list)``,
-    ``on_done(payload)``, ``on_failed(detail, accepted)``) fire on the
-    worker thread — the scheduler trampolines the ones that must touch
-    the engine back onto its driver thread via ``at_boundary``.
+    ``submit(payload, request_id, callbacks)`` enqueues one push —
+    ``payload`` is either v1 ``bytes`` (sent as one monolithic POST) or
+    a :class:`LazyBundle` (streamed as DTFH2 chunks with a one-chunk
+    encode-ahead pipeline). Workers try peers in pressure-score order
+    (round-robin when no probe data has been pushed) with backoff, up to
+    ``max_attempts`` total attempts; a peer that answers a typed 400 is
+    skipped for the remainder of that push — it rejected the LAYOUT and
+    will reject it again. Callbacks (``on_accepted()``,
+    ``on_tokens(list)``, ``on_done(payload)``, ``on_failed(detail,
+    accepted)``) fire on the worker thread — the scheduler trampolines
+    the ones that must touch the engine back onto its driver thread via
+    ``at_boundary``.
     """
 
     def __init__(
@@ -193,17 +552,30 @@ class HandoffOutbox:
         connect_timeout_s: float = 2.0,
         read_timeout_s: float = 120.0,
         workers: int = 2,
+        wire_version: int = 2,
+        chunk_pages: int = 4,
+        compress: bool = True,
+        compress_min_ratio: float = 0.9,
+        metrics=None,
     ):
-        self._peers: list[str] = [p.rstrip("/") for p in peers]
+        self._peers: list[str] = []
+        self._pressure: dict[str, dict] = {}
         self._rr = 0
         self._lock = threading.Lock()
         self.max_attempts = int(max_attempts)
         self.backoff_s = float(backoff_s)
         self.connect_timeout_s = float(connect_timeout_s)
         self.read_timeout_s = float(read_timeout_s)
+        self.wire_version = int(wire_version)
+        self.chunk_pages = max(1, int(chunk_pages))
+        self.compress = bool(compress)
+        self.compress_min_ratio = float(compress_min_ratio)
+        self.metrics = metrics
+        self._tp_ewma: dict[str, float] = {}
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._rng = random.Random(0)
+        self._set_peers_locked(peers)
         self._threads = [
             threading.Thread(
                 target=self._run, name=f"handoff-outbox-{i}", daemon=True
@@ -213,11 +585,33 @@ class HandoffOutbox:
         for t in self._threads:
             t.start()
 
-    # -- peer membership (fleet pushes updates) ---------------------------
+    # -- peer membership (fleet pushes updates + pressure) -----------------
 
-    def set_peers(self, urls) -> None:
+    def _set_peers_locked(self, peers) -> None:
+        urls, pressure = [], {}
+        for p in peers:
+            if isinstance(p, str):
+                if p:
+                    urls.append(p.rstrip("/"))
+            elif isinstance(p, dict) and p.get("url"):
+                url = str(p["url"]).rstrip("/")
+                urls.append(url)
+                info = {k: p[k] for k in
+                        ("pages_free", "pages_total", "queue_depth",
+                         "occupancy") if k in p}
+                if info:
+                    pressure[url] = info
+        self._peers = urls
+        self._pressure = pressure
+
+    def set_peers(self, peers) -> None:
+        """Replace the peer list. Entries are bare URLs or dicts
+        ``{"url": ..., "pages_free": ..., "pages_total": ...,
+        "queue_depth": ..., "occupancy": ...}`` — the fleet pushes the
+        dict form from its registry probes so peer choice can be
+        pressure-aware."""
         with self._lock:
-            self._peers = [u.rstrip("/") for u in urls if u]
+            self._set_peers_locked(peers)
 
     def peers(self) -> list[str]:
         with self._lock:
@@ -227,17 +621,54 @@ class HandoffOutbox:
         with self._lock:
             return bool(self._peers)
 
+    def _score(self, url: str, info, tp_max: float) -> float:
+        """Pressure score (higher = better target). Documented in the
+        module docstring and DESIGN.md §23 — keep the three in sync."""
+        s = 0.0
+        if info:
+            total = info.get("pages_total") or 0
+            if total:
+                s += float(info.get("pages_free") or 0) / float(total)
+            s -= 0.5 * float(info.get("occupancy") or 0.0)
+            s -= 0.05 * float(info.get("queue_depth") or 0)
+        ewma = self._tp_ewma.get(url, 0.0)
+        if ewma > 0.0 and tp_max > 0.0:
+            s += 0.25 * ewma / tp_max
+        return s
+
     def _next_peers(self) -> list[str]:
-        """Peer try-order for one push: round-robin rotated snapshot."""
+        """Peer try-order for one push: pressure-score descending when
+        the fleet has pushed probe data (or throughput history exists);
+        the original round-robin rotated snapshot otherwise."""
         with self._lock:
             if not self._peers:
                 return []
             self._rr = (self._rr + 1) % len(self._peers)
-            return self._peers[self._rr:] + self._peers[: self._rr]
+            rotated = self._peers[self._rr:] + self._peers[: self._rr]
+            if not self._pressure and not self._tp_ewma:
+                return rotated
+            tp_max = max(self._tp_ewma.values(), default=0.0)
+            scores = {u: self._score(u, self._pressure.get(u), tp_max)
+                      for u in rotated}
+        # Stable sort: equal scores keep the rotated (fair) order.
+        return sorted(rotated, key=lambda u: -scores[u])
+
+    def _record_throughput(self, peer: str, nbytes: int,
+                           seconds: float) -> None:
+        if seconds <= 0.0 or nbytes <= 0:
+            return
+        bps = nbytes / seconds
+        with self._lock:
+            prev = self._tp_ewma.get(peer)
+            self._tp_ewma[peer] = (
+                bps if prev is None else 0.3 * bps + 0.7 * prev)
+            val = self._tp_ewma[peer]
+        if self.metrics is not None:
+            self.metrics.record_handoff_throughput(peer, val)
 
     # -- push lifecycle ----------------------------------------------------
 
-    def submit(self, payload: bytes, request_id: str, callbacks) -> None:
+    def submit(self, payload, request_id: str, callbacks) -> None:
         self._q.put((payload, request_id, callbacks))
 
     def _run(self) -> None:
@@ -264,72 +695,191 @@ class HandoffOutbox:
             max_delay=max(self.backoff_s * 8, self.backoff_s),
             jitter=0.25, rng=self._rng))
 
-    def _push(self, payload: bytes, request_id: str, cb) -> None:
+    def _push(self, payload, request_id: str, cb) -> None:
+        """Try peers until one accepts. A peer that answered a typed 400
+        is deprioritized for the remainder of THIS push (the old
+        ``_next_peers() * max_attempts`` loop burned attempts re-offering
+        a layout the peer already refused) — but only while another
+        candidate exists: a 400 can also mean a corrupted TRANSFER
+        (CRC/framing), which a clean re-send to the same peer recovers,
+        so when every peer has rejected once the ban resets instead of
+        abandoning the push with attempts left."""
+        order = self._next_peers()
         last = "no decode peer configured"
+        rejected: set[str] = set()
         attempts = 0
-        for peer in self._next_peers() * self.max_attempts:
-            if attempts >= self.max_attempts:
-                break
+        while attempts < self.max_attempts:
+            candidates = [p for p in order if p not in rejected]
+            if not candidates:
+                if not order:
+                    break
+                rejected.clear()
+                candidates = list(order)
+            peer = candidates[attempts % len(candidates)]
             attempts += 1
-            body = payload
-            if faults.fire("handoff_corrupt"):
-                # Bit-flip inside the DTFH1 magic: the peer's
-                # decode_bundle must reject the bundle as a typed 400 —
-                # garbage pages never get imported.
-                corrupt = bytearray(body)
-                corrupt[2] ^= 0xFF
-                body = bytes(corrupt)
-            parsed = urllib.parse.urlsplit(peer)
-            conn = http.client.HTTPConnection(
-                parsed.hostname, parsed.port,
-                timeout=self.connect_timeout_s)
-            try:
-                faults.maybe_fail("handoff_send_timeout", peer)
-                conn.request(
-                    "POST", "/handoff", body=body,
-                    headers={"Content-Type": "application/octet-stream"})
-                conn.sock.settimeout(self.read_timeout_s)
-                resp = conn.getresponse()
-                if resp.status != 200:
-                    last = (f"{peer}: HTTP {resp.status} "
-                            f"{resp.read(256)[:256]!r}")
-                    self._backoff(attempts)
-                    continue
-                ctype = resp.getheader("Content-Type", "")
-                if not ctype.startswith("text/event-stream"):
-                    last = f"{peer}: unexpected Content-Type {ctype!r}"
-                    continue
-                accepted = False
-                for event, obj in _iter_sse(resp):
-                    if not accepted:
-                        # First frame = the peer imported the pages and
-                        # is decoding: the exporter may release its slot.
-                        accepted = True
-                        cb.on_accepted(peer)
-                    if event == "token":
-                        cb.on_tokens(obj.get("tokens", []))
-                    elif event == "done":
-                        if "error" in obj:
-                            cb.on_failed(
-                                f"{peer}: {obj['error']}", True)
-                        else:
-                            cb.on_done(obj)
-                        return
-                    elif event == "error":
-                        cb.on_failed(f"{peer}: {obj}", True)
-                        return
-                if accepted:
-                    # Stream cut mid-decode: the pages died with the
-                    # peer — typed error, never silently dropped.
-                    cb.on_failed(f"{peer}: stream ended early", True)
-                    return
-                last = f"{peer}: empty stream before accept"
-            except (OSError, http.client.HTTPException) as exc:
-                last = f"{peer}: {exc!r}"
+            outcome, detail = self._try_peer(peer, payload, request_id, cb)
+            if outcome == "done":
+                return
+            last = detail
+            if outcome == "rejected":
+                rejected.add(peer)
+            elif outcome == "retry":
                 self._backoff(attempts)
-            finally:
-                conn.close()
         cb.on_failed(last, False)
+
+    def _request_v1(self, conn, body: bytes) -> int:
+        if faults.fire("handoff_corrupt"):
+            # Bit-flip inside the DTFH1 magic: the peer's decode_bundle
+            # must reject the bundle as a typed 400 — garbage pages
+            # never get imported.
+            corrupt = bytearray(body)
+            corrupt[2] ^= 0xFF
+            body = bytes(corrupt)
+        conn.request(
+            "POST", "/handoff", body=body,
+            headers={"Content-Type": "application/octet-stream"})
+        if self.metrics is not None:
+            self.metrics.record_handoff_bytes(len(body), compressed=False)
+        return len(body)
+
+    def _request_v2(self, conn, lazy: LazyBundle, request_id: str) -> int:
+        """Streamed chunked POST with a one-frame encode-ahead pipeline:
+        a feeder thread encodes chunk k+1 while the socket drains chunk
+        k. Returns total wire bytes."""
+        metrics = self.metrics
+        sent = [0]
+        compressed_any = [False]
+
+        def on_chunk(nbytes, was_compressed, encode_s):
+            compressed_any[0] = compressed_any[0] or was_compressed
+            if metrics is not None:
+                metrics.record_handoff_chunk_ms(encode_s * 1e3)
+
+        corrupt = faults.fire("handoff_corrupt")
+        frames: queue.Queue = queue.Queue(maxsize=2)
+        feeder_err: list[BaseException] = []
+        abort = threading.Event()
+
+        def feed():
+            try:
+                first_chunk = True
+                for frame in iter_frames_v2(
+                        lazy.bundle, request_id=request_id,
+                        chunk_pages=self.chunk_pages,
+                        compress=self.compress,
+                        compress_min_ratio=self.compress_min_ratio,
+                        on_chunk=on_chunk):
+                    if corrupt and frame[:4] == _CHNK and first_chunk:
+                        # Flip a payload byte AFTER the CRC was stamped:
+                        # the peer must catch it pre-import (typed 400).
+                        first_chunk = False
+                        broken = bytearray(frame)
+                        broken[-1] ^= 0xFF
+                        frame = bytes(broken)
+                    while not abort.is_set():
+                        try:
+                            frames.put(frame, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if abort.is_set():
+                        return
+            except BaseException as exc:  # noqa: BLE001 — relay to sender
+                feeder_err.append(exc)
+            finally:
+                while not abort.is_set():
+                    try:
+                        frames.put(None, timeout=0.2)
+                        return
+                    except queue.Full:
+                        continue
+
+        feeder = threading.Thread(target=feed, daemon=True,
+                                  name="handoff-encode")
+        feeder.start()
+
+        def body():
+            while True:
+                frame = frames.get()
+                if frame is None:
+                    if feeder_err:
+                        raise feeder_err[0]
+                    return
+                sent[0] += len(frame)
+                yield frame
+
+        try:
+            conn.request(
+                "POST", "/handoff", body=body(), encode_chunked=True,
+                headers={"Content-Type": "application/octet-stream",
+                         "Transfer-Encoding": "chunked"})
+        finally:
+            abort.set()
+            feeder.join(timeout=5.0)
+        if metrics is not None:
+            metrics.record_handoff_bytes(
+                sent[0], compressed=compressed_any[0])
+        return sent[0]
+
+    def _try_peer(self, peer: str, payload, request_id: str, cb):
+        """One attempt against one peer. Returns ``(outcome, detail)``:
+        ``done`` (callbacks delivered a terminal state), ``rejected``
+        (typed 400 — skip this peer for the rest of the push), or
+        ``retry`` (transport/overload — another peer may take it)."""
+        parsed = urllib.parse.urlsplit(peer)
+        conn = http.client.HTTPConnection(
+            parsed.hostname, parsed.port,
+            timeout=self.connect_timeout_s)
+        try:
+            faults.maybe_fail("handoff_send_timeout", peer)
+            t0 = time.monotonic()
+            if isinstance(payload, LazyBundle):
+                nbytes = self._request_v2(conn, payload, request_id)
+            else:
+                nbytes = self._request_v1(conn, payload)
+            conn.sock.settimeout(self.read_timeout_s)
+            resp = conn.getresponse()
+            self._record_throughput(peer, nbytes, time.monotonic() - t0)
+            if resp.status != 200:
+                detail = (f"{peer}: HTTP {resp.status} "
+                          f"{resp.read(256)[:256]!r}")
+                if resp.status == 400:
+                    # The peer REFUSED the layout (bad magic, kv_dtype
+                    # mismatch, CRC) — re-offering the same bytes cannot
+                    # succeed, so it is out for this push.
+                    return "rejected", detail
+                return "retry", detail
+            ctype = resp.getheader("Content-Type", "")
+            if not ctype.startswith("text/event-stream"):
+                return "other", f"{peer}: unexpected Content-Type {ctype!r}"
+            accepted = False
+            for event, obj in _iter_sse(resp):
+                if not accepted:
+                    # First frame = the peer imported the pages and is
+                    # decoding: the exporter may release its slot.
+                    accepted = True
+                    cb.on_accepted(peer)
+                if event == "token":
+                    cb.on_tokens(obj.get("tokens", []))
+                elif event == "done":
+                    if "error" in obj:
+                        cb.on_failed(f"{peer}: {obj['error']}", True)
+                    else:
+                        cb.on_done(obj)
+                    return "done", ""
+                elif event == "error":
+                    cb.on_failed(f"{peer}: {obj}", True)
+                    return "done", ""
+            if accepted:
+                # Stream cut mid-decode: the pages died with the peer —
+                # typed error, never silently dropped.
+                cb.on_failed(f"{peer}: stream ended early", True)
+                return "done", ""
+            return "other", f"{peer}: empty stream before accept"
+        except (OSError, http.client.HTTPException) as exc:
+            return "retry", f"{peer}: {exc!r}"
+        finally:
+            conn.close()
 
     def stop(self) -> None:
         self._stop.set()

@@ -70,6 +70,11 @@ class ProbeResult:
     queue_depth: int = 0
     occupancy: float = 0.0
     shed_total: float = 0.0
+    # Page capacity under the paged KV layout (healthz pages_free/
+    # pages_total) — the real admission gate on a decode tier, and the
+    # dominant term of the handoff outbox's pressure-aware peer score.
+    pages_free: int = 0
+    pages_total: int = 0
     tp: int = 1              # tensor-parallel width of the replica's mesh
     devices: int = 1         # devices it spans — a tp-wide replica is ONE
     #                          replica, not tp independent ones
@@ -217,6 +222,8 @@ def http_probe(base_url: str, timeout_s: float = 2.0) -> ProbeResult:
         weight_dtype=str(body.get("weight_dtype", "")),
         kv_dtype=str(body.get("kv_dtype", "")),
         role=str(body.get("role", "mixed") or "mixed"),
+        pages_free=int(body.get("pages_free", 0)),
+        pages_total=int(body.get("pages_total", 0)),
     )
     deploy = body.get("deploy", {})
     if isinstance(deploy, dict):
@@ -313,6 +320,11 @@ class ReplicaRegistry:
         self._g_shed = r.gauge(
             "fleet_replica_shed_total",
             "Scraped serve_shed_total per replica (rate = shed rate).",
+            labels=("replica",))
+        self._g_pages_free = r.gauge(
+            "fleet_replica_pages_free",
+            "Scraped free KV pages per replica (paged layout; the "
+            "decode-tier capacity the handoff peer score keys on).",
             labels=("replica",))
         self._g_up = r.gauge(
             "fleet_up_replicas", "Replicas currently in state up.")
@@ -558,6 +570,8 @@ class ReplicaRegistry:
             self._g_queue.labels(replica=rid).set(float(r.last.queue_depth))
             self._g_inflight.labels(replica=rid).set(float(r.inflight))
             self._g_shed.labels(replica=rid).set(r.last.shed_total)
+            self._g_pages_free.labels(replica=rid).set(
+                float(r.last.pages_free))
             if r.state == "up":
                 up += 1
                 capacity += r.last.slots
@@ -602,6 +616,8 @@ class ReplicaRegistry:
                         "weight_dtype": r.last.weight_dtype,
                         "kv_dtype": r.last.kv_dtype,
                         "role": r.last.role,
+                        "pages_free": r.last.pages_free,
+                        "pages_total": r.last.pages_total,
                         "weight_version": r.last.weight_version,
                         "serving_variant": r.last.serving_variant,
                         "variants": list(r.last.variants),

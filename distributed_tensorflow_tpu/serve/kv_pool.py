@@ -56,6 +56,21 @@ __all__ = [
 TRASH_PAGE = 0
 
 
+# Fused page scatter for the chunk-streamed handoff import: one jitted
+# dispatch updates every leaf of every layer, so the driver-thread block
+# per staged chunk is bounded by a single program launch instead of
+# layers x leaves eager dispatches (that per-leaf loop is exactly what
+# makes the v1 monolithic import a long stall on deep models). Donation
+# recycles the pool buffers in place; the caller rebinds ``pool.layers``
+# to the result immediately, which is what makes donation safe.
+def _page_scatter(layers, idx, rows):
+    return jax.tree_util.tree_map(
+        lambda buf, r: buf.at[idx].set(r), layers, rows)
+
+
+_fused_page_scatter = jax.jit(_page_scatter, donate_argnums=(0,))
+
+
 class InsufficientPages(RuntimeError):
     """Admission-time: the pool cannot back this request right now. The
     scheduler requeues the request at the head of its lane — pages free as
@@ -387,6 +402,68 @@ class PagedKVPool:
             "page_size": self.page_size,
             "layers": layers,
         }
+
+    def snapshot_pages(self, slot: int) -> dict:
+        """Like :meth:`export_pages`, but DEFERRED: the per-leaf gathers
+        are dispatched (``buf[idx]`` — fresh device arrays, nothing
+        donated) and returned WITHOUT a device->host copy. The driver
+        thread pays only op dispatch; a streaming sender slices and
+        ``device_get``s the snapshot chunk by chunk off-thread. The
+        snapshot arrays are private copies, so they stay valid across
+        later engine steps even though ``self.layers`` is donated."""
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        row = self.page_tables[slot]
+        bound = [int(pid) for pid in row if pid != TRASH_PAGE]
+        idx = np.asarray(bound, np.int32)
+        layers = [
+            {name: buf[idx] for name, buf in layer.items()}
+            for layer in self.layers
+        ]
+        return {
+            "n_pages": len(bound),
+            "page_size": self.page_size,
+            "layers": layers,
+        }
+
+    def scatter_pages(self, page_ids, layer_rows) -> None:
+        """Write foreign page rows into already-allocated pages — the
+        incremental half of :meth:`import_pages`. ``layer_rows`` mirrors
+        the pool's per-layer leaf dicts with a leading axis of
+        ``len(page_ids)``. Must run on the engine driver thread (the
+        functional ``self.layers`` swap races concurrent mutators
+        otherwise); dtype mismatches raise before any buffer changes.
+
+        Single-device pools take the FUSED path: one jitted dispatch
+        updates all ``layers x leaves`` buffers (with donation), so the
+        driver block per staged handoff chunk stays a single program
+        launch however deep the model is. Sharded pools keep the eager
+        per-leaf loop — the update rows must be placed with
+        ``kv_sharding`` first, and a handoff import onto a sharded pool
+        is already guarded upstream."""
+        idx = np.asarray(list(page_ids), np.int32)
+        if self.kv_sharding is None:
+            rows = [
+                {name: np.asarray(src[name], dtype=layer[name].dtype)
+                 for name in layer}
+                for layer, src in zip(self.layers, layer_rows)
+            ]
+            self.layers = _fused_page_scatter(self.layers, idx, rows)
+            return
+        new_layers = []
+        for layer, src in zip(self.layers, layer_rows):
+            new_layer = {}
+            for name, buf in layer.items():
+                rows = np.asarray(src[name], dtype=buf.dtype)
+                rows = jax.device_put(rows, self.kv_sharding)
+                new_layer[name] = buf.at[idx].set(rows)
+            new_layers.append(new_layer)
+        self.layers = new_layers
+
+    def free_pages(self, page_ids) -> None:
+        """Decref a list of pages (abort path of a staged import)."""
+        for pid in page_ids:
+            self.decref(int(pid))
 
     def import_pages(self, slot: int, payload: dict) -> list[int]:
         """Write a foreign page payload into fresh pages and bind ``slot``.

@@ -207,6 +207,42 @@ class ServingMetrics:
             "KV-page handoff events between serving tiers, by outcome.",
             labels=("outcome",))
         self._handoff_outcomes: set = set()
+        # Handoff fast-path instruments (PR 17). Bytes-on-wire split by
+        # whether any chunk shipped zlib-compressed; per-chunk encode
+        # latency; a per-peer EWMA of observed transfer throughput (the
+        # outbox's own pushes feed it — the same number its peer score
+        # consumes); and the driver-thread stall each side pays per
+        # handoff event (export capture / import scatter), as both a
+        # cumulative total and a worst-single-event gauge so the bench
+        # can gate v2's bounded per-chunk stall against v1's whole-slot
+        # block.
+        self._handoff_bytes = r.counter(
+            "fleet_handoff_bytes_total",
+            "Handoff bytes on the wire, by compression.",
+            labels=("compressed",))
+        self._handoff_chunk_ms = r.histogram(
+            "fleet_handoff_chunk_ms",
+            "Per-chunk encode time of streamed handoff bundles (ms).",
+            maxlen=n)
+        self._handoff_tp = r.gauge(
+            "fleet_handoff_throughput_bytes_per_s",
+            "EWMA of observed handoff transfer throughput, per peer.",
+            labels=("peer",))
+        self._handoff_peers: set = set()
+        self._handoff_stall_total = r.counter(
+            "serve_handoff_stall_seconds_total",
+            "Cumulative driver-thread block spent on handoff transfers, "
+            "by side (export | import | commit).",
+            labels=("side",))
+        self._handoff_stall_max = r.gauge(
+            "serve_handoff_stall_max_seconds",
+            "Worst single driver-thread block of one handoff event, "
+            "by side (export | import | commit).",
+            labels=("side",))
+        self._handoff_stall_counts = r.counter(
+            "serve_handoff_stall_events_total",
+            "Handoff driver-stall events recorded, by side.",
+            labels=("side",))
         self._variant_names: set = set()
         # Dtype strings mirrored out of the engine at sync time; ride
         # the snapshot (loadgen's report) since gauges hold floats.
@@ -257,6 +293,49 @@ class ServingMetrics:
 
     def handoff_count(self, outcome: str) -> int:
         return int(self._handoff.labels(outcome=str(outcome)).value)
+
+    def record_handoff_bytes(self, nbytes: int, *, compressed: bool) -> None:
+        label = "true" if compressed else "false"
+        self._handoff_bytes.labels(compressed=label).inc(int(nbytes))
+
+    def handoff_bytes(self) -> dict:
+        return {
+            label: int(self._handoff_bytes.labels(compressed=label).value)
+            for label in ("true", "false")
+        }
+
+    def record_handoff_chunk_ms(self, ms: float) -> None:
+        self._handoff_chunk_ms.observe(float(ms))
+
+    def record_handoff_throughput(self, peer: str, bps: float) -> None:
+        self._handoff_peers.add(str(peer))
+        self._handoff_tp.labels(peer=str(peer)).set(float(bps))
+
+    def record_handoff_stall(self, side: str, seconds: float) -> None:
+        """One driver-thread block attributable to a handoff transfer:
+        export capture on the prefill tier, an import scatter event on
+        the decode tier (v1 pays one whole-slot event, v2 one per
+        chunk), and — v2 only — the post-transfer ``commit`` block
+        (slot acquire + bind + register adoption), which is the only
+        decode-tier stall left AFTER the last wire byte arrives."""
+        side = str(side)
+        seconds = max(0.0, float(seconds))
+        self._handoff_stall_total.labels(side=side).inc(seconds)
+        self._handoff_stall_counts.labels(side=side).inc()
+        with self._peak_lock:
+            if seconds > self._handoff_stall_max.labels(side=side).value:
+                self._handoff_stall_max.labels(side=side).set(seconds)
+
+    def handoff_stall(self, side: str) -> dict:
+        side = str(side)
+        return {
+            "total_s": float(
+                self._handoff_stall_total.labels(side=side).value),
+            "max_s": float(
+                self._handoff_stall_max.labels(side=side).value),
+            "events": int(
+                self._handoff_stall_counts.labels(side=side).value),
+        }
 
     def record_swap(self, outcome: str) -> None:
         """Count one hot-swap attempt (``"ok"`` or ``"rollback"``)."""
@@ -419,6 +498,16 @@ class ServingMetrics:
             "handoff": {
                 o: self.handoff_count(o)
                 for o in sorted(self._handoff_outcomes)
+            },
+            "handoff_bytes": self.handoff_bytes(),
+            "handoff_chunk_ms": self._handoff_chunk_ms.summary(),
+            "handoff_stall": {
+                side: self.handoff_stall(side)
+                for side in ("export", "import", "commit")
+            },
+            "handoff_throughput_bytes_per_s": {
+                p: float(self._handoff_tp.labels(peer=p).value)
+                for p in sorted(self._handoff_peers)
             },
             "swaps": {
                 "ok": self.swap_count("ok"),

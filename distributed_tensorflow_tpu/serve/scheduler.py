@@ -756,16 +756,28 @@ class Scheduler:
 
     def _begin_handoff(self, slot: int, fl: _InFlight) -> None:
         from distributed_tensorflow_tpu.serve.fleet.handoff import (
+            LazyBundle,
             encode_bundle,
         )
 
         r = fl.pending.request
         history = [int(t) for t in r.prompt] + [int(t) for t in fl.tokens]
+        t0 = time.monotonic()
+        use_v2 = int(getattr(self.handoff, "wire_version", 1)) >= 2
         try:
-            bundle = self.engine.export_slot(slot, history=history)
+            if use_v2:
+                # v2: snapshot the page arrays (dispatch-only device
+                # gathers) and let the outbox worker gather/encode each
+                # chunk off-thread — the driver stalls only for the
+                # snapshot, not the full host copy + serialize.
+                bundle = self.engine.export_slot_meta(slot,
+                                                      history=history)
+            else:
+                bundle = self.engine.export_slot(slot, history=history)
         except RuntimeError:
             return  # not exportable right now; keep decoding locally
-        payload = encode_bundle(bundle, request_id=r.request_id)
+        payload = (LazyBundle(bundle) if use_v2
+                   else encode_bundle(bundle, request_id=r.request_id))
         # Park: decode stops (active masked off) but registers + pages
         # stay intact, and the pool still owns the slot — nothing can
         # re-acquire it until release() or a fallback reactivates it.
@@ -774,6 +786,8 @@ class Scheduler:
         self._parked[slot] = fl
         if self.metrics is not None:
             self.metrics.record_handoff("export")
+            self.metrics.record_handoff_stall(
+                "export", time.monotonic() - t0)
         self.handoff.submit(payload, r.request_id,
                             _HandoffCallbacks(self, slot, fl))
 
@@ -850,24 +864,7 @@ class Scheduler:
         ``insufficient_pages`` / ``shutting_down``) so the pushing side
         can try another peer or fall back to local decode."""
         now = self.clock()
-        history = [int(t) for t in bundle.get("history") or []]
-        made = int(bundle.get("made", 0))
-        prompt = tuple(history[: max(1, len(history) - made)]) or (0,)
-        request = Request(
-            prompt=prompt,
-            max_new_tokens=max(1, int(bundle.get("budget", 1)) - made),
-            temperature=float(bundle.get("temperature", 0.0)),
-            top_k=int(bundle.get("top_k", 0)),
-            top_p=float(bundle.get("top_p", 0.0)),
-            seed=int(bundle.get("seed", 0)),
-            eos_id=(None if bundle.get("eos") is None
-                    else int(bundle["eos"])),
-            request_id=str(bundle.get("request_id")
-                           or f"h{next(self._ids)}"),
-            stream=True,
-        )
-        pending = PendingRequest(request=request, submitted_at=now)
-        pending._stream_q = _queue.Queue()
+        pending = self._handoff_pending(bundle, now)
         with self._lock:
             if not self._accepting:
                 pending.finish(
@@ -890,6 +887,38 @@ class Scheduler:
             self._handoff_inbox.append((bundle, pending))
         return pending
 
+    def _handoff_pending(self, bundle: dict, now: float) -> PendingRequest:
+        """Build the always-streaming PendingRequest a handoff bundle's
+        registers describe (shared by the v1 inbox and v2 sessions)."""
+        history = [int(t) for t in bundle.get("history") or []]
+        made = int(bundle.get("made", 0))
+        prompt = tuple(history[: max(1, len(history) - made)]) or (0,)
+        request = Request(
+            prompt=prompt,
+            max_new_tokens=max(1, int(bundle.get("budget", 1)) - made),
+            temperature=float(bundle.get("temperature", 0.0)),
+            top_k=int(bundle.get("top_k", 0)),
+            top_p=float(bundle.get("top_p", 0.0)),
+            seed=int(bundle.get("seed", 0)),
+            eos_id=(None if bundle.get("eos") is None
+                    else int(bundle["eos"])),
+            request_id=str(bundle.get("request_id")
+                           or f"h{next(self._ids)}"),
+            stream=True,
+        )
+        pending = PendingRequest(request=request, submitted_at=now)
+        pending._stream_q = _queue.Queue()
+        return pending
+
+    def open_handoff_import(self, header: dict) -> "HandoffImportSession":
+        """Decode-tier entry (HTTP handler thread) for a CHUNKED v2
+        handoff: returns a session whose reserve/feed/commit/abort stage
+        pages in behind the all-or-nothing contract — pages are alloc'd
+        up front and scattered chunk-by-chunk as frames arrive, but the
+        slot is acquired and bound only at commit, so any earlier
+        failure leaves the decode tier exactly as it was."""
+        return HandoffImportSession(self, header)
+
     def _admit_handoffs(self, now: float) -> None:
         """Driver thread: import queued handoff bundles into free slots.
         Imports happen BEFORE fresh admissions — a handed-off request
@@ -908,6 +937,7 @@ class Scheduler:
                 self._reject_handoff(pending, "queue_full",
                                      "no free slot on decode tier")
                 continue
+            t0 = time.monotonic()
             try:
                 self.engine.import_slot(slot, bundle)
             except InsufficientPages as exc:
@@ -927,6 +957,10 @@ class Scheduler:
                                              "", wv)
             if self.metrics is not None:
                 self.metrics.record_handoff("import")
+                # Monolithic import blocks this driver iteration for the
+                # full scatter — the baseline the v2 staged path beats.
+                self.metrics.record_handoff_stall(
+                    "import", time.monotonic() - t0)
 
     def _reject_handoff(self, pending: PendingRequest, reason: str,
                         detail: str) -> None:
@@ -1152,3 +1186,197 @@ class _HandoffCallbacks:
         else:
             self.sched.at_boundary(
                 lambda: self.sched._handoff_fallback(self.slot, detail))
+
+
+class HandoffImportError(RuntimeError):
+    """Typed failure of a staged (v2) import. ``reason`` uses the
+    scheduler's rejection vocabulary (``invalid`` / ``queue_full`` /
+    ``insufficient_pages`` / ``shutting_down``) so the server maps it
+    straight to an HTTP status and the sender to retry-vs-fallback."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(detail or reason)
+        self.reason = reason
+        self.detail = detail
+
+
+class HandoffImportSession:
+    """Decode-side staged import of ONE chunk-streamed (DTFH2) handoff.
+
+    Driven from an HTTP handler thread; every engine/pool mutation
+    trampolines onto the driver thread via ``at_boundary`` (the pool's
+    leaves are donated by the jitted step — only the driver may touch
+    them between rounds):
+
+    * ``reserve()`` — validate the header and ALLOCATE (not bind) the
+      page ids. Blocks only the handler thread; failures are typed.
+    * ``feed(start, stop, layer_rows)`` — enqueue one chunk's rows for
+      scatter at the next iteration boundary and return immediately:
+      the network transfer overlaps live decode rounds, and each
+      scatter's driver time is recorded as receiver-side import stall.
+    * ``commit()`` — after the CMIT frame: on the driver, acquire a
+      slot, bind the staged pages, adopt registers, and return the
+      always-streaming :class:`PendingRequest`. Typed failure frees the
+      staged pages — the all-or-nothing contract holds because nothing
+      was bound or activated before this point.
+    * ``abort()`` — free the staged pages (idempotent; call on any
+      handler-side error or disconnect before commit).
+    """
+
+    __slots__ = ("sched", "header", "n_pages", "pages", "committed",
+                 "aborted", "_scatter_err")
+
+    def __init__(self, sched: Scheduler, header: dict):
+        self.sched = sched
+        self.header = header
+        try:
+            self.n_pages = int(header["pages"]["n_pages"])
+        except (KeyError, TypeError, ValueError) as exc:
+            raise HandoffImportError(
+                "invalid", f"malformed v2 header: {exc}") from exc
+        self.pages: list[int] | None = None
+        self.committed = False
+        self.aborted = False
+        self._scatter_err: list = [None]
+
+    def _fail(self, reason: str, detail: str):
+        if self.sched.metrics is not None:
+            self.sched.metrics.record_handoff("import_rejected")
+        raise HandoffImportError(reason, detail)
+
+    def reserve(self, timeout_s: float = 30.0) -> None:
+        sched = self.sched
+        try:
+            sched.engine.validate_handoff_header(self.header)
+        except (ValueError, RuntimeError) as exc:
+            self._fail("invalid", str(exc))
+        pps = getattr(sched.engine.pool, "pages_per_slot", self.n_pages)
+        if self.n_pages > pps:
+            self._fail("invalid",
+                       f"{self.n_pages} pages > pages_per_slot {pps}")
+        with sched._lock:
+            if not sched._accepting:
+                self._fail("shutting_down",
+                           "scheduler is draining" if sched._draining
+                           else "scheduler is stopping")
+        done = threading.Event()
+        box: dict = {}
+
+        def op():
+            box["pages"] = sched.engine.pool.alloc_pages(self.n_pages)
+            done.set()
+
+        sched.at_boundary(op)
+        if not done.wait(timeout_s):
+            self._fail("queue_full",
+                       "reserve timed out waiting for the driver")
+        if box["pages"] is None:
+            self._fail("insufficient_pages",
+                       f"{self.n_pages} pages requested, "
+                       f"{sched.engine.pool.pages_free} free")
+        self.pages = box["pages"]
+
+    def feed(self, start: int, stop: int, layer_rows) -> None:
+        sched = self.sched
+        pages = self.pages[start:stop]
+        err = self._scatter_err
+
+        def op():
+            if err[0] is not None or self.aborted:
+                return
+            t0 = time.monotonic()
+            try:
+                sched.engine.pool.scatter_pages(pages, layer_rows)
+            except Exception as exc:  # surfaces as typed reject at commit
+                err[0] = exc
+                return
+            if sched.metrics is not None:
+                sched.metrics.record_handoff_stall(
+                    "import", time.monotonic() - t0)
+
+        sched.at_boundary(op)
+
+    def commit(self, timeout_s: float = 30.0) -> PendingRequest:
+        sched = self.sched
+        done = threading.Event()
+        box: dict = {}
+
+        def op():
+            try:
+                box["pending"] = self._commit_on_driver()
+            except HandoffImportError as exc:
+                box["err"] = exc
+            except Exception as exc:
+                box["err"] = HandoffImportError("invalid", str(exc))
+            finally:
+                done.set()
+
+        sched.at_boundary(op)
+        if not done.wait(timeout_s):
+            self._fail("queue_full",
+                       "commit timed out waiting for the driver")
+        if "err" in box:
+            self._fail(box["err"].reason, box["err"].detail)
+        self.committed = True
+        return box["pending"]
+
+    def _commit_on_driver(self) -> PendingRequest:
+        """Driver thread (boundary): the ordered-deque guarantee means
+        every queued chunk scatter already ran when this executes. The
+        whole block is timed as the ``commit`` stall side — it is the
+        only decode-visible stall left after the last wire byte (the
+        chunk scatters overlapped the transfer), which is what the
+        handoff perf bench gates against v1's post-transfer import."""
+        t0 = time.monotonic()
+        sched = self.sched
+        pages, self.pages = self.pages, None
+        if self._scatter_err[0] is not None:
+            sched.engine.pool.free_pages(pages)
+            raise HandoffImportError(
+                "invalid", f"chunk scatter failed: {self._scatter_err[0]}")
+        with sched._lock:
+            accepting, draining = sched._accepting, sched._draining
+        if not accepting:
+            sched.engine.pool.free_pages(pages)
+            raise HandoffImportError(
+                "shutting_down",
+                "scheduler is draining" if draining
+                else "scheduler is stopping")
+        slot = sched.engine.acquire_slot()
+        if slot is None:
+            sched.engine.pool.free_pages(pages)
+            raise HandoffImportError("queue_full",
+                                     "no free slot on decode tier")
+        try:
+            sched.engine.validate_handoff_header(self.header)
+        except Exception as exc:  # config changed mid-transfer (hot swap)
+            sched.engine.pool.free_pages(pages)
+            sched.engine.release(slot)
+            raise HandoffImportError("invalid", str(exc)) from exc
+        try:
+            sched.engine.adopt_imported_slot(slot, self.header, pages)
+        except Exception as exc:
+            # bind landed (or raised before touching the slot's row) —
+            # release() frees whatever got bound.
+            sched.engine.release(slot)
+            raise HandoffImportError("invalid", str(exc)) from exc
+        now = sched.clock()
+        pending = sched._handoff_pending(self.header, now)
+        wv = int(getattr(sched.engine, "weight_version", 0))
+        # ttft_s=0.0 (not None): first token already served by prefill.
+        sched._inflight[slot] = _InFlight(pending, None, now, 0.0, "", wv)
+        if sched.metrics is not None:
+            sched.metrics.record_handoff("import")
+            sched.metrics.record_handoff_stall(
+                "commit", time.monotonic() - t0)
+        return pending
+
+    def abort(self) -> None:
+        if self.committed or self.aborted:
+            return
+        self.aborted = True
+        pages, self.pages = self.pages, None
+        if pages:
+            sched = self.sched
+            sched.at_boundary(
+                lambda: sched.engine.pool.free_pages(pages))

@@ -78,6 +78,7 @@ Endpoints:
 from __future__ import annotations
 
 import json
+import struct
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -99,6 +100,79 @@ _REJECTION_STATUS = {
     "insufficient_pages": 503,
     "upstream_died": 503,
 }
+
+
+class _ChunkedReader:
+    """Minimal HTTP/1.1 chunked-transfer decoder over the handler's
+    ``rfile`` — the stdlib handler does not de-chunk request bodies, and
+    the v2 handoff sender streams frames with ``Transfer-Encoding:
+    chunked`` (total size unknown while encoding overlaps sending)."""
+
+    def __init__(self, rfile):
+        self.rfile = rfile
+        self.remaining = 0  # data bytes left in the current HTTP chunk
+        self.eof = False
+
+    def _next_chunk(self) -> None:
+        line = self.rfile.readline(1024)
+        if line in (b"\r\n", b"\n"):  # CRLF terminating the previous chunk
+            line = self.rfile.readline(1024)
+        if not line:
+            self.eof = True
+            return
+        try:
+            size = int(line.strip().split(b";")[0], 16)
+        except ValueError:
+            self.eof = True
+            return
+        if size == 0:
+            while True:  # trailers until the blank line
+                t = self.rfile.readline(1024)
+                if not t or t in (b"\r\n", b"\n"):
+                    break
+            self.eof = True
+            return
+        self.remaining = size
+
+    def read(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n and not self.eof:
+            if self.remaining == 0:
+                self._next_chunk()
+                continue
+            take = min(n - len(out), self.remaining)
+            data = self.rfile.read(take)
+            if not data:
+                self.eof = True
+                break
+            out += data
+            self.remaining -= len(data)
+            if self.remaining == 0:
+                self.rfile.read(2)  # CRLF after the chunk data
+        return bytes(out)
+
+
+class _LengthReader:
+    """Content-Length-bounded body reader with the same ``read`` shape."""
+
+    def __init__(self, rfile, length: int):
+        self.rfile = rfile
+        self.remaining = max(0, int(length))
+
+    def read(self, n: int) -> bytes:
+        take = min(n, self.remaining)
+        if take <= 0:
+            return b""
+        data = self.rfile.read(take)
+        self.remaining -= len(data)
+        return data
+
+
+def _read_exact(reader, n: int) -> bytes:
+    data = reader.read(n)
+    if len(data) != n:
+        raise ValueError(f"truncated handoff stream ({len(data)}/{n} bytes)")
+    return data
 
 
 def _parse_request(body: dict, codec, budget_s: float | None = None) -> Request:
@@ -362,32 +436,136 @@ def make_server(
 
         def _handle_handoff(self) -> None:
             """POST /handoff — decode-tier import of a prefill-tier slot.
-            Body is a binary handoff bundle; the response is the same SSE
+            Body is a binary handoff bundle (DTFH1 monolithic or DTFH2
+            chunk stream, sniffed by magic); the response is the same SSE
             shape as streaming /generate (the first frame doubles as the
             ACCEPT signal the pushing side commits on), with synchronous
             rejections answered as plain typed JSON so the pusher can
             retry another peer."""
             from distributed_tensorflow_tpu.serve.fleet.handoff import (
                 decode_bundle,
+                decode_bundle_v2,
             )
 
             if not hasattr(scheduler, "submit_handoff"):
                 self._send(404, {"error": "not_found",
                                  "detail": "no handoff support"})
                 return
+            chunked = ("chunked"
+                       in self.headers.get("Transfer-Encoding", "").lower())
+            if chunked:
+                reader = _ChunkedReader(self.rfile)
+            else:
+                reader = _LengthReader(
+                    self.rfile, int(self.headers.get("Content-Length", 0)))
             try:
-                n = int(self.headers.get("Content-Length", 0))
-                bundle = decode_bundle(self.rfile.read(n))
+                magic = reader.read(5)
+            except OSError:
+                return  # sender died before the magic; nothing to answer
+            if magic == b"DTFH2" and hasattr(scheduler,
+                                             "open_handoff_import"):
+                self._handle_handoff_v2(reader)
+                return
+            # v1 bundle — or a scheduler without the staged import path:
+            # buffer the whole body and import monolithically.
+            try:
+                parts = [magic]
+                while True:
+                    block = reader.read(1 << 16)
+                    if not block:
+                        break
+                    parts.append(block)
+                data = b"".join(parts)
+                bundle = (decode_bundle_v2(data) if magic == b"DTFH2"
+                          else decode_bundle(data))
             except Exception as exc:  # noqa: BLE001 — malformed wire data
                 self._send(400, {"error": "invalid", "detail": str(exc)})
                 return
             pending = scheduler.submit_handoff(bundle)
             self._stream_response(pending)
 
+        def _handle_handoff_v2(self, reader) -> None:
+            """Streaming DTFH2 import: validate + reserve pages on the
+            header, scatter each page-group chunk as it arrives (the
+            transfer overlaps live decode rounds — scatters run at
+            iteration boundaries), and claim a slot only at the commit
+            frame. Any pre-commit failure aborts the staged pages and
+            answers typed JSON AFTER draining the rest of the upload —
+            answering mid-upload would surface as a broken pipe on the
+            sender instead of the typed status. The all-or-nothing
+            contract holds: SSE (and with it the pushing side's ACCEPT)
+            begins only after commit."""
+            from distributed_tensorflow_tpu.serve.fleet.handoff import (
+                ChunkAssembler,
+            )
+            from distributed_tensorflow_tpu.serve.scheduler import (
+                HandoffImportError,
+            )
+
+            session = None
+
+            def drain_then(code: int, payload: dict, retry: bool) -> None:
+                try:
+                    while reader.read(1 << 16):
+                        pass
+                except OSError:
+                    pass
+                try:
+                    self._send(code, payload,
+                               {"Retry-After": "1"} if retry else None)
+                except OSError:
+                    pass  # sender already gone
+
+            try:
+                (head_len,) = struct.unpack("<I", _read_exact(reader, 4))
+                header = json.loads(_read_exact(reader, head_len))
+                asm = ChunkAssembler(header)
+                session = scheduler.open_handoff_import(header)
+                session.reserve()
+                pending = None
+                while pending is None:
+                    tag = _read_exact(reader, 4)
+                    if tag == b"CHNK":
+                        plen, crc = struct.unpack(
+                            "<II", _read_exact(reader, 8))
+                        flags = _read_exact(reader, 1)[0]
+                        payload = _read_exact(reader, plen)
+                        start, stop, rows = asm.feed(payload, flags, crc)
+                        session.feed(start, stop, rows)
+                    elif tag == b"CMIT":
+                        (total,) = struct.unpack(
+                            "<I", _read_exact(reader, 4))
+                        asm.finish(total)
+                        pending = session.commit()
+                    else:
+                        raise ValueError(f"unknown frame tag {tag!r}")
+            except HandoffImportError as exc:
+                if session is not None:
+                    session.abort()
+                drain_then(_REJECTION_STATUS.get(exc.reason, 500),
+                           {"error": exc.reason, "detail": exc.detail},
+                           retry=exc.reason != "invalid")
+                return
+            except (ValueError, KeyError, TypeError) as exc:
+                # HandoffCorrupt (a ValueError), truncation, bad header.
+                if session is not None:
+                    session.abort()
+                drain_then(400, {"error": "invalid", "detail": str(exc)},
+                           retry=False)
+                return
+            except OSError:
+                if session is not None:
+                    session.abort()
+                return  # sender died mid-stream; nothing to answer
+            self._stream_response(pending)
+
         def _handle_handoff_peers(self) -> None:
             """POST /admin/handoff_peers {"urls": [...]} — the fleet
             supervisor pushes the current decode-tier membership to
-            prefill replicas as replicas come and go."""
+            prefill replicas as replicas come and go. Entries are bare
+            URL strings or ``{"url": ..., "pages_free": ..., ...}``
+            pressure dicts (registry probe data) feeding the outbox's
+            pressure-aware peer score."""
             outbox = getattr(scheduler, "handoff", None)
             if outbox is None:
                 self._send(400, {"error": "invalid",
@@ -399,8 +577,13 @@ def make_server(
                 body = json.loads(self.rfile.read(n) or b"{}")
                 urls = body["urls"]
                 if not isinstance(urls, list) or not all(
-                        isinstance(u, str) for u in urls):
-                    raise ValueError("urls must be a list of strings")
+                        isinstance(u, str)
+                        or (isinstance(u, dict)
+                            and isinstance(u.get("url"), str))
+                        for u in urls):
+                    raise ValueError(
+                        "urls must be a list of strings or {'url': ...} "
+                        "dicts")
             except (ValueError, TypeError, KeyError,
                     json.JSONDecodeError) as exc:
                 self._send(400, {"error": "invalid", "detail": str(exc)})

@@ -501,6 +501,50 @@ def _scrape_health(url, server):
     return slo, recompiles, fastpath
 
 
+def _scrape_handoff(urls):
+    """KV-page handoff funnel from each prefill replica's /metrics.json:
+    per-replica outcome counts, wire bytes by compression, per-chunk
+    encode percentiles, tier stall and per-peer throughput EWMA, plus a
+    fleet-wide rollup with the silent-fallback count (exports that never
+    reached a terminal accepted/fallback outcome — the number --smoke
+    gates on). Never raises; an unreachable replica reports an error
+    entry and counts zero."""
+    import urllib.request
+
+    per_replica = {}
+    totals = {"export": 0, "accepted": 0, "fallback": 0, "failed": 0,
+              "done": 0, "bytes": {"true": 0, "false": 0}}
+    for url in urls:
+        base = url.rstrip("/")
+        try:
+            with urllib.request.urlopen(base + "/metrics.json",
+                                        timeout=5) as r:
+                snap = json.loads(r.read())
+        except Exception as exc:  # noqa: BLE001 — scrape is best-effort
+            per_replica[base] = {"error": repr(exc)}
+            continue
+        outcomes = snap.get("handoff", {}) or {}
+        entry = {
+            "outcomes": outcomes,
+            "bytes": snap.get("handoff_bytes", {}) or {},
+            "chunk_ms": snap.get("handoff_chunk_ms") or {},
+            "stall": snap.get("handoff_stall", {}) or {},
+            "throughput_bytes_per_s":
+                snap.get("handoff_throughput_bytes_per_s", {}) or {},
+        }
+        per_replica[base] = entry
+        for key in ("export", "accepted", "fallback", "failed", "done"):
+            totals[key] += int(outcomes.get(key, 0))
+        for label in ("true", "false"):
+            totals["bytes"][label] += int(entry["bytes"].get(label, 0))
+    # Every export must terminate as accepted (peer took the pages) or
+    # fallback (typed failure, local decode resumed). Anything else is a
+    # request silently stuck in handoff limbo.
+    totals["silent_fallbacks"] = max(
+        0, totals["export"] - totals["accepted"] - totals["fallback"])
+    return {"replicas": per_replica, "totals": totals}
+
+
 def run_load(
     submit_one,
     *,
@@ -648,6 +692,15 @@ def main(argv=None):
         help="append the machine-parseable report record here as one JSONL "
              "line (bench.py's BENCH_LAST.json convention — appended, so "
              "serving-latency trends accumulate across runs; '' disables)",
+    )
+    parser.add_argument(
+        "--handoff_report", default="",
+        help="comma-separated base URLs of prefill-tier replicas to "
+        "scrape (/metrics.json) for the KV-page handoff funnel: outcome "
+        "counts, wire bytes, per-chunk encode percentiles, per-peer "
+        "throughput EWMA and tier stall. With --smoke the run FAILS if "
+        "any handoff fell back SILENTLY (exports not accounted for by "
+        "an accepted or typed-fallback outcome)",
     )
     parser.add_argument(
         "--long_prompts", action="store_true",
@@ -836,6 +889,11 @@ def main(argv=None):
     # the engine recompile after warmup (it must not)?
     slo_status, recompiles, fastpath = _scrape_health(
         targets[0] if targets else "", server)
+    handoff_report = None
+    if args.handoff_report:
+        handoff_report = _scrape_handoff(
+            [u.strip() for u in args.handoff_report.split(",")
+             if u.strip()])
     # Serving-mesh topology for the report: self-serve reads the engine,
     # HTTP mode scrapes /healthz (best-effort — older servers lack it).
     mesh_info = None
@@ -930,6 +988,7 @@ def main(argv=None):
         "failovers": acct.failovers,
         "per_variant": acct.variant_report(),
         "swap_mid_run": args.swap_mid_run,
+        "handoff": handoff_report,
     }
     print(json.dumps(report))
     if args.report_file:
@@ -946,6 +1005,16 @@ def main(argv=None):
         if acct.completed == 0:
             print("SMOKE FAIL: no request completed", file=sys.stderr)
             return 1
+        if handoff_report is not None:
+            silent = handoff_report["totals"]["silent_fallbacks"]
+            if silent > 0:
+                print(
+                    f"SMOKE FAIL: {silent} handoff export(s) never "
+                    "reached an accepted or typed-fallback outcome "
+                    "(silent fallback)",
+                    file=sys.stderr,
+                )
+                return 1
     return 0
 
 

@@ -146,10 +146,39 @@ def launch_fleet(
         raise
 
 
+def decode_peer_infos(registry, decode_urls) -> list:
+    """Enrich decode-tier URLs with the registry's latest probe pressure
+    (pages_free/pages_total, queue depth, occupancy) so prefill outboxes
+    can score peers instead of round-robining. URLs the registry has not
+    probed yet stay bare strings — the outbox falls back to RR for
+    them."""
+    by_url = {}
+    try:
+        for rep in registry.snapshot()["replicas"].values():
+            by_url[rep["base_url"].rstrip("/")] = rep
+    except Exception:  # noqa: BLE001 — enrichment is best-effort
+        return list(decode_urls)
+    out = []
+    for url in decode_urls:
+        rep = by_url.get(str(url).rstrip("/"))
+        if rep is None:
+            out.append(url)
+            continue
+        out.append({
+            "url": url,
+            "pages_free": rep.get("pages_free", 0),
+            "pages_total": rep.get("pages_total", 0),
+            "queue_depth": rep.get("queue_depth", 0),
+            "occupancy": rep.get("occupancy", 0.0),
+        })
+    return out
+
+
 def push_handoff_peers(prefill_urls, decode_urls,
                        timeout_s: float = 5.0) -> None:
     """POST the decode tier's membership to every prefill replica's
-    handoff outbox. Best-effort: a replica that is mid-boot or gone gets
+    handoff outbox. Entries are bare URLs or ``decode_peer_infos``
+    pressure dicts. Best-effort: a replica that is mid-boot or gone gets
     the next membership push."""
     import json
     import urllib.request
@@ -245,7 +274,8 @@ def main(argv=None):
                        if m.role == "decode" and not m.draining]
         prefill_urls = [m.handle.url for m in members
                         if m.role == "prefill" and not m.draining]
-        push_handoff_peers(prefill_urls, decode_urls)
+        push_handoff_peers(prefill_urls,
+                           decode_peer_infos(registry, decode_urls))
 
     if fleet_cfg.supervise:
         print(
@@ -271,6 +301,8 @@ def main(argv=None):
             # bursty but short; decode holds slots for whole responses).
             role_for=(lambda direction: "decode") if tiered
             else (lambda direction: "mixed"),
+            balance_tiers=bool(getattr(fleet_cfg, "balance_tiers", False)
+                               and tiered),
             on_change=on_membership,
         )
         supervisor.start(len(initial_roles), roles=initial_roles,
@@ -332,6 +364,39 @@ def main(argv=None):
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+
+    pressure_stop = threading.Event()
+    if tiered:
+        # Peer-pressure refresh: membership pushes happen on change, but
+        # the PRESSURE attached to each decode peer (pages_free, queue
+        # depth) goes stale between changes — re-push the enriched list
+        # on a probe-paced cadence so prefill outboxes keep steering at
+        # current capacity, not boot-time capacity.
+        def repush_pressure() -> None:
+            interval = max(0.5, fleet_cfg.probe_interval_s * 4)
+            while not pressure_stop.wait(interval):
+                try:
+                    if supervisor is not None:
+                        members = supervisor.members
+                        decode_urls = [m.handle.url for m in members
+                                       if m.role == "decode"
+                                       and not m.draining]
+                        prefill_urls = [m.handle.url for m in members
+                                        if m.role == "prefill"
+                                        and not m.draining]
+                    else:
+                        decode_urls = [r.url for r in replicas
+                                       if r.role == "decode"]
+                        prefill_urls = [r.url for r in replicas
+                                        if r.role == "prefill"]
+                    push_handoff_peers(
+                        prefill_urls,
+                        decode_peer_infos(registry, decode_urls))
+                except Exception:  # noqa: BLE001 — refresh is best-effort
+                    pass
+
+        threading.Thread(target=repush_pressure, name="handoff-pressure",
+                         daemon=True).start()
     def write_storm_summary() -> None:
         """Fleet-wide chaos/storm summary: final breaker states, every
         ``fleet_*`` counter/gauge, and the per-replica snapshot — the
@@ -369,6 +434,7 @@ def main(argv=None):
         server.serve_forever()
     finally:
         server.server_close()
+        pressure_stop.set()
         if slo_monitor is not None:
             slo_monitor.stop()
         write_storm_summary()

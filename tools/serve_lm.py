@@ -217,7 +217,12 @@ def build_stack(serve_cfg, cfg, params, deploy_cfg=None):
         )
 
         handoff = HandoffOutbox(
-            getattr(serve_cfg, "handoff_peer_list", ()))
+            getattr(serve_cfg, "handoff_peer_list", ()),
+            wire_version=int(getattr(serve_cfg, "handoff_wire", 2)),
+            chunk_pages=int(getattr(serve_cfg, "handoff_chunk_pages", 4)),
+            compress=bool(getattr(serve_cfg, "handoff_compress", True)),
+            metrics=metrics,
+        )
     scheduler = Scheduler(
         engine,
         max_queue_depth=serve_cfg.max_queue_depth,
