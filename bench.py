@@ -1888,6 +1888,7 @@ def bench_fleet_elastic() -> list[dict]:
     from serve_fleet import ReplicaProc, push_handoff_peers
 
     from distributed_tensorflow_tpu.obs.export import parse_prometheus_text
+    from distributed_tensorflow_tpu.serve import metric_names as mn
     from distributed_tensorflow_tpu.serve.fleet import (
         FleetRouter,
         FleetSupervisor,
@@ -2072,7 +2073,7 @@ def bench_fleet_elastic() -> list[dict]:
             samples = parse_prometheus_text(resp.read().decode())
         handoff = {
             s["labels"]["outcome"]: s["value"] for s in samples
-            if s["name"] == "serve_handoff_total"
+            if s["name"] == mn.SERVE_HANDOFF_TOTAL
         }
         # Parity must have flowed THROUGH the decode tier: every case
         # accepted, none quietly decoded locally via the fallback path.
@@ -2168,6 +2169,7 @@ def bench_fleet_chaos() -> list[dict]:
     from serve_fleet import launch_fleet
 
     from distributed_tensorflow_tpu.obs.export import parse_prometheus_text
+    from distributed_tensorflow_tpu.serve import metric_names as mn
     from distributed_tensorflow_tpu.serve.fleet import (
         FleetRouter,
         ReplicaRegistry,
@@ -2224,7 +2226,7 @@ def bench_fleet_chaos() -> list[dict]:
                 url.rstrip("/") + "/metrics", timeout=10) as resp:
             samples = parse_prometheus_text(resp.read().decode())
         return sum(s["value"] for s in samples
-                   if s["name"] == "recompile_events_total")
+                   if s["name"] == mn.RECOMPILE_EVENTS_TOTAL)
 
     # Counted (not probabilistic) arms: the storm is identical every run
     # and EXHAUSTS, so the recovery wave measures a genuinely fault-free
@@ -2462,6 +2464,7 @@ def bench_fleet_handoff_perf() -> list[dict]:
     from serve_fleet import ReplicaProc, push_handoff_peers
 
     from distributed_tensorflow_tpu.obs.export import parse_prometheus_text
+    from distributed_tensorflow_tpu.serve import metric_names as mn
 
     if SMOKE:
         shape = ["--vocab_size", "256", "--d_model", "32", "--num_heads",
@@ -2506,13 +2509,13 @@ def bench_fleet_handoff_perf() -> list[dict]:
             samples = parse_prometheus_text(resp.read().decode())
         out = {"bytes": 0.0, "stall": {}, "handoff": {}, "recompiles": 0.0}
         for s in samples:
-            if s["name"] == "fleet_handoff_bytes_total":
+            if s["name"] == mn.FLEET_HANDOFF_BYTES_TOTAL:
                 out["bytes"] += s["value"]
-            elif s["name"] == "serve_handoff_stall_seconds_total":
+            elif s["name"] == mn.SERVE_HANDOFF_STALL_SECONDS_TOTAL:
                 out["stall"][s["labels"]["side"]] = s["value"]
-            elif s["name"] == "serve_handoff_total":
+            elif s["name"] == mn.SERVE_HANDOFF_TOTAL:
                 out["handoff"][s["labels"]["outcome"]] = s["value"]
-            elif s["name"] == "recompile_events_total":
+            elif s["name"] == mn.RECOMPILE_EVENTS_TOTAL:
                 out["recompiles"] += s["value"]
         return out
 

@@ -49,16 +49,16 @@ def sample_logits(logits, key, temperature: float = 0.0,
     top_p (the argmax always survives, so the distribution is never empty).
     Static shapes throughout (top_k/sort — no data-dependent control flow),
     so the whole thing jits into the decode scan."""
-    if temperature <= 0.0:
+    if temperature <= 0.0:  # dttlint: disable=jit-purity -- static sampling config: callers pass Python floats, branch specializes the program (see docstring)
         return jnp.argmax(logits, -1).astype(jnp.int32)
     logits = (logits / temperature).astype(jnp.float32)
     if top_k is not None:
-        if top_k < 1:
+        if top_k < 1:  # dttlint: disable=jit-purity -- static sampling config: top_k is a Python int/None at trace time, never a tracer
             raise ValueError(f"top_k must be >= 1, got {top_k}")
         kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
         logits = jnp.where(logits < kth, _NEG_INF, logits)
     if top_p is not None:
-        if not 0.0 < top_p <= 1.0:
+        if not 0.0 < top_p <= 1.0:  # dttlint: disable=jit-purity -- static sampling config: top_p is a Python float/None at trace time, never a tracer
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         desc = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(desc, axis=-1)

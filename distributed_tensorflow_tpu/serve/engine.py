@@ -465,7 +465,7 @@ class SlotEngine:
             return prefill_fn
 
         def _select(sampled, last, temp, top_k, top_p, seed):
-            if sampled:
+            if sampled:  # dttlint: disable=jit-purity -- static program-variant flag: the factory bakes sampled in as a Python bool (one jitted program per variant)
                 key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
                 return sample_logits_batched(
                     last[None], key[None], temp[None], top_k[None], top_p[None]

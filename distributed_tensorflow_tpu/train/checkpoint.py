@@ -689,8 +689,8 @@ class CheckpointManager:
                 job.done.set()
 
     def _run_job(self, job: _SnapshotJob) -> None:
-        deadline = time.time() + 60.0
-        while job.held and not job.cancelled and time.time() < deadline:
+        deadline = time.monotonic() + 60.0
+        while job.held and not job.cancelled and time.monotonic() < deadline:
             time.sleep(0.005)
         if job.cancelled:
             job.status = _JOB_CANCELLED

@@ -30,7 +30,9 @@ would occur, so recovery paths are exercised end-to-end):
 * ``nonfinite_grad`` — step-armed: the training loop poisons that step's
                        batch with NaN, driving the non-finite guard;
 * ``preempt``        — step-armed: the loop raises a synthetic preemption
-                       request at that step (same flag a real SIGTERM sets).
+                       request at that step (same flag a real SIGTERM sets);
+* ``ckpt_publish``   — manifest publish in ``train/checkpoint.py`` (the
+                       rename that makes a checkpoint visible to watchers).
 
 Serving-plane sites (PR 16, DESIGN.md §22 for the outcome each maps to):
 
@@ -45,7 +47,9 @@ Serving-plane sites (PR 16, DESIGN.md §22 for the outcome each maps to):
 * ``probe_flap``            — health probe reports failure for a live replica;
 * ``handoff_corrupt``       — outbound DTFH1 bundle is bit-flipped;
 * ``handoff_send_timeout``  — outbound handoff send dies on a timeout;
-* ``spawn_fail``            — supervisor replica spawn raises.
+* ``spawn_fail``            — supervisor replica spawn raises;
+* ``deploy_nan``            — deploy watcher's canary forward pass sees a
+                              non-finite logit (drives the rollback gate).
 
 The registry is process-local and loads from the env on first use, so
 multiprocess tests arm workers simply by exporting ``DTT_FAULT``.

@@ -175,7 +175,7 @@ def serve_router():
 
 
 def test_grammar_parses_probability_after_and_ms():
-    sites = faults.parse_spec(
+    sites = faults.parse_spec(  # dttlint: disable=fault-registry -- grammar unit test: dummy site names exercise the parser, not injection
         "a:p=0.5,a:ms=100,b:after=2,b:after=5,c:3,d:ms=250")
     assert sites["a"].p == 0.5 and sites["a"].ms == 100.0
     assert sites["b"].afters == {2, 5}
@@ -190,7 +190,7 @@ def test_grammar_rejects_malformed(bad):
 
 
 def test_after_fires_once_past_the_crossing():
-    faults.configure("s:after=2")
+    faults.configure("s:after=2")  # dttlint: disable=fault-registry -- registry unit test: dummy site fired via faults.fire directly below, no wired call site needed
     assert [faults.fire("s") for _ in range(5)] == [
         False, False, True, False, False]
 
@@ -747,7 +747,8 @@ def test_loadgen_types_stream_cuts_and_carries_deadline_ms(tmp_path):
         assert proc.returncode == 0, proc.stderr[-1500:]
         report = json.loads(report_file.read_text().splitlines()[-1])
         assert report["outcomes"] == {
-            "ok": 2, "deadline": 0, "failover_exhausted": 0, "shed": 0,
+            "ok": 2, "deadline": 0, "failover_exhausted": 0,
+            "capacity_shed": 0, "shed_unknown": 0,
             "stream_aborted": 2, "errored": 0}
         assert report["stream_aborted"] == 2
         assert sum(report["outcomes"].values()) == report["num_requests"]

@@ -113,6 +113,24 @@ def test_uncommitted_step_is_invisible_and_unreadable(tmp_path):
         read_step(d, 4)
 
 
+@pytest.mark.fault
+def test_publish_fault_leaves_no_committed_step(tmp_path):
+    """``ckpt_publish`` chaos site: a publish that dies between the shard
+    write and the commit rename must be atomic-invisible — watchers never
+    see a torn manifest, and the next publish of the same step lands."""
+    d = str(tmp_path / "ck")
+    faults.configure("ckpt_publish:1")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            write_committed_step(d, 5, {"w": np.ones(4, np.float32)})
+        assert list_committed_steps(d) == []
+        step_dir = write_committed_step(d, 5, {"w": np.ones(4, np.float32)})
+        assert os.path.isdir(step_dir)
+        assert list_committed_steps(d) == [5]
+    finally:
+        faults.reset()
+
+
 def test_torn_committed_step_raises_typed_oserror(tmp_path):
     """COMMITTED but the shard file is gone (torn dir): still listed (the
     commit marker is the visibility rule) but reading is a typed OSError,

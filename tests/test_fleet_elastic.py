@@ -243,6 +243,26 @@ def test_spawn_failure_is_retried_next_tick_not_fatal():
     assert sup.member_count() == 1
 
 
+@pytest.mark.fault
+def test_injected_spawn_fault_is_absorbed_like_a_real_boot_failure():
+    """The ``spawn_fail`` chaos site (DTT_FAULT) takes the same non-fatal
+    path as a spawner that raises: no member, no crash, next attempt
+    clean once the arm exhausts."""
+    from distributed_tensorflow_tpu.utils import faults
+
+    clock, pressure = [0.0], [0.5]
+    sup, spawner, registry = _make(clock, pressure)
+    faults.configure("spawn_fail:1")
+    try:
+        assert sup._spawn_one("mixed") is None
+        assert sup.member_count() == 0
+        assert spawner.count == 0  # the fault fired before the real spawn
+        assert sup._spawn_one("mixed") is not None
+        assert sup.member_count() == 1
+    finally:
+        faults.reset()
+
+
 def test_supervisor_bounds_are_validated():
     registry = ReplicaRegistry([], registry=MetricsRegistry())
     with pytest.raises(ValueError, match="min_replicas"):

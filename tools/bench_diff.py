@@ -136,6 +136,27 @@ def diff_lines(cur: list[dict], ref: list[dict]) -> list[str]:
     return lines
 
 
+def null_gated_keys(metrics: list[dict], tag: str) -> list[str]:
+    """Gated keys (``FLOORS`` / ``FRAC_CEILS``) whose metric is present but
+    whose gated field parsed to null. A null here is the signature of a
+    drifted scrape name or a crashed scrape — the metric object exists, the
+    number never arrived — and must read as a gate failure, not a pass
+    (``enforce_floors`` only catches fully MISSING entries)."""
+    import bench
+
+    by_name = {m.get("metric"): m for m in metrics}
+    out = []
+    for name, floor in bench.FLOORS.items():
+        m = by_name.get(name)
+        if m is not None and "value" in m and m["value"] is None:
+            out.append(f"{tag}: {name}: value parsed to null (floor {floor})")
+    for name, ceil in bench.FRAC_CEILS.items():
+        m = by_name.get(name)
+        if m is not None and "frac" in m and m["frac"] is None:
+            out.append(f"{tag}: {name}: frac parsed to null (ceiling {ceil})")
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--dir", default=".", help="where the BENCH files live")
@@ -149,6 +170,7 @@ def main(argv=None) -> int:
         return 2
     cur = flatten_last(json.load(open(last_path)))
 
+    null_problems = null_gated_keys(cur, os.path.basename(last_path))
     ref_path = args.ref or newest_run_file(args.dir)
     if ref_path:
         ref = metrics_from_run(json.load(open(ref_path)))
@@ -156,8 +178,16 @@ def main(argv=None) -> int:
               f"({len(cur)} vs {len(ref)} metrics)")
         for line in diff_lines(cur, ref):
             print(line)
+        null_problems += null_gated_keys(ref, os.path.basename(ref_path))
     else:
         print(f"bench_diff: {last_path} (no BENCH_r*.json reference found)")
+
+    if null_problems:
+        print("bench_diff: NULL-VALUED GATED METRICS (scrape drift?):",
+              file=sys.stderr)
+        for p in null_problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
 
     import bench
 

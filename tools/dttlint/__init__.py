@@ -1,0 +1,33 @@
+"""dttlint: repo-native static analysis for the serving/training stack.
+
+Six AST-based rule families enforce the invariants 17 PRs of growth
+encoded (DESIGN.md §24 is the catalog):
+
+* ``jit-purity``          — no host effects / traced-value branches in
+                            code reachable from the jitted program set;
+* ``donation``            — a buffer passed at a ``donate_argnums``
+                            position is never read after the call;
+* ``lock-mixed`` /
+  ``lock-blocking`` /
+  ``wallclock-deadline``  — lock discipline for the threaded serving
+                            classes (scheduler, registry, outbox,
+                            watcher, obs) + monotonic-clock deadlines;
+* ``fault-registry``      — ``DTT_FAULT`` site grammar: call sites,
+                            ``utils/faults.py`` docstring table,
+                            DESIGN.md §22 table, and test/bench arming
+                            specs all name the same site set;
+* ``rejection-kinds``     — typed ``Rejection`` kinds == the server's
+                            status-code map == loadgen's outcome
+                            partition;
+* ``metric-drift``        — metric names string-scraped by loadgen /
+                            bench / bench_diff / tests resolve to
+                            registered metric families.
+
+Pure stdlib ``ast`` — no JAX import, safe for tier-1 and pre-commit.
+Suppress a finding inline with ``# dttlint: disable=<rule> -- reason``
+(the reason is mandatory; a bare disable is itself a finding).
+"""
+
+from tools.dttlint.core import Finding, Repo, run_lint  # noqa: F401
+
+__all__ = ["Finding", "Repo", "run_lint"]

@@ -1,0 +1,68 @@
+"""CLI: ``python -m tools.dttlint [--json] [--rules a,b] [--root DIR]``.
+
+Exit status: 0 clean, 1 unsuppressed findings, 2 usage/IO error. The
+whole-repo tier-1 gate (``tests/test_dttlint.py``) and the verify path
+both run exactly this entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _repo_root() -> str:
+    # tools/dttlint/__main__.py -> repo root is two levels up from tools/.
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    sys.path.insert(0, _repo_root())
+    from tools.dttlint.core import (
+        DEFAULT_TARGETS,
+        Repo,
+        render_human,
+        render_json,
+        run_lint,
+    )
+    from tools.dttlint.rules import ALL_RULES
+
+    parser = argparse.ArgumentParser(
+        prog="dttlint",
+        description="repo-native static analysis (DESIGN.md §24)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine output")
+    parser.add_argument("--root", default=_repo_root(), help="repo root")
+    parser.add_argument(
+        "--rules", default="",
+        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument(
+        "targets", nargs="*",
+        help=f"paths relative to root (default: {' '.join(DEFAULT_TARGETS)})")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id:<20} {rule.doc}")
+        return 0
+
+    t0 = time.monotonic()
+    targets = tuple(args.targets) or DEFAULT_TARGETS
+    repo = Repo.from_disk(args.root, targets)
+    if not repo.files:
+        print(f"dttlint: nothing to lint under {args.root}", file=sys.stderr)
+        return 2
+    select = {r.strip() for r in args.rules.split(",") if r.strip()} or None
+    active, suppressed = run_lint(repo, select=select)
+    elapsed = time.monotonic() - t0
+
+    render = render_json if args.json else render_human
+    print(render(active, suppressed, len(repo.files), elapsed))
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

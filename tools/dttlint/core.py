@@ -1,0 +1,260 @@
+"""Rule framework: findings, suppressions, the repo snapshot, the runner.
+
+Design constraints that shaped this module:
+
+* **Pure AST, zero deps.** The suite must run in tier-1 (< 10 s, no JAX
+  import) and inside ``tools/bench_diff``-style gates, so everything is
+  stdlib ``ast`` + regex over source text.
+* **In-memory repos.** Rules receive a :class:`Repo` — a dict of
+  relpath → source — never the filesystem, so every rule is testable
+  against three-line fixture snippets (firing / clean / suppressed)
+  without touching the real tree.
+* **Cross-file rules are first-class.** Four of the six families
+  (fault registry, rejection kinds, metric drift, donation into
+  kv_pool) compare *sets of names across files*; a per-file visitor
+  API cannot express them, so the rule interface is simply
+  ``run(repo) -> findings``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation: ``rule`` names the family (and is the suppression
+    key), ``path`` is repo-relative, ``line`` is 1-based."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    severity: str = "error"
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.severity}[{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "severity": self.severity,
+            "message": self.message,
+        }
+
+
+# Inline suppression: "dttlint: disable=<rule>[,<rule>] -- <reason>" in a
+# comment. The "--" reason clause is mandatory by policy (DESIGN.md §24):
+# a suppression with no justification is reported as its own finding
+# instead of honored. (The examples here use <angle> placeholders so the
+# linter does not match its own source.)
+_SUPPRESS_RE = re.compile(
+    r"#\s*dttlint:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(\S.*))?$"
+)
+
+
+@dataclass
+class _Suppression:
+    rules: frozenset[str]
+    reason: str
+
+
+@dataclass
+class SourceFile:
+    """A parsed module plus its suppression table."""
+
+    path: str
+    text: str
+    tree: ast.AST | None = None           # None: not Python / syntax error
+    parse_error: str | None = None
+    lines: list[str] = field(default_factory=list)
+    suppressions: dict[int, _Suppression] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: str, text: str) -> "SourceFile":
+        sf = cls(path=path, text=text, lines=text.splitlines())
+        if path.endswith(".py"):
+            try:
+                sf.tree = ast.parse(text)
+            except SyntaxError as exc:
+                sf.parse_error = f"{exc.msg} (line {exc.lineno})"
+        for i, line in enumerate(sf.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                sf.suppressions[i] = _Suppression(rules, (m.group(2) or "").strip())
+        return sf
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        sup = self.suppressions.get(line)
+        return sup is not None and (rule in sup.rules or "all" in sup.rules)
+
+
+# Directories/files the on-disk walk lints. ``tests/`` is included: the
+# fault-arming and metric-scrape registries live there, and a drifted
+# test literal is exactly the silent-coverage hole rules 4/6 exist for.
+DEFAULT_TARGETS = ("distributed_tensorflow_tpu", "tools", "tests", "bench.py")
+
+_SKIP_DIRS = {"__pycache__", ".git", ".claude", "_native"}
+
+
+class Repo:
+    """Everything the rules see: parsed ``.py`` sources + raw ``.md`` docs.
+
+    Paths are repo-root-relative with ``/`` separators; fixtures hand in
+    the same shapes (``{"distributed_tensorflow_tpu/serve/x.py": src}``)
+    so rules locate files by suffix, not by filesystem truth.
+    """
+
+    def __init__(self, files: dict[str, str]):
+        self.files: dict[str, SourceFile] = {
+            path: SourceFile.parse(path, text) for path, text in files.items()
+        }
+
+    @classmethod
+    def from_disk(cls, root: str, targets: tuple[str, ...] = DEFAULT_TARGETS) -> "Repo":
+        files: dict[str, str] = {}
+
+        def add(abspath: str) -> None:
+            rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+            try:
+                with open(abspath, encoding="utf-8") as fh:
+                    files[rel] = fh.read()
+            except (OSError, UnicodeDecodeError):
+                pass
+
+        for target in targets:
+            top = os.path.join(root, target)
+            if os.path.isfile(top):
+                add(top)
+                continue
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        add(os.path.join(dirpath, fn))
+        # The docs the fault-site rule cross-checks against.
+        for md in ("docs/DESIGN.md",):
+            p = os.path.join(root, md)
+            if os.path.isfile(p):
+                add(p)
+        return cls(files)
+
+    # -- lookup helpers ---------------------------------------------------
+
+    def modules(self, prefix: str = "") -> list[SourceFile]:
+        """Parsed Python files, optionally filtered by path prefix."""
+        return [
+            sf
+            for path, sf in sorted(self.files.items())
+            if path.endswith(".py") and sf.tree is not None
+            and path.startswith(prefix)
+        ]
+
+    def find(self, suffix: str) -> SourceFile | None:
+        """The unique file whose path ends with ``suffix`` (exact path
+        first, then suffix match) — lets fixtures use short fake paths."""
+        if suffix in self.files:
+            return self.files[suffix]
+        hits = [sf for p, sf in self.files.items() if p.endswith(suffix)]
+        return hits[0] if len(hits) == 1 else None
+
+
+class Rule:
+    """Base: subclasses set ``id``/``doc`` and implement ``run``."""
+
+    id: str = ""
+    doc: str = ""
+
+    def run(self, repo: Repo) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _suppression_findings(repo: Repo, known_rules: frozenset[str]) -> list[Finding]:
+    """Policy findings about the suppression comments themselves."""
+    out = []
+    for path, sf in sorted(repo.files.items()):
+        for line, sup in sorted(sf.suppressions.items()):
+            if not sup.reason:
+                out.append(Finding(
+                    "suppression-reason", path, line,
+                    "bare '# dttlint: disable' — every suppression must "
+                    "carry a '-- reason' clause (DESIGN.md §24 policy)",
+                ))
+            for r in sup.rules - known_rules - {"all"}:
+                out.append(Finding(
+                    "suppression-reason", path, line,
+                    f"suppression names unknown rule {r!r}",
+                ))
+    return out
+
+
+def run_lint(
+    repo: Repo,
+    rules: list[Rule] | None = None,
+    select: set[str] | None = None,
+) -> tuple[list[Finding], list[Finding]]:
+    """Run ``rules`` (default: the full registry) over ``repo``.
+
+    Returns ``(active, suppressed)`` findings, both sorted. Syntax errors
+    in linted files surface as ``parse-error`` findings — a file the
+    linter cannot read must not read as a pass.
+    """
+    if rules is None:
+        from tools.dttlint.rules import ALL_RULES
+
+        rules = ALL_RULES
+    if select:
+        rules = [r for r in rules if r.id in select]
+
+    known = frozenset(r.id for r in rules) | {
+        "parse-error", "suppression-reason",
+    }
+    raw: list[Finding] = []
+    for path, sf in sorted(repo.files.items()):
+        if sf.parse_error is not None:
+            raw.append(Finding("parse-error", path, 1, sf.parse_error))
+    for rule in rules:
+        raw.extend(rule.run(repo))
+    if select is None or "suppression-reason" in (select or ()):
+        raw.extend(_suppression_findings(repo, known))
+
+    active, suppressed = [], []
+    for f in sorted(set(raw), key=lambda f: (f.path, f.line, f.rule, f.message)):
+        sf = repo.files.get(f.path)
+        if sf is not None and f.rule != "suppression-reason" and sf.suppressed(f.rule, f.line):
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+def render_human(active: list[Finding], suppressed: list[Finding],
+                 n_files: int, elapsed_s: float) -> str:
+    lines = [f.format() for f in active]
+    lines.append(
+        f"dttlint: {len(active)} finding(s), {len(suppressed)} suppressed, "
+        f"{n_files} files, {elapsed_s:.2f}s"
+    )
+    return "\n".join(lines)
+
+
+def render_json(active: list[Finding], suppressed: list[Finding],
+                n_files: int, elapsed_s: float) -> str:
+    return json.dumps(
+        {
+            "findings": [f.as_dict() for f in active],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "files": n_files,
+            "elapsed_s": round(elapsed_s, 3),
+        },
+        indent=2,
+    )

@@ -1,0 +1,25 @@
+"""The rule registry: six families, eight rule ids."""
+
+from tools.dttlint.rules.donation import DonationRule
+from tools.dttlint.rules.fault_sites import FaultRegistryRule
+from tools.dttlint.rules.jit_purity import JitPurityRule
+from tools.dttlint.rules.locks import (
+    LockBlockingRule,
+    LockMixedRule,
+    WallclockDeadlineRule,
+)
+from tools.dttlint.rules.metric_names import MetricDriftRule
+from tools.dttlint.rules.rejections import RejectionKindsRule
+
+ALL_RULES = [
+    JitPurityRule(),
+    DonationRule(),
+    LockMixedRule(),
+    LockBlockingRule(),
+    WallclockDeadlineRule(),
+    FaultRegistryRule(),
+    RejectionKindsRule(),
+    MetricDriftRule(),
+]
+
+__all__ = ["ALL_RULES"]

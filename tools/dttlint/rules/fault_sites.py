@@ -1,0 +1,244 @@
+"""Rule family 4: DTT_FAULT site-registry consistency.
+
+Four copies of the fault-site set must agree or chaos coverage rots
+silently:
+
+1. **call sites** — string literals passed to ``faults.fire`` /
+   ``fire_step`` / ``maybe_fail`` / ``site_ms`` / ``delay_s`` across the
+   package, tools, and bench;
+2. **the docstring table** — ``utils/faults.py``'s module docstring
+   lists every wired site (``* ``name`` — ...``);
+3. **the DESIGN table** — DESIGN.md §22's site table (the reviewer-facing
+   copy of the same registry);
+4. **arming specs** — ``DTT_FAULT`` grammar strings in tests and bench
+   (``faults.configure(...)``, ``parse_spec(...)``, ``env["DTT_FAULT"]``
+   assignments, ``DTT_FAULT=...`` literals).
+
+A site fired in code but armed nowhere is dead chaos coverage (nothing
+ever proves the recovery path); an armed name with no call site is a
+test that injects nothing and silently passes (the PR 13 class); a site
+missing from either table is registry drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.dttlint.core import Finding, Repo, Rule
+from tools.dttlint.rules.common import const_str, dotted
+
+_FAULT_FNS = {"fire", "fire_step", "maybe_fail", "site_ms", "delay_s"}
+
+# ``* ``site`` — where it fires`` entries in the faults.py docstring,
+# starting at the site-table marker (the grammar bullets above it use the
+# same layout for spec syntax, not site names).
+_DOC_SITE_RE = re.compile(r"^\s*\*\s+``([a-z0-9_]+)``", re.MULTILINE)
+_DOC_TABLE_MARKER = "Sites wired through the stack"
+
+# DESIGN.md §22 table rows: every backticked token in the first cell.
+_MD_ROW_RE = re.compile(r"^\|([^|]*)\|", re.MULTILINE)
+_MD_SITE_RE = re.compile(r"`([a-z0-9_]+)`")
+
+_SPEC_ENTRY_RE = re.compile(
+    r"^([a-z][a-z0-9_]*)"
+    r"(?::(?:\d+|step=\d+|p=[0-9.]+|after=\d+|ms=[0-9.]+))?$"
+)
+
+
+def parse_spec_sites(spec: str) -> set[str] | None:
+    """Site names in a DTT_FAULT grammar string; None when the string is
+    not a well-formed spec (so arbitrary commas-in-strings don't count)."""
+    sites: set[str] = set()
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        m = _SPEC_ENTRY_RE.match(entry)
+        if m is None:
+            return None
+        sites.add(m.group(1))
+    return sites or None
+
+
+class FaultRegistryRule(Rule):
+    id = "fault-registry"
+    doc = "fault sites: call sites == docstring table == DESIGN table, all armed"
+
+    def run(self, repo: Repo) -> list[Finding]:
+        call_sites = self._call_sites(repo)          # name -> (path, line)
+        doc_sites, doc_loc = self._docstring_sites(repo)
+        md_sites, md_loc = self._design_sites(repo)
+        armed = self._armed_sites(repo, set(call_sites))  # name -> (path, line)
+
+        out: list[Finding] = []
+        for name, (path, line) in sorted(call_sites.items()):
+            if doc_sites is not None and name not in doc_sites:
+                out.append(Finding(
+                    self.id, path, line,
+                    f"fault site {name!r} is fired here but missing from "
+                    "the utils/faults.py docstring site table",
+                ))
+            if md_sites is not None and name not in md_sites:
+                out.append(Finding(
+                    self.id, path, line,
+                    f"fault site {name!r} is fired here but missing from "
+                    "the DESIGN.md §22 fault-site table",
+                ))
+            if name not in armed:
+                out.append(Finding(
+                    self.id, path, line,
+                    f"fault site {name!r} is never armed by any test/bench "
+                    "DTT_FAULT spec — dead chaos coverage (no test proves "
+                    "its recovery path)",
+                ))
+        # Table divergence, both directions (the two tables are copies of
+        # one registry — satellite: rule parses both and flags drift).
+        if doc_sites is not None and md_sites is not None:
+            for name in sorted(doc_sites - md_sites):
+                out.append(Finding(
+                    self.id, doc_loc[0], doc_loc[1].get(name, 1),
+                    f"site {name!r} is in the faults.py docstring table but "
+                    "not in the DESIGN.md §22 table",
+                ))
+            for name in sorted(md_sites - doc_sites):
+                out.append(Finding(
+                    self.id, md_loc[0], md_loc[1].get(name, 1),
+                    f"site {name!r} is in the DESIGN.md §22 table but not "
+                    "in the faults.py docstring table",
+                ))
+        # Documented-but-dead: a table row with no call site.
+        if doc_sites is not None:
+            for name in sorted(doc_sites - set(call_sites)):
+                out.append(Finding(
+                    self.id, doc_loc[0], doc_loc[1].get(name, 1),
+                    f"documented fault site {name!r} has no "
+                    "faults.fire/maybe_fail/... call site",
+                ))
+        # Armed-but-unresolvable: a spec naming a nonexistent site.
+        for name, (path, line) in sorted(armed.items()):
+            if name not in call_sites:
+                out.append(Finding(
+                    self.id, path, line,
+                    f"DTT_FAULT spec arms {name!r} but no call site fires "
+                    "it — the injection is a no-op and the test asserts "
+                    "nothing",
+                ))
+        return out
+
+    # -- collectors -------------------------------------------------------
+
+    @staticmethod
+    def _call_sites(repo: Repo) -> dict[str, tuple[str, int]]:
+        sites: dict[str, tuple[str, int]] = {}
+        for sf in repo.modules():
+            if sf.path.startswith("tests/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func) or ""
+                if "." not in name or name.rsplit(".", 1)[1] not in _FAULT_FNS:
+                    continue
+                if not name.rsplit(".", 1)[0].endswith("faults"):
+                    continue
+                if not node.args:
+                    continue
+                lit = const_str(node.args[0])
+                if lit is not None:
+                    sites.setdefault(lit, (sf.path, node.lineno))
+        return sites
+
+    @staticmethod
+    def _docstring_sites(repo: Repo):
+        sf = repo.find("utils/faults.py")
+        if sf is None or sf.tree is None:
+            return None, ("", {})
+        doc = ast.get_docstring(sf.tree, clean=False)
+        if not doc:
+            return None, ("", {})
+        start = doc.find(_DOC_TABLE_MARKER)
+        if start < 0:
+            return None, ("", {})
+        skipped = doc[:start].count("\n")
+        sites: set[str] = set()
+        lines: dict[str, int] = {}
+        for m in _DOC_SITE_RE.finditer(doc[start:]):
+            sites.add(m.group(1))
+            # +2: the docstring's opening quote line plus 1-based offset.
+            lines[m.group(1)] = skipped + doc[start:m.start() + start].count("\n") + 2
+        return sites, (sf.path, lines)
+
+    @staticmethod
+    def _design_sites(repo: Repo):
+        sf = repo.find("docs/DESIGN.md")
+        if sf is None:
+            return None, ("", {})
+        in_22 = False
+        sites: set[str] = set()
+        lines: dict[str, int] = {}
+        for i, line in enumerate(sf.lines, start=1):
+            if line.startswith("## "):
+                in_22 = line.startswith("## 22")
+                continue
+            if not in_22 or not line.startswith("|"):
+                continue
+            m = _MD_ROW_RE.match(line)
+            if m is None or set(m.group(1).strip()) <= {"-", " ", ":"}:
+                continue
+            for site in _MD_SITE_RE.findall(m.group(1)):
+                sites.add(site)
+                lines.setdefault(site, i)
+        return (sites or None), (sf.path, lines)
+
+    @staticmethod
+    def _armed_sites(repo: Repo, known_sites: set[str]) -> dict[str, tuple[str, int]]:
+        armed: dict[str, tuple[str, int]] = {}
+
+        def note(spec: str | None, path: str, line: int) -> None:
+            if not spec:
+                return
+            sites = parse_spec_sites(spec)
+            if sites:
+                for s in sites:
+                    armed.setdefault(s, (path, line))
+
+        # Arming surfaces only: a call site's own name literal must not
+        # self-arm, so the package is excluded.
+        arming = [sf for sf in repo.modules()
+                  if sf.path.startswith("tests/") or sf.path == "bench.py"]
+        for sf in arming:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    fn = dotted(node.func) or ""
+                    if fn.rsplit(".", 1)[-1] in ("configure", "parse_spec") and node.args:
+                        note(const_str(node.args[0]), sf.path, node.lineno)
+                elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    if node.value.startswith("DTT_FAULT="):
+                        # "DTT_FAULT=spec" shell-style literals.
+                        note(node.value.split("=", 1)[1], sf.path, node.lineno)
+                    else:
+                        # A bare string constant counts as an arming spec
+                        # only when it parses AND names at least one known
+                        # call site — bench passes specs through variables
+                        # (``env["DTT_FAULT"] = spec``), and this anchor
+                        # keeps "localhost:8080"-shaped strings out.
+                        sites = parse_spec_sites(node.value)
+                        if sites and sites & known_sites:
+                            for s in sites:
+                                armed.setdefault(s, (sf.path, node.lineno))
+                elif isinstance(node, ast.Assign):
+                    # env["DTT_FAULT"] = "spec"
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Subscript)
+                            and const_str(t.slice) == "DTT_FAULT"
+                        ):
+                            note(const_str(node.value), sf.path, node.lineno)
+                elif isinstance(node, ast.Dict):
+                    # {"DTT_FAULT": "spec"} env dict literals.
+                    for k, v in zip(node.keys, node.values):
+                        if k is not None and const_str(k) == "DTT_FAULT":
+                            note(const_str(v), sf.path,
+                                 getattr(v, "lineno", node.lineno))
+        return armed

@@ -1,0 +1,229 @@
+"""Rule family 1: jit purity / retrace hazards.
+
+Code reachable from ``jax.jit`` / ``pjit`` / the engines' ``_jit_program``
+hook runs under a tracer: host effects are silently baked in at trace
+time (``time.time()`` becomes a constant), host syncs (``.item()``,
+``float(param)``) stall the dispatch queue, and Python ``if`` on a traced
+value either crashes or — worse — keys a fresh compile per value, the
+recompile class PR 11 (warmup prefix-adoption hole) and PR 12
+(numpy-vs-device-array cache split) shipped fixes for.
+
+Roots are found syntactically: functions passed to ``jax.jit(...)`` /
+``pjit(...)`` / ``*._jit_program(...)``, ``@jax.jit``-style decorators
+(including ``partial(jax.jit, ...)``), and functions returned by a local
+factory whose call is jitted (``self._jit_program(make_step(False), ...)``
+marks ``make_step``'s returned closure). Reachability is same-module:
+calls to module/sibling/local defs recurse. That is deliberately narrow —
+cross-module helpers called from jitted code are rare here and a
+best-effort import resolver would trade real findings for noise.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dttlint.core import Finding, Repo, Rule
+from tools.dttlint.rules.common import (
+    ScopeIndex,
+    body_calls,
+    body_nodes,
+    dotted,
+    param_names,
+)
+
+# Dotted-call prefixes that are host effects inside a traced function.
+_BANNED_PREFIXES = (
+    "time.",           # trace-time constant; also wrong under jit anyway
+    "np.random.",      # host RNG: traced code must use jax.random
+    "numpy.random.",
+    "os.environ",      # env reads are trace-time constants
+    "os.getenv",
+    "random.",         # stdlib host RNG
+)
+_BANNED_EXACT = {"print", "input", "breakpoint"}
+# jax.debug.print / jax.debug.callback are the sanctioned escape hatches.
+_ALLOWED_PREFIXES = ("jax.debug.",)
+
+_HOST_SYNC_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _jit_root_exprs(tree: ast.AST):
+    """(call-node, fn-expr) pairs for every jit-compilation site."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        if (
+            name in ("jax.jit", "pjit", "jax.pjit")
+            or name.endswith(".pjit")
+            or name.endswith("._jit_program")
+            or name == "jit"
+        ):
+            if node.args:
+                yield node, node.args[0]
+
+
+def _decorated_roots(tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            name = dotted(dec) or ""
+            if name in ("jax.jit", "jit", "pjit", "jax.pjit"):
+                yield node
+            elif isinstance(dec, ast.Call):
+                cname = dotted(dec.func) or ""
+                if cname in ("jax.jit", "jit", "pjit", "jax.pjit"):
+                    yield node
+                elif cname.endswith("partial") and dec.args:
+                    inner = dotted(dec.args[0]) or ""
+                    if inner in ("jax.jit", "jit", "pjit", "jax.pjit"):
+                        yield node
+
+
+class JitPurityRule(Rule):
+    id = "jit-purity"
+    doc = "no host effects, host syncs, or traced-value branches under jit"
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in repo.modules():
+            if sf.path.startswith("tests/"):
+                continue
+            out.extend(self._run_module(sf))
+        return out
+
+    def _run_module(self, sf) -> list[Finding]:
+        index = ScopeIndex(sf.tree)
+        roots: list[ast.AST] = list(_decorated_roots(sf.tree))
+        for call, fn_expr in _jit_root_exprs(sf.tree):
+            if isinstance(fn_expr, ast.Name):
+                hit = index.resolve(fn_expr.id, call)
+                if hit is not None:
+                    roots.append(hit)
+            elif isinstance(fn_expr, ast.Lambda):
+                roots.append(fn_expr)
+            elif isinstance(fn_expr, ast.Call):
+                # self._jit_program(make_step(False), ...): the factory's
+                # returned closures are the traced functions.
+                factory_name = dotted(fn_expr.func)
+                if factory_name and "." not in factory_name:
+                    factory = index.resolve(factory_name, call)
+                    if factory is not None:
+                        roots.extend(index.returned_defs(factory))
+
+        # Same-module reachability from the roots.
+        reachable: list[ast.FunctionDef | ast.AsyncFunctionDef] = []
+        seen: set[ast.AST] = set()
+        frontier = [r for r in roots if isinstance(r, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        lambdas = [r for r in roots if isinstance(r, ast.Lambda)]
+        while frontier:
+            fn = frontier.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            reachable.append(fn)
+            for call in body_calls(fn):
+                if isinstance(call.func, ast.Name):
+                    hit = index.resolve(call.func.id, call)
+                    if hit is not None and hit not in seen:
+                        frontier.append(hit)
+
+        out: list[Finding] = []
+        for fn in reachable:
+            out.extend(self._check_fn(sf, fn, fn.name))
+        for lam in lambdas:
+            out.extend(self._check_lambda(sf, lam))
+        return out
+
+    def _check_fn(self, sf, fn, label: str) -> list[Finding]:
+        out: list[Finding] = []
+        params = param_names(fn)
+        for node in body_nodes(fn):
+            if isinstance(node, ast.Call):
+                out.extend(self._check_call(sf, node, params, label))
+            elif isinstance(node, ast.Subscript):
+                if (dotted(node.value) or "").endswith("os.environ"):
+                    out.append(Finding(
+                        self.id, sf.path, node.lineno,
+                        f"os.environ read inside jit-reachable {label}() is a "
+                        "trace-time constant",
+                    ))
+            elif isinstance(node, (ast.If, ast.While)):
+                hazard = self._traced_branch_hazard(node.test, params)
+                if hazard:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append(Finding(
+                        self.id, sf.path, node.lineno,
+                        f"Python `{kind}` on traced parameter {hazard!r} in "
+                        f"jit-reachable {label}() — use lax.cond/jnp.where "
+                        "(recompile / ConcretizationTypeError hazard)",
+                    ))
+        return out
+
+    def _check_lambda(self, sf, lam: ast.Lambda) -> list[Finding]:
+        out: list[Finding] = []
+        params = param_names(lam)
+        for node in ast.walk(lam):
+            if isinstance(node, ast.Call):
+                out.extend(self._check_call(sf, node, params, "<lambda>"))
+        return out
+
+    def _check_call(self, sf, call: ast.Call, params: set[str], label: str) -> list[Finding]:
+        out: list[Finding] = []
+        name = dotted(call.func) or ""
+        if name and not name.startswith(_ALLOWED_PREFIXES):
+            if name in _BANNED_EXACT or any(
+                name.startswith(p) or name == p.rstrip(".") for p in _BANNED_PREFIXES
+            ):
+                out.append(Finding(
+                    self.id, sf.path, call.lineno,
+                    f"host effect {name}() inside jit-reachable {label}() "
+                    "(baked in at trace time, not run per step)",
+                ))
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "item" and not call.args:
+            out.append(Finding(
+                self.id, sf.path, call.lineno,
+                f".item() inside jit-reachable {label}() is a host sync "
+                "(ConcretizationTypeError under trace)",
+            ))
+        if (
+            name in _HOST_SYNC_CASTS
+            and len(call.args) == 1
+            and isinstance(call.args[0], ast.Name)
+            and call.args[0].id in params
+        ):
+            out.append(Finding(
+                self.id, sf.path, call.lineno,
+                f"{name}() on traced parameter {call.args[0].id!r} in "
+                f"jit-reachable {label}() forces a host sync "
+                "(the PR 12 numpy-vs-device-array class)",
+            ))
+        return out
+
+    @staticmethod
+    def _traced_branch_hazard(test: ast.AST, params: set[str]) -> str | None:
+        """A bare traced-parameter Name in a branch test. ``x is None`` /
+        ``x is not None`` comparisons are exempt: optional-argument
+        plumbing resolved at trace time, the codebase's dominant static
+        branch idiom."""
+        def is_none_check(node: ast.AST) -> bool:
+            return (
+                isinstance(node, ast.Compare)
+                and all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops)
+                and (
+                    any(isinstance(c, ast.Constant) and c.value is None
+                        for c in node.comparators)
+                    or (isinstance(node.left, ast.Constant) and node.left.value is None)
+                )
+            )
+
+        stack = [test]
+        while stack:
+            node = stack.pop()
+            if is_none_check(node):
+                continue
+            if isinstance(node, ast.Name) and node.id in params:
+                return node.id
+            stack.extend(ast.iter_child_nodes(node))
+        return None

@@ -1,0 +1,296 @@
+"""Rule family 3: lock discipline for the threaded serving classes.
+
+The scheduler driver, fleet registry prober, handoff outbox, deploy
+watcher, and obs registry each own a ``threading.Lock``/``RLock`` and
+are mutated from several threads (HTTP handler threads, the driver, the
+watcher). Three checks:
+
+* ``lock-mixed`` — an attribute mutated under ``with self._lock`` in one
+  method and outside it in another is a torn-read/lost-update bug
+  waiting for load (the PR 13 died-mid-probe double count was exactly
+  this shape). ``__init__`` is exempt: construction happens-before
+  thread start.
+* ``lock-blocking`` — blocking work while holding the lock (HTTP
+  requests, ``subprocess``, timeout-less ``queue.get()``, long
+  ``time.sleep``) stalls every thread that touches the class; the
+  scheduler's drain path and the registry's probe loop both depend on
+  sub-ms critical sections.
+* ``wallclock-deadline`` — deadlines computed from ``time.time()``
+  jump with NTP steps; threads must wait on ``time.monotonic()``.
+  (Wall-clock *reporting* — ``t_wall`` fields — is fine and untouched.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dttlint.core import Finding, Repo, Rule
+from tools.dttlint.rules.common import dotted, self_attr
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+
+_MUTATOR_METHODS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "pop", "popleft", "remove", "discard", "clear", "setdefault",
+}
+
+_SLEEP_THRESHOLD_S = 0.05
+
+_BLOCKING_CALL_PREFIXES = (
+    "urllib.request.urlopen", "urlopen", "requests.",
+    "subprocess.", "socket.create_connection",
+)
+
+_QUEUEISH = ("queue", "_q", "outbox", "inbox")
+
+
+def _is_queueish(key: str) -> bool:
+    k = key.lower()
+    return k == "q" or k.endswith("_q") or any(s in k for s in _QUEUEISH)
+
+
+class _ClassScan:
+    """Mutation sites and lock usage for one ClassDef."""
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.lock_attrs: set[str] = set()
+        # attr -> [(line, method, under_lock)]
+        self.mutations: dict[str, list[tuple[int, str, bool]]] = {}
+        self.blocking: list[tuple[int, str, str]] = []  # line, method, what
+        self._find_locks()
+        if self.lock_attrs:
+            self._scan_methods()
+
+    def _find_locks(self) -> None:
+        for node in ast.walk(self.cls):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                ctor = dotted(node.value.func) or ""
+                if ctor in _LOCK_CTORS:
+                    for t in node.targets:
+                        attr = self_attr(t)
+                        if attr is not None:
+                            self.lock_attrs.add(attr)
+
+    def _scan_methods(self) -> None:
+        for item in self.cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue
+            self._scan_block(item.body, item.name, under_lock=False)
+
+    def _holds_lock(self, with_node: ast.With) -> bool:
+        for w in with_node.items:
+            expr = w.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func  # with self._cond: vs with self._cond.wait_for(...)
+            attr = self_attr(expr)
+            if attr in self.lock_attrs:
+                return True
+        return False
+
+    def _scan_block(self, stmts, method: str, under_lock: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                inner = under_lock or self._holds_lock(stmt)
+                self._record_exprs(stmt.items, method, under_lock)
+                self._scan_block(stmt.body, method, inner)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Nested callbacks run on whoever calls them — scan as
+                # not-under-lock (conservative for the blocking check,
+                # and mutation sites there are still mutation sites).
+                self._scan_block(stmt.body, f"{method}.{stmt.name}", False)
+                continue
+            self._record_stmt(stmt, method, under_lock)
+            for fname in ("body", "orelse", "finalbody"):
+                block = getattr(stmt, fname, None)
+                if isinstance(block, list):
+                    self._scan_block(block, method, under_lock)
+            for h in getattr(stmt, "handlers", []) or []:
+                self._scan_block(h.body, method, under_lock)
+
+    def _record_exprs(self, items, method: str, under_lock: bool) -> None:
+        for w in items:
+            self._record_node(w.context_expr, method, under_lock)
+
+    def _record_stmt(self, stmt: ast.stmt, method: str, under_lock: bool) -> None:
+        # Assignment targets: self.X = / self.X += / self.X[k] =
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            flat = [t.elts] if isinstance(t, (ast.Tuple, ast.List)) else [[t]]
+            for group in flat:
+                for e in group:
+                    self._record_target(e, stmt.lineno, method, under_lock)
+        # Expression statements and nested expressions: mutator calls.
+        self._record_node(stmt, method, under_lock, skip_stmts=True)
+
+    def _record_target(self, e: ast.AST, line: int, method: str, under_lock: bool) -> None:
+        attr = self_attr(e)
+        if attr is None and isinstance(e, ast.Subscript):
+            attr = self_attr(e.value)
+        if attr is not None and attr not in self.lock_attrs:
+            self.mutations.setdefault(attr, []).append((line, method, under_lock))
+
+    def _record_node(self, root: ast.AST, method: str, under_lock: bool,
+                     skip_stmts: bool = False) -> None:
+        stack = list(ast.iter_child_nodes(root)) if skip_stmts else [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.stmt) or isinstance(node, ast.Lambda):
+                continue  # nested statements are handled by the block scan
+            if isinstance(node, ast.Call):
+                self._record_call(node, method, under_lock)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _record_call(self, call: ast.Call, method: str, under_lock: bool) -> None:
+        name = dotted(call.func) or ""
+        # self.X.append(...) — container mutation of attribute X.
+        if isinstance(call.func, ast.Attribute) and call.func.attr in _MUTATOR_METHODS:
+            attr = self_attr(call.func.value)
+            if attr is not None and attr not in self.lock_attrs:
+                self.mutations.setdefault(attr, []).append(
+                    (call.lineno, method, under_lock))
+        if not under_lock:
+            return
+        # Blocking calls while the lock is held.
+        if name == "time.sleep" and call.args:
+            a = call.args[0]
+            if not (isinstance(a, ast.Constant)
+                    and isinstance(a.value, (int, float))
+                    and a.value <= _SLEEP_THRESHOLD_S):
+                self.blocking.append(
+                    (call.lineno, method,
+                     "time.sleep() (non-trivial or unbounded duration)"))
+        elif any(name.startswith(p) for p in _BLOCKING_CALL_PREFIXES):
+            self.blocking.append((call.lineno, method, f"{name}()"))
+        elif (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in ("get", "join", "wait")
+            and not call.args
+            and not any(kw.arg == "timeout" for kw in call.keywords)
+        ):
+            recv = self_attr(call.func.value)
+            if recv is None and isinstance(call.func.value, ast.Name):
+                recv = call.func.value.id
+            if recv is not None and _is_queueish(recv):
+                self.blocking.append(
+                    (call.lineno, method,
+                     f"timeout-less {recv}.{call.func.attr}()"))
+
+
+class LockMixedRule(Rule):
+    id = "lock-mixed"
+    doc = "attribute mutated both under and outside the owner's lock"
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in repo.modules():
+            if sf.path.startswith("tests/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                scan = _ClassScan(node)
+                for attr, sites in sorted(scan.mutations.items()):
+                    locked = [s for s in sites if s[2]]
+                    unlocked = [s for s in sites if not s[2]]
+                    if not locked or not unlocked:
+                        continue
+                    lref = locked[0]
+                    for line, method, _ in unlocked:
+                        out.append(Finding(
+                            self.id, sf.path, line,
+                            f"{node.name}.{attr} is mutated here ({method}) "
+                            f"without the lock, but under it in "
+                            f"{lref[1]} (line {lref[0]}) — torn "
+                            "read/lost update across threads",
+                        ))
+        return out
+
+
+class LockBlockingRule(Rule):
+    id = "lock-blocking"
+    doc = "blocking call made while holding the owner's lock"
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in repo.modules():
+            if sf.path.startswith("tests/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                scan = _ClassScan(node)
+                for line, method, what in scan.blocking:
+                    out.append(Finding(
+                        self.id, sf.path, line,
+                        f"{what} while holding {node.name}'s lock "
+                        f"(in {method}) stalls every thread touching "
+                        "this object",
+                    ))
+        return out
+
+
+class WallclockDeadlineRule(Rule):
+    id = "wallclock-deadline"
+    doc = "deadline computed from time.time() instead of time.monotonic()"
+
+    _DEADLINE_NAMES = ("deadline", "expires", "expiry", "give_up")
+
+    @classmethod
+    def _deadlineish(cls, name: str | None) -> bool:
+        return name is not None and any(s in name.lower() for s in cls._DEADLINE_NAMES)
+
+    @staticmethod
+    def _has_walltime_call(node: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Call) and dotted(n.func) == "time.time"
+            for n in ast.walk(node)
+        )
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in repo.modules():
+            if sf.path.startswith("tests/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                    names = []
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            names.append(t.id)
+                        else:
+                            attr = self_attr(t)
+                            if attr:
+                                names.append(attr)
+                    if (any(self._deadlineish(n) for n in names)
+                            and node.value is not None
+                            and self._has_walltime_call(node.value)):
+                        out.append(Finding(
+                            self.id, sf.path, node.lineno,
+                            f"deadline {names[0]!r} computed from time.time() "
+                            "— wall clock jumps under NTP; use "
+                            "time.monotonic()",
+                        ))
+                elif isinstance(node, ast.Compare):
+                    sides = [node.left, *node.comparators]
+                    if any(self._has_walltime_call(s) for s in sides) and any(
+                        self._deadlineish(s.id) for s in sides
+                        if isinstance(s, ast.Name)
+                    ):
+                        out.append(Finding(
+                            self.id, sf.path, node.lineno,
+                            "deadline compared against time.time() — use "
+                            "time.monotonic()",
+                        ))
+        return out

@@ -1,0 +1,146 @@
+"""Rule family 6: metric-name drift.
+
+The gates run on *scraped* metrics: loadgen, bench, bench_diff and the
+tests read Prometheus/JSON snapshots and compare ``sample["name"]``
+against string literals. A rename on the registration side leaves the
+scraper reading nothing — and because several FLOORS entries gate on
+"value present", a drifted name silently un-gates a floor. This rule
+makes the scrape side resolve against the registration side:
+
+* **registered families** — first-arg literals of every
+  ``*.counter(...)`` / ``*.gauge(...)`` / ``*.histogram(...)`` call in
+  the package (histograms also export ``_bucket``/``_count``/``_sum``);
+* **the constants choke point** — ``serve/metric_names.py`` holds the
+  names loadgen/bench scrape; every constant must be a registered
+  family;
+* **scrape sites** — comparisons against a ``[...]["name"]`` subscript
+  (or ``.get("name")``): a metric-shaped literal that is not a
+  registered family is drift; in ``tools/loadgen.py`` / ``bench.py``
+  the literal should be a ``metric_names`` constant so renames are
+  one-line diffs (inline literals flag even when currently correct).
+
+Only literals matching the repo's family prefixes are considered, so
+flight-recorder event names (``e["name"] == "unhandled_exception"``)
+stay out of scope. Test files may register their own families
+(``rpc_seconds`` in the aggregation tests); in-file registrations are
+honored.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.dttlint.core import Finding, Repo, Rule
+from tools.dttlint.rules.common import const_str, dotted
+
+_REGISTER_FNS = {"counter", "gauge", "histogram"}
+
+# Family-name shape: the prefixes actually registered in this repo.
+_METRIC_SHAPED = re.compile(
+    r"^(serve|fleet|recompile|train|lm|ckpt|obs|rpc|skipped|slo)_[a-z0-9_]+$"
+)
+
+_HIST_SUFFIXES = ("_bucket", "_count", "_sum")
+
+# Files whose inline scrape literals must go through the constants module.
+_CHOKE_POINT_FILES = ("tools/loadgen.py", "bench.py")
+
+
+def _registered_in(tree: ast.AST) -> set[str]:
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr not in _REGISTER_FNS:
+            continue
+        if not node.args:
+            continue
+        lit = const_str(node.args[0])
+        if lit is not None and _METRIC_SHAPED.match(lit):
+            names.add(lit)
+            if node.func.attr == "histogram":
+                names.update(lit + s for s in _HIST_SUFFIXES)
+    return names
+
+
+def _name_subscript(node: ast.AST) -> bool:
+    """True for ``X[...]["name"]`` expressions — the scrape idiom.
+    (``e.get("name")`` is deliberately NOT matched: that is the
+    flight-recorder *event* idiom, a different namespace.)"""
+    return isinstance(node, ast.Subscript) and const_str(node.slice) == "name"
+
+
+class MetricDriftRule(Rule):
+    id = "metric-drift"
+    doc = "scraped metric names resolve to registered metric families"
+
+    def run(self, repo: Repo) -> list[Finding]:
+        registered: set[str] = set()
+        for sf in repo.modules("distributed_tensorflow_tpu/"):
+            registered |= _registered_in(sf.tree)
+
+        out: list[Finding] = []
+
+        # The constants choke point: every constant must be registered.
+        constants: dict[str, str] = {}  # constant name -> value
+        mn = repo.find("serve/metric_names.py")
+        if mn is not None and mn.tree is not None:
+            for node in ast.walk(mn.tree):
+                if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+                    v = const_str(node.value)
+                    for t in node.targets:
+                        tname = getattr(t, "id", "")
+                        if tname.isupper() and v is not None:
+                            constants[tname] = v
+                            if not self._known(v, registered):
+                                out.append(Finding(
+                                    self.id, mn.path, node.lineno,
+                                    f"metric_names.{tname} = {v!r} does not "
+                                    "match any registered metric family",
+                                ))
+
+        # Scrape sites.
+        for sf in repo.modules():
+            if sf.path.startswith("distributed_tensorflow_tpu/"):
+                continue  # registration side; scrapers live outside
+            local = registered | _registered_in(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Compare) or len(node.comparators) != 1:
+                    continue
+                left, right = node.left, node.comparators[0]
+                lit = None
+                if _name_subscript(left):
+                    lit = const_str(right)
+                elif _name_subscript(right):
+                    lit = const_str(left)
+                if lit is None or not _METRIC_SHAPED.match(lit):
+                    continue
+                if not self._known(lit, local):
+                    out.append(Finding(
+                        self.id, sf.path, node.lineno,
+                        f"scraped metric name {lit!r} matches no registered "
+                        "family — the scrape reads nothing and any gate on "
+                        "it silently un-gates",
+                    ))
+                elif sf.path in _CHOKE_POINT_FILES:
+                    out.append(Finding(
+                        self.id, sf.path, node.lineno,
+                        f"inline metric literal {lit!r} in {sf.path} — "
+                        "scrape through serve/metric_names.py so renames "
+                        "are one-line diffs",
+                    ))
+        return out
+
+    @staticmethod
+    def _known(name: str, registered: set[str]) -> bool:
+        if name in registered:
+            return True
+        # A labeled family rendered with a suffixed variant, or a
+        # histogram component of a registered family.
+        for s in _HIST_SUFFIXES:
+            if name.endswith(s) and name[: -len(s)] in registered:
+                return True
+        return False

@@ -1,0 +1,195 @@
+"""Rule family 5: typed-outcome exhaustiveness.
+
+Three surfaces partition every request outcome and must stay in sync:
+
+* the ``Rejection(kind)`` literals constructed in ``serve/`` (scheduler
+  admission/shed paths, handoff import rejections);
+* ``serve/server.py``'s ``_REJECTION_STATUS`` map (kind → HTTP status —
+  an unmapped kind falls through to a generic 500 and the client loses
+  the typed signal);
+* ``tools/loadgen.py``'s outcome partition (``_exhausted_reasons`` /
+  ``_capacity_shed_reasons`` / ``"deadline"``) — the zero-silent-drop
+  gates (PR 16) count on every reason landing in exactly one bucket, so
+  a new kind that silently falls into the generic ``shed`` bucket
+  un-types the accounting.
+
+Router-side error tags (``{"error": "upstream_unreachable"}`` dict
+literals in ``serve/fleet/router.py``) join the universe: loadgen sees
+them through the same ``error`` field.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.dttlint.core import Finding, Repo, Rule
+from tools.dttlint.rules.common import const_str
+
+# Router "error" tags that are transport phases, not terminal outcome
+# kinds loadgen buckets (they surface re-typed: connect_error trail
+# entries, etc.).
+_NON_OUTCOME_TAGS = frozenset({"transport"})
+
+
+def _set_literal(node: ast.AST) -> set[str] | None:
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        out = set()
+        for e in node.elts:
+            s = const_str(e)
+            if s is None:
+                return None
+            out.add(s)
+        return out
+    if isinstance(node, ast.Call) and getattr(node.func, "id", "") in ("set", "frozenset"):
+        return _set_literal(node.args[0]) if node.args else set()
+    return None
+
+
+class RejectionKindsRule(Rule):
+    id = "rejection-kinds"
+    doc = "Rejection kinds == server status map == loadgen outcome partition"
+
+    def run(self, repo: Repo) -> list[Finding]:
+        kinds = self._constructed_kinds(repo)       # kind -> (path, line)
+        status, status_loc = self._status_map(repo)
+        router_tags = self._router_tags(repo)       # tag -> (path, line)
+        partition, part_loc = self._loadgen_partition(repo)
+
+        out: list[Finding] = []
+        if status is not None:
+            for kind, (path, line) in sorted(kinds.items()):
+                if kind not in status:
+                    out.append(Finding(
+                        self.id, path, line,
+                        f"Rejection kind {kind!r} has no entry in "
+                        "serve/server.py _REJECTION_STATUS — clients get "
+                        "an untyped 500",
+                    ))
+            for kind in sorted(status - set(kinds)):
+                out.append(Finding(
+                    self.id, status_loc[0], status_loc[1].get(kind, 1),
+                    f"_REJECTION_STATUS maps {kind!r} but no serve/ code "
+                    "constructs that Rejection kind (dead map entry)",
+                ))
+        if partition is not None:
+            universe = dict(kinds)
+            for tag, loc in router_tags.items():
+                universe.setdefault(tag, loc)
+            buckets, bucket_names = partition
+            flat: set[str] = set()
+            for bname, bset in buckets.items():
+                dup = flat & bset
+                for d in sorted(dup):
+                    out.append(Finding(
+                        self.id, part_loc[0], part_loc[1],
+                        f"outcome reason {d!r} appears in more than one "
+                        "loadgen partition bucket",
+                    ))
+                flat |= bset
+            for kind, (path, line) in sorted(universe.items()):
+                if kind not in flat:
+                    out.append(Finding(
+                        self.id, path, line,
+                        f"outcome reason {kind!r} is not claimed by any "
+                        f"loadgen partition bucket ({bucket_names}) — it "
+                        "falls into the generic shed count untyped",
+                    ))
+            # "deadline" is the rule's own implicit bucket, not a declared
+            # loadgen set entry — never report it as stale.
+            for name in sorted(flat - set(universe) - {"deadline"}):
+                out.append(Finding(
+                    self.id, part_loc[0], part_loc[1],
+                    f"loadgen partition names {name!r} but nothing in "
+                    "serve/ produces that reason (stale partition entry)",
+                ))
+        return out
+
+    @staticmethod
+    def _constructed_kinds(repo: Repo) -> dict[str, tuple[str, int]]:
+        kinds: dict[str, tuple[str, int]] = {}
+        for sf in repo.modules("distributed_tensorflow_tpu/serve"):
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", "")
+                lit = None
+                if name == "Rejection":
+                    if len(node.args) >= 2:
+                        lit = const_str(node.args[1])
+                    for kw in node.keywords:
+                        if kw.arg == "reason":
+                            lit = const_str(kw.value)
+                elif "reject" in name.lower():
+                    # _reject_handoff(pending, "insufficient_pages", ...)
+                    # style forwarding helpers.
+                    for a in node.args:
+                        s = const_str(a)
+                        if s is not None and s.replace("_", "").isalpha() and s.islower():
+                            lit = s
+                            break
+                if lit is not None:
+                    kinds.setdefault(lit, (sf.path, node.lineno))
+        return kinds
+
+    @staticmethod
+    def _status_map(repo: Repo):
+        sf = repo.find("serve/server.py")
+        if sf is None or sf.tree is None:
+            return None, ("", {})
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and any(getattr(t, "id", "") == "_REJECTION_STATUS" for t in node.targets)
+                and isinstance(node.value, ast.Dict)
+            ):
+                keys: set[str] = set()
+                lines: dict[str, int] = {}
+                for k in node.value.keys:
+                    s = const_str(k) if k is not None else None
+                    if s is not None:
+                        keys.add(s)
+                        lines[s] = k.lineno
+                return keys, (sf.path, lines)
+        return None, ("", {})
+
+    @staticmethod
+    def _router_tags(repo: Repo) -> dict[str, tuple[str, int]]:
+        sf = repo.find("serve/fleet/router.py")
+        tags: dict[str, tuple[str, int]] = {}
+        if sf is None or sf.tree is None:
+            return tags
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if k is not None and const_str(k) == "error":
+                    s = const_str(v)
+                    if s is not None and s not in _NON_OUTCOME_TAGS:
+                        tags.setdefault(s, (sf.path, v.lineno))
+        return tags
+
+    @staticmethod
+    def _loadgen_partition(repo: Repo):
+        """loadgen's bucket sets: ``_exhausted_reasons``,
+        ``_capacity_shed_reasons``, plus the literal ``"deadline"``
+        bucket. Returns ((buckets, names), (path, line)) or (None, ...)."""
+        sf = repo.find("tools/loadgen.py")
+        if sf is None or sf.tree is None:
+            return None, ("", 1)
+        buckets: dict[str, set[str]] = {}
+        line = 1
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    tname = getattr(t, "id", "")
+                    if tname in ("_exhausted_reasons", "_capacity_shed_reasons"):
+                        s = _set_literal(node.value)
+                        if s is not None:
+                            buckets[tname] = s
+                            line = node.lineno
+        if not buckets:
+            return None, ("", 1)
+        buckets["deadline"] = {"deadline"}
+        names = " + ".join(sorted(buckets))
+        return (buckets, names), (sf.path, line)

@@ -432,28 +432,29 @@ def _scrape_health(url, server):
             from distributed_tensorflow_tpu.obs.export import (
                 parse_prometheus_text,
             )
+            from distributed_tensorflow_tpu.serve import metric_names as mn
 
             for sample in parse_prometheus_text(text):
-                if sample["name"] == "recompile_events_total":
+                if sample["name"] == mn.RECOMPILE_EVENTS_TOTAL:
                     recompiles = int(sample["value"])
-                elif sample["name"] == "serve_prefix_hit_rate":
+                elif sample["name"] == mn.SERVE_PREFIX_HIT_RATE:
                     fastpath["prefix_hit_rate"] = float(sample["value"])
-                elif sample["name"] == "serve_spec_accept_rate":
+                elif sample["name"] == mn.SERVE_SPEC_ACCEPT_RATE:
                     fastpath["spec_accept_rate"] = float(sample["value"])
-                elif sample["name"] == "serve_spec_accept_rate_by_drafter":
+                elif sample["name"] == mn.SERVE_SPEC_ACCEPT_RATE_BY_DRAFTER:
                     drafter = sample.get("labels", {}).get("drafter", "?")
                     fastpath["spec_accept_rate_by_drafter"][drafter] = float(
                         sample["value"])
-                elif sample["name"] == "serve_weight_bytes_per_device":
+                elif sample["name"] == mn.SERVE_WEIGHT_BYTES_PER_DEVICE:
                     fastpath["weight_bytes_per_device"] = int(sample["value"])
-                elif sample["name"] == "serve_kv_bytes_per_token":
+                elif sample["name"] == mn.SERVE_KV_BYTES_PER_TOKEN:
                     fastpath["kv_bytes_per_token"] = float(sample["value"])
-                elif sample["name"] == "serve_spec_accept_per_verify":
+                elif sample["name"] == mn.SERVE_SPEC_ACCEPT_PER_VERIFY:
                     fastpath["spec_accept_per_verify"] = float(sample["value"])
-                elif sample["name"] == "serve_spec_accepted_per_verify_p50":
+                elif sample["name"] == mn.SERVE_SPEC_ACCEPTED_PER_VERIFY_P50:
                     fastpath["spec_accepted_per_verify_p50"] = float(
                         sample["value"])
-                elif sample["name"] == "serve_spec_accepted_per_verify_p99":
+                elif sample["name"] == mn.SERVE_SPEC_ACCEPTED_PER_VERIFY_P99:
                     fastpath["spec_accepted_per_verify_p99"] = float(
                         sample["value"])
         except Exception:
@@ -919,13 +920,19 @@ def main(argv=None):
     accounted = (acct.completed + acct.shed + acct.errored
                  + acct.stream_aborted)
     # Typed outcome classes: every request lands in exactly one. A shed
-    # splits by reason — "deadline" (budget expired before service) and
-    # failover exhaustion (the router ran out of upstreams) are distinct
-    # operator signals from capacity sheds.
+    # splits by reason — "deadline" (budget expired before service),
+    # failover exhaustion (the router ran out of upstreams), and capacity
+    # sheds (the scheduler/server refused admission) are distinct operator
+    # signals. Together with "deadline" these sets must claim every
+    # Rejection kind and router error tag (dttlint rejection-kinds).
     _exhausted_reasons = {"upstream_unreachable", "upstream_died",
                           "no_upstream"}
+    _capacity_shed_reasons = {"queue_full", "shutting_down",
+                              "insufficient_pages", "invalid", "not_found"}
     failover_exhausted = sum(
         v for k, v in acct.shed_reasons.items() if k in _exhausted_reasons)
+    capacity_shed = sum(
+        v for k, v in acct.shed_reasons.items() if k in _capacity_shed_reasons)
     deadline_shed = acct.shed_reasons.get("deadline", 0)
     report = {
         "num_requests": args.num_requests,
@@ -937,7 +944,9 @@ def main(argv=None):
             "ok": acct.completed,
             "deadline": deadline_shed,
             "failover_exhausted": failover_exhausted,
-            "shed": acct.shed - deadline_shed - failover_exhausted,
+            "capacity_shed": capacity_shed,
+            "shed_unknown": (acct.shed - deadline_shed
+                             - failover_exhausted - capacity_shed),
             "stream_aborted": acct.stream_aborted,
             "errored": acct.errored,
         },
