@@ -2673,6 +2673,294 @@ def bench_fleet_handoff_perf() -> list[dict]:
     ]
 
 
+def bench_fleet_rollout() -> list[dict]:
+    """ISSUE 19's acceptance run: fleet-coordinated rollouts.
+
+    Three subprocess replicas behind a router take open-loop loadgen
+    traffic while a :class:`RolloutController` walks a committed
+    checkpoint step across them one at a time — the walk must converge
+    (every replica live on the step), with zero silent drops
+    (``loadgen --smoke`` exits nonzero on one) and zero post-warmup
+    recompiles on any replica. Then a ``DTT_FAULT=deploy_nan``-armed
+    replica poisons the NEXT step's canary: the walk must halt there
+    and roll the already-updated replicas back fleet-wide, leaving
+    every replica on the prior step. Finally the SLO-gated canary ramp
+    runs against the live fleet: it widens on clean signal and must
+    NARROW back to the first rung on an injected latency breach BEFORE
+    reaching full promotion, with the narrowed percent visible on every
+    replica's variant table."""
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from serve_fleet import launch_fleet
+
+    from distributed_tensorflow_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from distributed_tensorflow_tpu.obs.export import parse_prometheus_text
+    from distributed_tensorflow_tpu.obs.slo import SloMonitor, SloRule
+    from distributed_tensorflow_tpu.serve import metric_names as mn
+    from distributed_tensorflow_tpu.serve.fleet import (
+        CanaryRamp,
+        FleetRouter,
+        ReplicaRegistry,
+        RolloutController,
+        make_router_server,
+    )
+    from distributed_tensorflow_tpu.train.checkpoint import (
+        write_committed_step,
+    )
+
+    if SMOKE:
+        dims = dict(vocab_size=64, d_model=32, num_heads=4, num_layers=2,
+                    d_ff=64, max_seq_len=32)
+        slots, prefill_len = 2, 12
+        rate, n_load = 2.0, 120
+        shape_note = "smoke shape (64v/32d x2)"
+    else:
+        dims = dict(vocab_size=256, d_model=64, num_heads=4, num_layers=2,
+                    d_ff=256, max_seq_len=64)
+        slots, prefill_len = 4, 16
+        rate, n_load = 4.0, 240
+        shape_note = "256v/64d x2"
+    cfg = TransformerConfig(compute_dtype=jnp.float32, **dims)
+    argv = ["--demo",
+            "--vocab_size", str(dims["vocab_size"]),
+            "--d_model", str(dims["d_model"]),
+            "--num_heads", str(dims["num_heads"]),
+            "--num_layers", str(dims["num_layers"]),
+            "--d_ff", str(dims["d_ff"]),
+            "--seq_len", str(dims["max_seq_len"]),
+            "--slots", str(slots),
+            "--prefill_len", str(prefill_len),
+            "--serve_max_len", str(dims["max_seq_len"]),
+            "--drain_deadline_s", "10",
+            # A variant table on every replica: the ramp's percent
+            # pushes land on the same surface the rollout pushes use.
+            "--canary_percent", "1"]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    poisoned_env = dict(env)
+    # after=1: the baseline step's push passes, the next one poisons.
+    poisoned_env["DTT_FAULT"] = "deploy_nan:after=1"
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+
+    model = TransformerLM(cfg)
+    zeros = jnp.zeros((1, 8), jnp.int32)
+    good = model.init(jax.random.PRNGKey(1), zeros)["params"]
+    newer = model.init(jax.random.PRNGKey(2), zeros)["params"]
+    ckpt = tempfile.mkdtemp(prefix="bench_rollout_ck_")
+
+    def run_loadgen(target, extra):
+        with tempfile.NamedTemporaryFile(mode="r", suffix=".jsonl") as fh:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(tools_dir, "loadgen.py"),
+                 "--targets", target, "--smoke", "--seed", "0",
+                 "--prompt_len", "8", "--max_new_tokens", "12",
+                 "--timeout_s", "120", "--report_file", fh.name, *extra],
+                env=env, capture_output=True, text=True, timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"loadgen failed rc={proc.returncode} (a silent DROP "
+                    f"fails --smoke): {proc.stderr[-500:]}")
+            return json.loads(fh.read().strip().splitlines()[-1])
+
+    def healthz(url):
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as resp:
+            return json.loads(resp.read())
+
+    replicas = launch_fleet(2, argv, env=env)
+    rserver = None
+    load_out: dict = {}
+    load_err: list = []
+    try:
+        replicas += launch_fleet(1, argv, env=poisoned_env)
+        registry = ReplicaRegistry(up_after=1, down_after=3,
+                                   probe_timeout_s=10.0)
+        for i, rp in enumerate(replicas):
+            registry.add(rp.url, replica_id=f"r{i:02d}")
+        registry.probe_once()
+        assert registry.up_count() == 3
+        router = FleetRouter(registry, read_timeout_s=120.0)
+        rserver = make_router_server(router, port=0)
+        threading.Thread(target=rserver.serve_forever,
+                         daemon=True).start()
+        rhost, rport = rserver.server_address
+        router_url = f"http://{rhost}:{rport}"
+
+        def pound():
+            try:
+                load_out.update(run_loadgen(router_url, [
+                    "--rate", str(rate), "--num_requests", str(n_load)]))
+            except Exception as exc:  # surfaced after the walks
+                load_err.append(exc)
+
+        load_thread = threading.Thread(target=pound, daemon=True)
+        load_thread.start()
+
+        # ---- clean walk under load ---------------------------------------
+        ctrl = RolloutController(registry, ckpt, settle_timeout_s=300.0,
+                                 settle_poll_s=0.05, push_timeout_s=60.0,
+                                 start_after=0)
+        write_committed_step(ckpt, 1, {"params": good})
+        t0 = time.perf_counter()
+        assert ctrl.poll_once() == 1
+        walk_s = time.perf_counter() - t0
+        res = ctrl.last
+        assert res.outcome == "committed", res.to_dict()
+        assert res.updated == ("r00", "r01", "r02")
+        for rp in replicas:
+            assert healthz(rp.url)["deploy"]["weight_version"] == 1
+
+        # ---- poisoned walk: halt at r02, fleet-wide rollback -------------
+        registry.probe_once()  # pin the rollback priors at step 1
+        write_committed_step(ckpt, 2, {"params": newer})
+        assert ctrl.poll_once() == 2
+        res = ctrl.last
+        assert res.outcome == "rolled_back", res.to_dict()
+        assert res.halted_at == "r02"
+        assert res.rolled_back == ("r00", "r01")
+        for rp in replicas:
+            assert healthz(rp.url)["deploy"]["weight_version"] == 1
+        halt_rollback = 1.0
+
+        load_thread.join(timeout=600)
+        if load_err:
+            raise load_err[0]
+        assert load_out.get("completed", 0) > 0, load_out
+        assert load_out.get("dropped_without_shed", 1) == 0, load_out
+        zero_drops = 1.0
+
+        recompiles = 0.0
+        for rp in replicas:
+            with urllib.request.urlopen(rp.url + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            for sample in parse_prometheus_text(text):
+                if sample["name"] == mn.RECOMPILE_EVENTS_TOTAL:
+                    recompiles += float(sample["value"])
+        assert recompiles == 0.0, recompiles
+        zero_recompiles = 1.0
+
+        # The loadgen report's rollout section (scraped via
+        # serve/metric_names constants) must see both walk outcomes on
+        # the router registry — a tiny post-walk pass reads the final
+        # counters; the under-load report carries the per-replica
+        # weight-version timelines.
+        post = run_loadgen(router_url, ["--num_requests", "8",
+                                        "--concurrency", "2"])
+        totals = post["rollout"]["fleet_rollout_total"]
+        assert totals.get("committed", 0) >= 1, totals
+        assert totals.get("rolled_back", 0) >= 1, totals
+        versions = load_out["rollout"]["versions_observed"]
+        assert 1 in versions, versions
+
+        # ---- SLO-gated ramp: narrow on injected breach -------------------
+        lat_gauge = registry.metrics_registry.gauge(
+            "rollout_bench_latency_signal",
+            "injected latency signal driving the ramp's SLO rule")
+        monitor = SloMonitor(registry.metrics_registry, [SloRule(
+            "rollout_bench_latency", "rollout_bench_latency_signal",
+            100.0)])
+        ramp = CanaryRamp(registry, monitor, variant="canary",
+                          schedule=(5.0, 25.0, 50.0, 100.0), hold_s=0.2)
+        ramp.begin()
+        deadline = time.monotonic() + 60
+        while ramp.rung < 2 and time.monotonic() < deadline:
+            time.sleep(0.25)
+            monitor.evaluate()
+            ramp.tick()
+        assert ramp.rung == 2 and not ramp.done, (
+            f"ramp failed to widen: rung {ramp.rung}")
+        lat_gauge.set(500.0)   # the injected latency breach
+        monitor.evaluate()     # ok -> breach edge reaches the ramp
+        ramp.tick()
+        assert ramp.rung == 0 and ramp.narrowed_total == 1
+        assert not ramp.done   # narrowed BEFORE full promotion
+        for rp in replicas:
+            assert healthz(rp.url)["deploy"]["canary_percent"] == 5.0
+        ramp_narrowed = 1.0
+    finally:
+        if rserver is not None:
+            rserver.shutdown()
+            rserver.server_close()
+        for rp in replicas:
+            rp.terminate()
+
+    return [
+        {
+            "metric": "fleet_rollout_walk_s",
+            "value": walk_s,
+            "unit": "s",
+            "detail": (
+                f"one committed step walked across 3 replicas one at a "
+                f"time under open-loop load ({rate} req/s, {shape_note}): "
+                "push via /admin/deploy, poll /healthz deploy until the "
+                "boundary swap lands live, advance"
+            ),
+        },
+        {
+            "metric": "fleet_rollout_zero_drops",
+            "value": zero_drops,
+            "unit": "bool",
+            "detail": (
+                f"{load_out.get('completed')} completions, 0 requests "
+                "dropped without a typed shed response while BOTH walks "
+                "(clean commit + poisoned halt/rollback) crossed the "
+                "fleet; loadgen --smoke hard-fails on a silent drop; "
+                ">= 1.0 ENFORCED (bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "fleet_rollout_zero_recompiles",
+            "value": zero_recompiles,
+            "unit": "bool",
+            "detail": (
+                "0 post-warmup recompile_events_total across all 3 "
+                "replicas after two fleet walks and a canary rollback "
+                "(swaps are reference flips against prewarmed canary "
+                "programs); hard-asserted in-run; >= 1.0 ENFORCED "
+                "(bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "fleet_rollout_halt_rollback",
+            "value": halt_rollback,
+            "unit": "bool",
+            "detail": (
+                "DTT_FAULT=deploy_nan on replica r02 poisoned step 2's "
+                "canary: the walk halted AT r02 and rolled r00/r01 back "
+                "to step 1 — every replica verified back on the prior "
+                "step, none on the poisoned one; hard-asserted in-run; "
+                ">= 1.0 ENFORCED (bench.FLOORS)"
+            ),
+        },
+        {
+            "metric": "fleet_rollout_ramp_narrowed",
+            "value": ramp_narrowed,
+            "unit": "bool",
+            "detail": (
+                "SLO-gated canary ramp widened 5->25->50 on clean "
+                "signal, then an injected latency breach (gauge-driven "
+                "SloMonitor rule) narrowed it straight back to 5% "
+                "BEFORE full promotion, with the narrowed percent "
+                "pushed to every replica's variant table; hard-asserted "
+                "in-run; >= 1.0 ENFORCED (bench.FLOORS)"
+            ),
+        },
+    ]
+
+
 def bench_hotswap() -> list[dict]:
     """The deploy plane's acceptance run: a live engine adopts a newly
     COMMITTED checkpoint mid-burst with zero dropped requests and zero
@@ -3770,6 +4058,23 @@ FLOORS = {
     "fleet_handoff_perf_token_parity": 1.0,
     "fleet_handoff_perf_zero_recompiles": 1.0,
     "fleet_handoff_perf_zero_silent_fallbacks": 1.0,
+    # ISSUE 19's fleet-rollout gates (bench_fleet_rollout hard-asserts
+    # all four in-run; the floors keep them visible through bench_diff).
+    # Zero drops: loadgen --smoke pounds the router while a committed
+    # step walks the 3-replica fleet AND while a poisoned step halts and
+    # rolls it back — no request may vanish without a typed shed.
+    # Zero recompiles: two fleet walks plus a canary rollback may not
+    # push any replica through a post-warmup re-trace (swaps are
+    # reference flips against prewarmed canary programs).
+    # Halt+rollback: DTT_FAULT=deploy_nan on one replica must halt the
+    # walk AT that replica and restore every already-updated replica to
+    # the prior committed step (nobody left serving the poisoned one).
+    # Ramp narrowed: an injected SLO latency breach must narrow the
+    # canary ramp back to its first rung BEFORE full promotion.
+    "fleet_rollout_zero_drops": 1.0,
+    "fleet_rollout_zero_recompiles": 1.0,
+    "fleet_rollout_halt_rollback": 1.0,
+    "fleet_rollout_ramp_narrowed": 1.0,
 }
 
 # Efficiency floors on the ``frac`` field (fraction of the metric's own
@@ -3961,6 +4266,12 @@ def main() -> None:
             # (test_bench_fleet_handoff_perf_smoke_meets_gates) covers
             # smoke, floors bind on full/TPU runs.
             *(() if SMOKE else (bench_fleet_handoff_perf,)),
+            # The rollout bench boots 3 replica subprocesses and runs
+            # two fleet walks under an open-loop loadgen — same budget
+            # problem, same arrangement: dedicated slow test
+            # (test_bench_fleet_rollout_smoke_meets_gates) covers
+            # smoke, floors bind on full/TPU runs.
+            *(() if SMOKE else (bench_fleet_rollout,)),
             bench_hotswap,
             bench_flash_kernel,
             bench_mnist_real_accuracy,

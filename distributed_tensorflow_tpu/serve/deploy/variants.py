@@ -43,17 +43,25 @@ def variant_lane(client_id: str) -> int:
 
 class Variant:
     """One named weight bundle: a device-staged params tree plus the
-    metadata the fleet advertises (checkpoint step, quant mode)."""
+    metadata the fleet advertises (checkpoint step, quant mode).
 
-    __slots__ = ("name", "params", "step", "weight_dtype", "drafter")
+    A variant either shares the base engine (``engine is None`` — a
+    flip-ready buffer staged through ``stage_weights``) or carries a
+    SIBLING engine of its own (``engine`` set — a params tree whose
+    treedef the base engine would hard-reject, e.g. a retrained head
+    with a different label count)."""
+
+    __slots__ = ("name", "params", "step", "weight_dtype", "drafter",
+                 "engine")
 
     def __init__(self, name, params, step=0, weight_dtype="native",
-                 drafter=""):
+                 drafter="", engine=None):
         self.name = str(name)
         self.params = params
         self.step = int(step)
         self.weight_dtype = str(weight_dtype)
         self.drafter = str(drafter)
+        self.engine = engine
 
 
 class VariantTable:
@@ -107,6 +115,42 @@ class VariantTable:
             self._variants[v.name] = v
         return v
 
+    def set_engine(self, name: str, engine, *, step: int = 0) -> Variant:
+        """Register a CROSS-STRUCTURE variant backed by its own engine.
+
+        ``check_swap_compatible`` hard-rejects a candidate whose treedef
+        differs from the live tree — correct for a buffer flip, fatal
+        for the paper's retrain scenario (same trunk, different head).
+        A sibling engine sidesteps the flip entirely: the variant's
+        params live in ``engine`` and the scheduler rebinds its engine
+        reference (:meth:`engine_for`) at the same empty-iteration
+        boundary where buffer variants flip. Lane routing, metrics, and
+        ``(variant, weight_version)`` attribution are unchanged."""
+        v = Variant(
+            str(name), engine.params, step=step,
+            weight_dtype=getattr(engine, "weight_dtype", "native"),
+            drafter=getattr(engine, "drafter", ""),
+            engine=engine,
+        )
+        if v.name == self.default:
+            raise ValueError(
+                f"default variant {v.name!r} cannot be a sibling engine"
+            )
+        engine.serving_variant = v.name
+        engine.weight_version = int(step)
+        with self._lock:
+            self._variants[v.name] = v
+        return v
+
+    def engine_for(self, name: str):
+        """Engine that runs ``name``: its sibling engine when it carries
+        one, else the table's base engine (buffer-flip variants)."""
+        with self._lock:
+            v = self._variants.get(name)
+        if v is None:
+            raise KeyError(f"unknown variant {name!r}")
+        return v.engine if v.engine is not None else self.engine
+
     def remove(self, name: str) -> None:
         if name == self.default:
             raise ValueError(f"cannot remove the default variant {name!r}")
@@ -126,6 +170,20 @@ class VariantTable:
             return name in self._variants
 
     # -- routing ----------------------------------------------------------
+
+    def set_canary(self, percent: float, variant: str | None = None) -> None:
+        """Retarget the canary lane slice — the SLO-ramp control surface.
+        Router and replicas agree on who is canaried because both compare
+        the same crc32 lane (:func:`variant_lane`) against this percent;
+        pushing the same number everywhere keeps the split coherent."""
+        if not 0.0 <= float(percent) <= 100.0:
+            raise ValueError(
+                f"canary_percent must be in [0, 100], got {percent}"
+            )
+        with self._lock:
+            self.canary_percent = float(percent)
+            if variant:
+                self.canary_variant = str(variant)
 
     def resolve(self, client_id: str) -> str:
         """Variant for a client: its hash lane against the canary rule.
@@ -149,6 +207,12 @@ class VariantTable:
             v = self._variants.get(name)
         if v is None:
             raise KeyError(f"unknown variant {name!r}")
+        if v.engine is not None:
+            # Sibling-engine variant: nothing to flip — the scheduler
+            # rebinds its engine reference (engine_for) at this same
+            # boundary, and the sibling serves only this variant.
+            v.engine.serving_variant = v.name
+            return
         if (self.engine.serving_variant == v.name
                 and self.engine.params is v.params):
             return
@@ -176,6 +240,8 @@ class VariantTable:
                         "step": v.step,
                         "weight_dtype": v.weight_dtype,
                         "drafter": v.drafter,
+                        "engine": "sibling" if v.engine is not None
+                        else "base",
                     }
                     for v in self._variants.values()
                 },

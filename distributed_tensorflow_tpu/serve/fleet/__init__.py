@@ -18,6 +18,10 @@ chief in front of parameter-holding workers.
   cooldowns, drain-then-stop scale-down, dead-replica replacement).
 * :mod:`handoff` — the prefill→decode KV-page handoff: wire codec for
   exported slots and the prefill-side push client.
+* :mod:`rollout` — the fleet deploy plane: a rollout controller that
+  walks committed checkpoint steps across replicas one at a time (halt
+  + fleet-wide rollback on the first canary rejection) and an
+  SLO-gated canary-percent ramp.
 """
 
 from distributed_tensorflow_tpu.serve.fleet.elastic import FleetSupervisor
@@ -31,6 +35,11 @@ from distributed_tensorflow_tpu.serve.fleet.registry import (
     ProbeResult,
     Replica,
     ReplicaRegistry,
+)
+from distributed_tensorflow_tpu.serve.fleet.rollout import (
+    CanaryRamp,
+    RolloutController,
+    RolloutResult,
 )
 from distributed_tensorflow_tpu.serve.fleet.router import (
     FleetRouter,
@@ -48,4 +57,7 @@ __all__ = [
     "HandoffOutbox",
     "encode_bundle",
     "decode_bundle",
+    "RolloutController",
+    "RolloutResult",
+    "CanaryRamp",
 ]

@@ -1,0 +1,462 @@
+"""Fleet-coordinated rollouts: one checkpoint step, one replica at a time.
+
+The paper's chief owns parameter distribution: workers never pick up new
+weights on their own, the chief decides what the cluster runs. PR 12
+rebuilt the single-replica half (``serve/deploy/``: committed-manifest
+watch, boundary swap, canary rollback) but left each replica swapping
+INDEPENDENTLY — a poisoned checkpoint reaches every replica inside one
+poll interval and the whole fleet canaries it simultaneously. This
+module is the chief for serving weights (DESIGN.md §25):
+
+* :class:`RolloutController` watches a committed-checkpoint directory
+  (reusing :class:`deploy.watcher.CheckpointWatcher`'s
+  newest-readable-once contract) and WALKS each new step across the
+  registry's up replicas one at a time: push via ``POST /admin/deploy``
+  (the replica re-reads the step from disk and runs its own boundary
+  canary — raw params never ride the wire), poll that replica's
+  ``/healthz`` deploy section until the swap lands live, then move to
+  the next replica. The FIRST replica-local canary rollback (or push
+  failure, or settle timeout) halts the walk and rolls the
+  already-updated replicas back to their prior committed step —
+  a bad step burns exactly one replica's canary, never the fleet.
+* :class:`CanaryRamp` replaces PR 12's static ``--canary_percent``
+  with an SLO-gated schedule: the canary variant starts at a small
+  crc32 lane slice and widens one rung per sustained-ok window of
+  ``obs/slo.py`` signals (routed TTFT p99, shed rate, eval-loss probe
+  — whatever rules the monitor carries); any transition into breach
+  narrows back to the first rung before the next widening can start.
+  Each change is pushed fleet-wide through ``/admin/deploy`` so router
+  and replicas keep agreeing on who is canaried (same crc32 lane math,
+  same percent, everywhere).
+
+Fault sites (DESIGN.md §22): ``rollout_push`` makes an admin-deploy
+delivery fail mid-walk (typed halt + fleet rollback, no replica left on
+the new step); ``rollout_slo_flap`` injects a synthetic breach signal
+into the ramp (narrow, never widen-through-noise).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from distributed_tensorflow_tpu.obs import recorder as obs_recorder
+from distributed_tensorflow_tpu.serve.deploy.watcher import CheckpointWatcher
+from distributed_tensorflow_tpu.train.checkpoint import list_committed_steps
+from distributed_tensorflow_tpu.utils import faults
+from distributed_tensorflow_tpu.utils.logging import get_logger
+
+__all__ = ["RolloutController", "RolloutResult", "CanaryRamp"]
+
+# stderr: the serving CLIs' stdout carries data and must stay log-free.
+log = get_logger(__name__, stream=sys.stderr)
+
+
+class RolloutResult:
+    """Outcome of walking ONE checkpoint step across the fleet.
+
+    ``outcome`` is one of:
+
+    * ``"committed"``   — every up replica converged to the step;
+    * ``"rolled_back"`` — the walk halted and every already-updated
+      replica was restored to its prior committed step;
+    * ``"halted"``      — the walk halted and at least one rollback
+      failed (the detail names the stragglers — operator attention).
+    """
+
+    __slots__ = ("step", "outcome", "updated", "rolled_back", "halted_at",
+                 "detail")
+
+    def __init__(self, step, outcome, updated=(), rolled_back=(),
+                 halted_at="", detail=""):
+        self.step = int(step)
+        self.outcome = str(outcome)
+        self.updated = tuple(updated)
+        self.rolled_back = tuple(rolled_back)
+        self.halted_at = str(halted_at)
+        self.detail = str(detail)
+
+    def to_dict(self) -> dict:
+        return {
+            "step": self.step,
+            "outcome": self.outcome,
+            "updated": list(self.updated),
+            "rolled_back": list(self.rolled_back),
+            "halted_at": self.halted_at,
+            "detail": self.detail,
+        }
+
+
+class RolloutController:
+    """Walk committed checkpoint steps across a :class:`ReplicaRegistry`.
+
+    The watcher half reuses ``deploy/watcher.py`` wholesale — committed
+    steps only, newest wins, unreadable-once-committed steps are skipped
+    permanently — but the delivered param tree is DISCARDED: replicas
+    re-read the step themselves via ``/admin/deploy`` (one disk read per
+    replica instead of one multi-MB HTTP body per replica, and the
+    replica-side read goes through the same torn-file discipline).
+
+    One walk at a time: the watcher thread is the only caller of
+    :meth:`rollout_step`, and a newer step committed mid-walk is picked
+    up by the next poll after the walk returns.
+    """
+
+    def __init__(
+        self,
+        registry,
+        watch_dir: str,
+        *,
+        params_key: str = "auto",
+        poll_interval_s: float = 0.25,
+        push_timeout_s: float = 10.0,
+        settle_timeout_s: float = 60.0,
+        settle_poll_s: float = 0.1,
+        keep_history: int = 32,
+        start_after: int | None = None,
+        clock=time.monotonic,
+    ):
+        self.registry = registry
+        self.watch_dir = str(watch_dir)
+        self.params_key = str(params_key)
+        self.push_timeout_s = float(push_timeout_s)
+        self.settle_timeout_s = float(settle_timeout_s)
+        self.settle_poll_s = float(settle_poll_s)
+        self.keep_history = int(keep_history)
+        self.clock = clock
+        self.history: list[RolloutResult] = []
+        self.last: RolloutResult | None = None
+        # Literal names at the registration side (the repo-wide idiom
+        # dttlint's metric-drift rule resolves against); scrapers reach
+        # them through serve/metric_names.py constants.
+        r = registry.metrics_registry
+        self._c_rollout = r.counter(
+            "fleet_rollout_total",
+            "Fleet rollout walks by outcome "
+            "(committed / rolled_back / halted).",
+            labels=("outcome",))
+        self._g_current = r.gauge(
+            "fleet_rollout_replicas_current",
+            "Replicas live on the step currently being walked (resets "
+            "to 0 when a walk starts or rolls back).")
+        self._watcher = CheckpointWatcher(
+            self.watch_dir,
+            lambda step, _tree: self.rollout_step(step),
+            poll_interval_s=poll_interval_s,
+            params_key=params_key,
+            start_after=start_after,
+        )
+
+    # -- watcher lifecycle -------------------------------------------------
+
+    def start(self) -> None:
+        self._watcher.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._watcher.stop(timeout)
+
+    def poll_once(self):
+        """One synchronous watch-and-walk cycle (tests, offline tools)."""
+        return self._watcher.poll_once()
+
+    # -- the walk ----------------------------------------------------------
+
+    def rollout_step(self, step: int) -> RolloutResult:
+        """Walk ``step`` across every up replica, one at a time. Never
+        raises: every failure mode lands in the returned
+        :class:`RolloutResult` (and the flight recorder)."""
+        step = int(step)
+        replicas = sorted(
+            (r for r in self.registry.replicas if r.state == "up"),
+            key=lambda r: r.replica_id,
+        )
+        rec = obs_recorder.get_recorder()
+        rec.record(kind="rollout_start", step=step,
+                   replicas=[r.replica_id for r in replicas])
+        # Prior versions FIRST: the rollback targets must be pinned
+        # before any replica moves.
+        prior = {r.replica_id: int(r.last.weight_version) for r in replicas}
+        self._g_current.set(0.0)
+        updated: list[str] = []
+        halted_at = ""
+        detail = ""
+        for r in replicas:
+            ok, why = self._push_and_settle(r, step)
+            if not ok:
+                halted_at = r.replica_id
+                detail = why
+                break
+            updated.append(r.replica_id)
+            self._g_current.set(float(len(updated)))
+            rec.record(kind="rollout_replica_ok", step=step,
+                       replica=r.replica_id)
+        if not halted_at:
+            result = RolloutResult(step, "committed", updated=updated)
+            log.info("fleet rollout: step %d committed across %d replicas",
+                     step, len(updated))
+        else:
+            rec.record(kind="rollout_halt", step=step, replica=halted_at,
+                       detail=detail)
+            log.error("fleet rollout: step %d HALTED at %s (%s) — rolling "
+                      "back %d updated replicas", step, halted_at, detail,
+                      len(updated))
+            by_id = {r.replica_id: r for r in replicas}
+            rolled_back, failures = self._rollback(by_id, updated, prior)
+            outcome = "rolled_back" if not failures else "halted"
+            if failures:
+                detail += "; rollback incomplete: " + "; ".join(failures)
+            result = RolloutResult(
+                step, outcome, updated=updated, rolled_back=rolled_back,
+                halted_at=halted_at, detail=detail,
+            )
+            self._g_current.set(0.0)
+            obs_recorder.dump_to_dir("fleet_rollout_halt")
+        self._c_rollout.labels(outcome=result.outcome).inc()
+        rec.record(kind="rollout_done", **result.to_dict())
+        self.history.append(result)
+        del self.history[:-self.keep_history]
+        self.last = result
+        return result
+
+    # -- per-replica push --------------------------------------------------
+
+    def _push_and_settle(self, replica, step: int):
+        """Push ``step`` to one replica and wait for its swap to settle.
+        Returns ``(ok, why)``; any exception is a typed halt reason."""
+        try:
+            faults.maybe_fail(
+                "rollout_push",
+                f"step {step} -> {replica.replica_id}")
+            self._admin_deploy(replica, {
+                "watch_dir": self.watch_dir,
+                "step": step,
+                "params_key": self.params_key,
+            })
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            return False, f"push failed: {type(exc).__name__}: {exc}"
+        return self._await_settle(replica, step)
+
+    def _admin_deploy(self, replica, body: dict) -> dict:
+        req = urllib.request.Request(
+            replica.base_url + "/admin/deploy",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.push_timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def _read_deploy(self, replica) -> dict:
+        """The replica's /healthz ``deploy`` section. A 503 is still an
+        answer (draining replica) — same rule as the registry's prober."""
+        try:
+            with urllib.request.urlopen(
+                    replica.base_url + "/healthz",
+                    timeout=self.push_timeout_s) as resp:
+                body = json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            body = json.loads(err.read())
+        deploy = body.get("deploy", {})
+        return deploy if isinstance(deploy, dict) else {}
+
+    def _await_settle(self, replica, step: int):
+        """Poll the replica's deploy section until the pushed step lands
+        live (``weight_version == step``), its canary rolls it back, or
+        the settle timeout trips."""
+        deadline = self.clock() + self.settle_timeout_s
+        last_err = ""
+        while True:
+            try:
+                deploy = self._read_deploy(replica)
+            except (OSError, ValueError, json.JSONDecodeError) as exc:
+                deploy, last_err = None, f"{type(exc).__name__}: {exc}"
+            if deploy is not None:
+                last = deploy.get("last_swap") or {}
+                if (int(last.get("step", -1)) == step
+                        and last.get("outcome") == "rollback"):
+                    return False, (
+                        "canary rollback: " + str(last.get("reason", "")))
+                if int(deploy.get("weight_version", 0)) == step:
+                    return True, ""
+                if (int(last.get("step", -1)) == step
+                        and last.get("outcome") == "ok"):
+                    # Landed as a non-live variant-table entry — the swap
+                    # settled even though the live engine version did not
+                    # move (a variant-targeted rollout).
+                    return True, ""
+            if self.clock() >= deadline:
+                return False, (
+                    f"swap did not settle within {self.settle_timeout_s}s"
+                    + (f" (last error {last_err})" if last_err else ""))
+            time.sleep(self.settle_poll_s)
+
+    def _rollback(self, by_id: dict, updated: list, prior: dict):
+        """Restore each already-updated replica to its prior committed
+        step. Returns ``(rolled_back_ids, failure_strings)`` — a prior
+        version that is not a committed step (a replica booted on step
+        0, nothing published yet) cannot be restored by re-push and is
+        reported, not papered over."""
+        committed = set(list_committed_steps(self.watch_dir))
+        rolled_back: list[str] = []
+        failures: list[str] = []
+        for rid in updated:
+            prev = int(prior.get(rid, 0))
+            if prev not in committed:
+                failures.append(
+                    f"{rid}: prior version {prev} is not a committed step")
+                continue
+            try:
+                self._admin_deploy(by_id[rid], {
+                    "watch_dir": self.watch_dir,
+                    "step": prev,
+                    "params_key": self.params_key,
+                })
+                ok, why = self._await_settle(by_id[rid], prev)
+            except (OSError, ValueError, json.JSONDecodeError) as exc:
+                ok, why = False, f"{type(exc).__name__}: {exc}"
+            if ok:
+                rolled_back.append(rid)
+            else:
+                failures.append(f"{rid}: {why}")
+        return rolled_back, failures
+
+
+class CanaryRamp:
+    """SLO-gated canary-percent schedule, pushed fleet-wide.
+
+    The new variant opens at ``schedule[0]`` percent of client lanes and
+    widens ONE rung per ``hold_s`` of sustained-ok SLO signal; any
+    transition into breach (the monitor's ok→breach callback, or the
+    ``rollout_slo_flap`` fault) narrows straight back to the first rung
+    and restarts the hold clock — exposure is earned per rung, and one
+    flap forfeits all of it. Every change POSTs ``{"canary_percent",
+    "canary_variant"}`` to each up replica's ``/admin/deploy``; the
+    router re-learns the percent from its probes, so router and replica
+    lane decisions stay coherent (identical crc32 math, identical
+    percent).
+
+    Drive :meth:`tick` from any cadence (the SLO ticker's own interval
+    is the natural one). ``done`` turns true at the last rung — full
+    promotion — after which the caller typically flips the variant to
+    default or retires the ramp.
+    """
+
+    def __init__(
+        self,
+        registry,
+        slo_monitor=None,
+        *,
+        variant: str = "canary",
+        schedule=(5.0, 25.0, 50.0, 100.0),
+        hold_s: float = 2.0,
+        push_timeout_s: float = 5.0,
+        clock=time.monotonic,
+    ):
+        schedule = tuple(float(p) for p in schedule)
+        if not schedule or list(schedule) != sorted(schedule) or not all(
+                0.0 < p <= 100.0 for p in schedule):
+            raise ValueError(
+                f"schedule must be ascending percents in (0, 100], "
+                f"got {schedule}")
+        self.registry = registry
+        self.variant = str(variant)
+        self.schedule = schedule
+        self.hold_s = float(hold_s)
+        self.push_timeout_s = float(push_timeout_s)
+        self.clock = clock
+        self.started = False
+        self.rung = 0
+        self.narrowed_total = 0
+        self.widened_total = 0
+        self._breached = threading.Event()
+        self._ok_since: float | None = None
+        if slo_monitor is not None:
+            slo_monitor.add_callback(self._on_slo)
+
+    @property
+    def percent(self) -> float:
+        return self.schedule[self.rung] if self.started else 0.0
+
+    @property
+    def done(self) -> bool:
+        return self.started and self.rung == len(self.schedule) - 1
+
+    def _on_slo(self, rule, status, value) -> None:
+        # Monitor callbacks fire on ok→breach and breach→ok transitions;
+        # only the breach edge narrows (recovery is earned via hold_s of
+        # clean ticks, not granted back on the first good reading).
+        if status == "breach":
+            self._breached.set()
+
+    def begin(self) -> float:
+        """Open the canary lane at the first rung and push it out."""
+        self.started = True
+        self.rung = 0
+        self._breached.clear()
+        self._ok_since = self.clock()
+        return self._push()
+
+    def tick(self) -> float:
+        """One ramp decision; returns the (possibly unchanged) percent."""
+        if not self.started:
+            return 0.0
+        breached = self._breached.is_set()
+        if faults.fire("rollout_slo_flap"):
+            breached = True  # injected flap: the signal, not the rule
+        if breached:
+            self._breached.clear()
+            self._ok_since = self.clock()
+            if self.rung > 0:
+                self.rung = 0
+                self.narrowed_total += 1
+                obs_recorder.get_recorder().record(
+                    kind="canary_narrow", variant=self.variant,
+                    percent=self.percent)
+                log.warning(
+                    "canary ramp: SLO breach — %r narrowed to %.1f%%",
+                    self.variant, self.percent)
+                self._push()
+            return self.percent
+        now = self.clock()
+        if (self.rung < len(self.schedule) - 1
+                and self._ok_since is not None
+                and now - self._ok_since >= self.hold_s):
+            self.rung += 1
+            self.widened_total += 1
+            self._ok_since = now
+            obs_recorder.get_recorder().record(
+                kind="canary_widen", variant=self.variant,
+                percent=self.percent)
+            log.info("canary ramp: %r widened to %.1f%%",
+                     self.variant, self.percent)
+            self._push()
+        return self.percent
+
+    def _push(self) -> float:
+        """Push the current percent to every up replica (best-effort per
+        replica — an unreachable replica re-learns the percent at its
+        next push; the router keys off probed healthz state either way)."""
+        body = json.dumps({
+            "canary_percent": self.percent,
+            "canary_variant": self.variant,
+        }).encode()
+        for r in self.registry.replicas:
+            if r.state != "up":
+                continue
+            try:
+                req = urllib.request.Request(
+                    r.base_url + "/admin/deploy", data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(
+                        req, timeout=self.push_timeout_s):
+                    pass
+            except OSError as exc:
+                log.warning("canary ramp: push to %s failed: %s",
+                            r.replica_id, exc)
+        return self.percent

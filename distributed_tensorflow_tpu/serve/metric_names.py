@@ -27,3 +27,6 @@ SERVE_KV_BYTES_PER_TOKEN = "serve_kv_bytes_per_token"
 SERVE_HANDOFF_TOTAL = "serve_handoff_total"
 SERVE_HANDOFF_STALL_SECONDS_TOTAL = "serve_handoff_stall_seconds_total"
 FLEET_HANDOFF_BYTES_TOTAL = "fleet_handoff_bytes_total"
+
+FLEET_ROLLOUT_TOTAL = "fleet_rollout_total"
+FLEET_ROLLOUT_REPLICAS_CURRENT = "fleet_rollout_replicas_current"

@@ -659,8 +659,14 @@ class Scheduler:
                 # Empty iteration boundary: flip the engine onto the
                 # next variant's staged buffer — a reference swap
                 # between jitted rounds, no recompile — then resume
-                # admitting from that variant's queue.
+                # admitting from that variant's queue. A cross-structure
+                # variant instead REBINDS self.engine to its sibling
+                # engine (deploy/variants.set_engine): same boundary
+                # rule, different engine object, so a treedef the base
+                # engine would hard-reject serves behind the same
+                # scheduler/lane/metrics surface.
                 self.variants.activate(switch_to)
+                self.engine = self.variants.engine_for(switch_to)
                 self._variant_served = 0
                 continue
             r = pending.request

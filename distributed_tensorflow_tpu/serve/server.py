@@ -73,6 +73,14 @@ Endpoints:
 * ``POST /admin/handoff_peers`` — ``{"urls": [...]}`` replaces the
   prefill replica's decode-peer list (the fleet supervisor pushes
   membership changes here).
+* ``POST /admin/deploy`` — the fleet rollout controller's control
+  surface (``serve/fleet/rollout.py``). One JSON body, two planes:
+  ``{"watch_dir": ..., "step": N}`` makes THIS replica read the
+  committed checkpoint step from disk and push it through its own
+  ``WeightSwapper`` (stage → boundary canary → flip or rollback —
+  raw params never ride the wire); ``{"canary_percent": P}``
+  retargets the variant table's crc32 lane slice (the SLO ramp).
+  Errors answer typed 400 ``{"error": "invalid"}``.
 """
 
 from __future__ import annotations
@@ -339,6 +347,12 @@ def make_server(
                 variants = getattr(scheduler, "variants", None)
                 if variants is not None:
                     deploy.update(variants.snapshot())
+                # Last swap outcome: the rollout controller polls this to
+                # tell "swap landed live" from "canary rolled it back".
+                last = getattr(getattr(scheduler, "swapper", None),
+                               "last", None)
+                if last is not None:
+                    deploy["last_swap"] = last.to_dict()
                 body["deploy"] = deploy
                 drain_fn = getattr(scheduler, "drain_remaining_s", None)
                 remaining = drain_fn() if drain_fn is not None else None
@@ -376,6 +390,9 @@ def make_server(
                 return
             if self.path == "/admin/handoff_peers":
                 self._handle_handoff_peers()
+                return
+            if self.path == "/admin/deploy":
+                self._handle_admin_deploy()
                 return
             if self.path != "/generate":
                 self._send(404, {"error": "not_found", "detail": self.path})
@@ -590,6 +607,88 @@ def make_server(
                 return
             outbox.set_peers(urls)
             self._send(200, {"ok": True, "peers": outbox.peers()})
+
+        def _handle_admin_deploy(self) -> None:
+            """POST /admin/deploy — push a committed checkpoint step
+            and/or a canary-percent ramp update into this replica.
+
+            The checkpoint plane re-reads the step from disk on the
+            replica (``read_step`` + the watcher's params extraction —
+            the same newest-readable-once machinery, pushed instead of
+            polled) and submits it through the replica's own swapper so
+            every fleet-pushed step still passes the boundary canary.
+            ``DTT_FAULT=deploy_nan`` poisons the pushed candidate here
+            exactly as it poisons a watched one. With ``wait_s`` the
+            response reports the swap outcome inline; otherwise the
+            caller polls ``/healthz``'s ``deploy.last_swap``."""
+            from distributed_tensorflow_tpu.serve.deploy.watcher import (
+                _extract_params,
+                _poison_first_float_leaf,
+            )
+            from distributed_tensorflow_tpu.train.checkpoint import (
+                read_step,
+            )
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, TypeError, json.JSONDecodeError) as exc:
+                self._send(400, {"error": "invalid", "detail": str(exc)})
+                return
+            out = {"ok": True}
+            if "canary_percent" in body:
+                variants = getattr(scheduler, "variants", None)
+                if variants is None:
+                    self._send(400, {"error": "invalid",
+                                     "detail": "replica has no variant "
+                                               "table"})
+                    return
+                try:
+                    variants.set_canary(float(body["canary_percent"]),
+                                        body.get("canary_variant"))
+                except (ValueError, TypeError) as exc:
+                    self._send(400, {"error": "invalid",
+                                     "detail": str(exc)})
+                    return
+                out["canary_percent"] = variants.canary_percent
+                out["canary_variant"] = variants.canary_variant
+            if "step" in body:
+                swapper = getattr(scheduler, "swapper", None)
+                if swapper is None:
+                    self._send(400, {"error": "invalid",
+                                     "detail": "replica has no weight "
+                                               "swapper"})
+                    return
+                try:
+                    step = int(body["step"])
+                    watch_dir = str(body["watch_dir"])
+                    tree = read_step(watch_dir, step)
+                    params = _extract_params(
+                        tree, str(body.get("params_key", "auto")))
+                except (OSError, KeyError, ValueError, TypeError) as exc:
+                    self._send(400, {"error": "invalid",
+                                     "detail": str(exc)})
+                    return
+                if faults.fire("deploy_nan"):
+                    params = _poison_first_float_leaf(params)
+                try:
+                    swapper.submit(step, params,
+                                   variant=body.get("variant") or None)
+                except ValueError as exc:
+                    self._send(400, {"error": "invalid",
+                                     "detail": str(exc)})
+                    return
+                out["step"] = step
+                wait_s = body.get("wait_s")
+                if wait_s:
+                    out["applied"] = bool(
+                        swapper.wait_applied(timeout=float(wait_s)))
+                    last = swapper.last
+                    if last is not None:
+                        out["swap"] = last.to_dict()
+            self._send(200, out)
 
         def _completion_payload(self, outcome: Completion) -> dict:
             payload = {

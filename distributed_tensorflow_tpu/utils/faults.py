@@ -49,7 +49,11 @@ Serving-plane sites (PR 16, DESIGN.md §22 for the outcome each maps to):
 * ``handoff_send_timeout``  — outbound handoff send dies on a timeout;
 * ``spawn_fail``            — supervisor replica spawn raises;
 * ``deploy_nan``            — deploy watcher's canary forward pass sees a
-                              non-finite logit (drives the rollback gate).
+                              non-finite logit (drives the rollback gate);
+* ``rollout_push``          — rollout controller's admin-deploy delivery
+                              fails mid-walk (typed halt + fleet rollback);
+* ``rollout_slo_flap``      — canary ramp sees a synthetic SLO breach
+                              (narrow-to-first-rung, never widen on noise).
 
 The registry is process-local and loads from the env on first use, so
 multiprocess tests arm workers simply by exporting ``DTT_FAULT``.

@@ -93,6 +93,13 @@ class _Accounting:
         # Traffic-shape attribution: outcome + latency samples per
         # schedule phase ("burst", "trough", ...) when --shape is set.
         self.per_phase = {}
+        # Rollout attribution: per-replica weight-version TIMELINE —
+        # an (elapsed_s, version) point appended whenever the version a
+        # replica's answers carry changes (X-Replica + X-Weight-Version
+        # headers). A fleet walk shows up as staggered per-replica
+        # steps; a fleet rollback as steps back down.
+        self.t0 = time.monotonic()
+        self.replica_versions = {}
 
     def _phase_bucket(self, phase):
         return self.per_phase.setdefault(phase, {
@@ -149,6 +156,24 @@ class _Accounting:
                 for name, v in sorted(self.per_variant.items())
             }
 
+    def rollout_report(self):
+        """JSON-ready rollout view: the weight-version timeline each
+        replica's answers traced out, plus every version observed
+        anywhere in the run (headers or done frames)."""
+        with self.lock:
+            versions = set()
+            for v in self.per_variant.values():
+                versions |= set(v["weight_versions"])
+            for tl in self.replica_versions.values():
+                versions |= {wv for _, wv in tl}
+            return {
+                "replica_weight_versions": {
+                    rid: [list(point) for point in tl]
+                    for rid, tl in sorted(self.replica_versions.items())
+                },
+                "versions_observed": sorted(versions),
+            }
+
     def phase_report(self):
         """JSON-ready per-phase split of the shaped run (p50/p95/p99 per
         schedule phase — where "TTFT during the burst" lives)."""
@@ -194,10 +219,22 @@ class _Accounting:
         replica = headers.get("X-Replica")
         attempts = headers.get("X-Attempts")
         trail = headers.get("X-Attempt-Trail")
+        wv = headers.get("X-Weight-Version")
         with self.lock:
             if replica:
                 self.per_replica[replica] = (
                     self.per_replica.get(replica, 0) + 1)
+                if wv is not None:
+                    try:
+                        wvi = int(wv)
+                    except ValueError:
+                        wvi = None
+                    if wvi is not None:
+                        tl = self.replica_versions.setdefault(replica, [])
+                        if ((not tl or tl[-1][1] != wvi)
+                                and len(tl) < 512):
+                            tl.append([
+                                round(time.monotonic() - self.t0, 3), wvi])
             if attempts:
                 try:
                     self.failovers += max(0, int(attempts) - 1)
@@ -546,6 +583,39 @@ def _scrape_handoff(urls):
     return {"replicas": per_replica, "totals": totals}
 
 
+def _scrape_rollout(url):
+    """Fleet rollout counters from the router's /metrics
+    (``fleet_rollout_total{outcome=...}`` and
+    ``fleet_rollout_replicas_current`` — present only when a
+    RolloutController shares the router's registry). Never raises;
+    returns ``(totals_by_outcome, replicas_current)`` with nulls when
+    the families are absent."""
+    totals = {}
+    replicas_current = None
+    if not url:
+        return totals, replicas_current
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                url.rstrip("/") + "/metrics", timeout=5) as r:
+            text = r.read().decode()
+        from distributed_tensorflow_tpu.obs.export import (
+            parse_prometheus_text,
+        )
+        from distributed_tensorflow_tpu.serve import metric_names as mn
+
+        for sample in parse_prometheus_text(text):
+            if sample["name"] == mn.FLEET_ROLLOUT_TOTAL:
+                outcome = sample.get("labels", {}).get("outcome", "?")
+                totals[outcome] = int(sample["value"])
+            elif sample["name"] == mn.FLEET_ROLLOUT_REPLICAS_CURRENT:
+                replicas_current = float(sample["value"])
+    except Exception:  # noqa: BLE001 — the report stays best-effort
+        pass
+    return totals, replicas_current
+
+
 def run_load(
     submit_one,
     *,
@@ -890,6 +960,14 @@ def main(argv=None):
     # the engine recompile after warmup (it must not)?
     slo_status, recompiles, fastpath = _scrape_health(
         targets[0] if targets else "", server)
+    # Rollout view: the per-replica weight-version timelines this run's
+    # responses traced out + the controller's fleet counters (scraped
+    # off the first target, which is the router in fleet runs).
+    rollout_totals, rollout_current = _scrape_rollout(
+        targets[0] if targets else "")
+    rollout_section = acct.rollout_report()
+    rollout_section["fleet_rollout_total"] = rollout_totals
+    rollout_section["fleet_rollout_replicas_current"] = rollout_current
     handoff_report = None
     if args.handoff_report:
         handoff_report = _scrape_handoff(
@@ -998,6 +1076,7 @@ def main(argv=None):
         "per_variant": acct.variant_report(),
         "swap_mid_run": args.swap_mid_run,
         "handoff": handoff_report,
+        "rollout": rollout_section,
     }
     print(json.dumps(report))
     if args.report_file:

@@ -234,6 +234,10 @@ def build_stack(serve_cfg, cfg, params, deploy_cfg=None):
     )
     if swapper is not None:
         swapper.scheduler = scheduler
+        # The /admin/deploy handler only sees the scheduler — bind the
+        # swapper there so fleet-pushed checkpoint steps reach the same
+        # stage → boundary-canary → flip path the watcher uses.
+        scheduler.swapper = swapper
         if deploy_cfg.enabled:
             target = deploy_cfg.deploy_variant or None
             watcher = CheckpointWatcher(

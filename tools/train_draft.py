@@ -209,6 +209,18 @@ def main(argv=None):
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--log_every", type=int, default=50)
     parser.add_argument("--output", default="draft.msgpack")
+    parser.add_argument(
+        "--publish_dir", default="",
+        help="also publish the distilled params as a COMMITTED checkpoint "
+             "step (train/checkpoint.py atomic-commit discipline) so a "
+             "deploy watcher or the fleet rollout controller picks them "
+             "up — the drafter refreshes itself from serving traffic",
+    )
+    parser.add_argument(
+        "--publish_step", type=int, default=-1,
+        help="step number for --publish_dir "
+             "(default: next after the directory's newest committed step)",
+    )
     args = parser.parse_args(argv)
 
     import jax
@@ -290,6 +302,20 @@ def main(argv=None):
         },
     )
     print(f"exported {args.output}")
+
+    if args.publish_dir:
+        from distributed_tensorflow_tpu.train.checkpoint import (
+            list_committed_steps,
+            write_committed_step,
+        )
+
+        step = args.publish_step
+        if step < 0:
+            existing = list_committed_steps(args.publish_dir)
+            step = (existing[-1] + 1) if existing else 1
+        step_dir = write_committed_step(
+            args.publish_dir, step, {"params": draft_params})
+        print(f"published committed step {step} -> {step_dir}")
     return agreement
 
 
